@@ -1,0 +1,6 @@
+"""Thin shim: `python sheeprl.py exp=... ` (reference: sheeprl.py)."""
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    run()

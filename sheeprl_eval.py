@@ -1,0 +1,6 @@
+"""Thin shim: `python sheeprl_eval.py checkpoint_path=...` (reference: sheeprl_eval.py)."""
+
+from sheeprl_tpu.cli import evaluation
+
+if __name__ == "__main__":
+    evaluation()

@@ -1,0 +1,13 @@
+"""sheeprl_tpu: a TPU-native (JAX/XLA/pjit/Pallas) deep-RL framework.
+
+Re-implements the full capability surface of sonnygeorge/sheeprl (PPO/A2C/SAC/DreamerV3
+families + dream_and_ponder) with a TPU-first architecture: pure-functional jitted
+train steps, `lax.scan` recurrences, data-parallel sharding over a `jax.sharding.Mesh`
+with XLA collectives over ICI, and host-side numpy replay buffers feeding HBM.
+"""
+
+import os
+
+__version__ = "0.1.0"
+
+ROOT_DIR = os.path.dirname(os.path.abspath(__file__))

@@ -1,0 +1,23 @@
+"""Print the registered algorithms table (reference: sheeprl/available_agents.py:7)."""
+
+from __future__ import annotations
+
+
+def available_agents() -> str:
+    from sheeprl_tpu.cli import _import_algorithms
+    from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+    _import_algorithms()
+    lines = ["SheepRL-TPU Agents", "=" * 72]
+    lines.append(f"{'Module':<34}{'Algorithm':<22}{'Entrypoint':<12}{'Decoupled'}")
+    lines.append("-" * 72)
+    for module, algos in sorted(algorithm_registry.items()):
+        for algo in algos:
+            lines.append(f"{module:<34}{algo['name']:<22}{algo['entrypoint']:<12}{algo['decoupled']}")
+    lines.append("")
+    lines.append("Registered evaluations: " + ", ".join(sorted({e['name'] for evs in evaluation_registry.values() for e in evs})))
+    return "\n".join(lines)
+
+
+if __name__ == "__main__":
+    print(available_agents())

@@ -1,0 +1,258 @@
+"""CLI: config composition, validation, dispatch to algorithm entrypoints.
+
+Reference: sheeprl/cli.py (run :358, run_algorithm :60, eval_algorithm :202,
+evaluation :369, registration :408, check_configs :271, resume_from_checkpoint :23,
+reproducible :187). Structural difference: no ``fabric.launch`` process fork — JAX is
+single-controller SPMD, so the entrypoint is called directly and parallelism lives in
+the mesh (multi-host runs launch this same CLI once per host with
+``fabric.multihost=True``).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import time
+import warnings
+from typing import Any, Dict, List, Optional, Sequence
+
+from sheeprl_tpu.config import ConfigError, compose
+from sheeprl_tpu.core.runtime import Runtime, build_runtime, seed_everything
+from sheeprl_tpu.utils.checkpoint import CheckpointCallback, load_state
+from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+from sheeprl_tpu.utils.utils import dotdict, print_config
+
+# Algorithm modules are imported lazily by name; this manifest mirrors the reference's
+# eager imports in sheeprl/__init__.py:18-50 and keeps `available_agents` cheap.
+KNOWN_ALGO_MODULES = [
+    "a2c",
+    "dream_and_ponder",
+    "dreamer_v1",
+    "dreamer_v2",
+    "dreamer_v3",
+    "droq",
+    "p2e_dv1",
+    "p2e_dv2",
+    "p2e_dv3",
+    "ppo",
+    "ppo_recurrent",
+    "sac",
+    "sac_ae",
+]
+
+
+def _import_algorithms() -> None:
+    for mod in KNOWN_ALGO_MODULES:
+        try:
+            importlib.import_module(f"sheeprl_tpu.algos.{mod}")
+        except ModuleNotFoundError:
+            pass
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Merge the checkpoint's sidecar config, preserving run-identity keys.
+
+    Reference: sheeprl/cli.py:23-57.
+    """
+    if cfg.checkpoint.resume_from is None:
+        return cfg
+    ckpt_path = os.path.abspath(cfg.checkpoint.resume_from)
+    if not os.path.isfile(ckpt_path):
+        raise ValueError(f"The checkpoint to resume from does not exist: {ckpt_path}")
+    old_cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
+    if not os.path.isfile(old_cfg_path):
+        raise RuntimeError(f"The config file of the checkpoint to resume from does not exist: {old_cfg_path}")
+    import yaml
+
+    with open(old_cfg_path) as f:
+        old_cfg = dotdict(yaml.safe_load(f))
+    if old_cfg.env.id != cfg.env.id:
+        raise ValueError(
+            f"This experiment is run with a different environment from the one of the experiment you want to restart. "
+            f"Got '{cfg.env.id}', when '{old_cfg.env.id}' is expected."
+        )
+    if old_cfg.algo.name != cfg.algo.name:
+        raise ValueError(
+            f"This experiment is run with a different algorithm from the one of the experiment you want to restart. "
+            f"Got '{cfg.algo.name}', when '{old_cfg.algo.name}' is expected."
+        )
+    merged = dotdict(old_cfg)
+    merged.checkpoint = cfg.checkpoint
+    merged.checkpoint.resume_from = ckpt_path
+    merged.run_name = cfg.run_name
+    merged.root_dir = cfg.root_dir
+    merged.seed = cfg.seed
+    merged.fabric = cfg.fabric
+    return merged
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config validation (reference: sheeprl/cli.py:271-345)."""
+    algo_name = cfg.algo.name
+    decoupled = False
+    entry = _find_entrypoint(algo_name)
+    if entry is not None:
+        decoupled = entry["decoupled"]
+    if decoupled and cfg.fabric.devices in (1, "1"):
+        raise RuntimeError(f"The decoupled version of {algo_name} requires at least 2 devices/processes to run")
+    if cfg.get("num_threads", 1) < 1:
+        raise ValueError(f"num_threads must be >= 1, got {cfg.num_threads}")
+    if cfg.metric.log_level not in (0, 1):
+        raise ValueError(f"metric.log_level must be 0 or 1, got {cfg.metric.log_level}")
+    if "precision" in cfg.fabric and cfg.fabric.precision in ("16-true",):
+        warnings.warn("fp16-true is unstable on TPU; prefer bf16-mixed", UserWarning)
+
+
+def check_configs_evaluation(cfg: dotdict) -> None:
+    if cfg.float32_matmul_precision not in ("highest", "high", "default", "medium"):
+        raise ValueError(
+            "Invalid value '{}' for the 'float32_matmul_precision' parameter.".format(cfg.float32_matmul_precision)
+        )
+    if cfg.checkpoint_path is None:
+        raise ValueError("You must specify the evaluation checkpoint path")
+
+
+def _find_entrypoint(algo_name: str) -> Optional[Dict[str, Any]]:
+    for module, implementations in algorithm_registry.items():
+        for algo in implementations:
+            if algo["name"] == algo_name:
+                return {"module": module, **algo}
+    return None
+
+
+def _apply_global_flags(cfg: dotdict) -> None:
+    import jax
+
+    precision_map = {"highest": "highest", "high": "high", "default": "default", "medium": "default"}
+    try:
+        jax.config.update(
+            "jax_default_matmul_precision", precision_map.get(cfg.get("float32_matmul_precision", "high"), "high")
+        )
+    except Exception:
+        pass
+    if cfg.get("jax_deterministic_ops", False):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_gpu_deterministic_ops=true"
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Lookup + dispatch (reference: sheeprl/cli.py:60-199)."""
+    _import_algorithms()
+    entry = _find_entrypoint(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no entrypoint has been registered")
+    module = entry["module"]
+    task = importlib.import_module(f"{module}.{entry['name']}")
+    command = getattr(task, entry["entrypoint"])
+
+    utils = importlib.import_module(f"{module}.utils")
+    # Prune metric keys the algorithm does not produce (reference cli.py:151-165)
+    keys_to_remove = []
+    if cfg.metric.log_level > 0 and "aggregator" in cfg.metric:
+        aggregator_keys = getattr(utils, "AGGREGATOR_KEYS", set())
+        keys_to_remove = [k for k in cfg.metric.aggregator.metrics.keys() if k not in aggregator_keys]
+        for k in keys_to_remove:
+            cfg.metric.aggregator.metrics.pop(k, None)
+    # Prune model-manager models (reference cli.py:166-181)
+    models_keys = set(getattr(utils, "MODELS_TO_REGISTER", set()))
+    if "models" in cfg.model_manager:
+        for k in list(cfg.model_manager.models.keys()):
+            if k not in models_keys:
+                cfg.model_manager.models.pop(k, None)
+
+    callbacks = [CheckpointCallback(keep_last=cfg.checkpoint.keep_last)]
+    runtime = build_runtime(cfg.fabric, extra_callbacks=[])
+    runtime.callbacks = callbacks
+    seed_everything(cfg.seed)
+    _apply_global_flags(cfg)
+    if runtime.is_global_zero:
+        print_config(cfg)
+    command(runtime, cfg)
+
+
+def eval_algorithm(cfg: dotdict) -> None:
+    """Evaluation dispatch (reference: sheeprl/cli.py:202-268)."""
+    _import_algorithms()
+    cfg.run_test = True
+    entry = _find_entrypoint(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no entrypoint has been registered")
+    module = entry["module"]
+    evals = evaluation_registry.get(module, [])
+    eval_entry = next((e for e in evals if e["name"] == entry["name"]), None)
+    if eval_entry is None:
+        raise RuntimeError(f"No evaluation has been registered for the algorithm named '{cfg.algo.name}'")
+    task = importlib.import_module(f"{module}.{eval_entry['evaluation_file']}")
+    command = getattr(task, eval_entry["entrypoint"])
+    runtime = Runtime(accelerator=cfg.fabric.get("accelerator", "auto"), devices=1, precision=cfg.fabric.precision)
+    seed_everything(cfg.seed)
+    _apply_global_flags(cfg)
+    state = load_state(cfg.checkpoint_path)
+    command(runtime, cfg, state)
+
+
+def evaluation(overrides: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl-eval` entry: boot entirely from the checkpoint's sidecar config.
+
+    Reference: sheeprl/cli.py:369-405.
+    """
+    overrides = list(overrides if overrides is not None else sys.argv[1:])
+    cli_cfg: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        import yaml as _yaml
+
+        cli_cfg[key.strip()] = _yaml.safe_load(value)
+    ckpt_path = cli_cfg.get("checkpoint_path")
+    if ckpt_path is None:
+        raise ConfigError("You must specify checkpoint_path=<path> for evaluation")
+    ckpt_path = os.path.abspath(ckpt_path)
+    cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
+    if not os.path.isfile(cfg_path):
+        raise RuntimeError(f"The config file of the checkpoint does not exist: {cfg_path}")
+    import yaml
+
+    with open(cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+    cfg.checkpoint_path = ckpt_path
+    # Evaluation runs single-device / single-env (reference cli.py:383-390)
+    cfg.env.num_envs = 1
+    cfg.fabric.devices = 1
+    cfg.env.capture_video = bool(cli_cfg.get("env.capture_video", cfg.env.get("capture_video", True)))
+    if "fabric.accelerator" in cli_cfg:
+        cfg.fabric.accelerator = cli_cfg["fabric.accelerator"]
+    if "seed" in cli_cfg:
+        cfg.seed = cli_cfg["seed"]
+    if "float32_matmul_precision" in cli_cfg:
+        cfg.float32_matmul_precision = cli_cfg["float32_matmul_precision"]
+    check_configs_evaluation(cfg)
+    eval_algorithm(cfg)
+
+
+def registration(overrides: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl-registration` entry: register checkpointed models in a model registry.
+
+    Reference: sheeprl/cli.py:408-450. Requires MLflow, which is optional; without it
+    this command degrades to a clear error message.
+    """
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError("MLflow is not installed; model registration is unavailable in this build")
+    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint  # pragma: no cover
+
+    overrides = list(overrides if overrides is not None else sys.argv[1:])
+    cfg = compose(config_name="model_manager_config", overrides=overrides)  # pragma: no cover
+    register_model_from_checkpoint(cfg)  # pragma: no cover
+
+
+def run(overrides: Optional[Sequence[str]] = None) -> None:
+    """Main `sheeprl` entry (reference: sheeprl/cli.py:358-366)."""
+    t0 = time.perf_counter()
+    overrides = list(overrides if overrides is not None else sys.argv[1:])
+    cfg = compose(config_name="config", overrides=overrides)
+    cfg = resume_from_checkpoint(cfg)
+    check_configs(cfg)
+    run_algorithm(cfg)
+    if cfg.get("exp", {}) and cfg.get("run_benchmarks", False):
+        print(f"Elapsed time: {time.perf_counter() - t0:.3f} s")

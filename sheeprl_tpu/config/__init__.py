@@ -1,0 +1,10 @@
+from sheeprl_tpu.config.loader import (
+    MISSING,
+    ConfigError,
+    compose,
+    instantiate,
+    load_config,
+    resolve_interpolations,
+)
+
+__all__ = ["MISSING", "ConfigError", "compose", "instantiate", "load_config", "resolve_interpolations"]

@@ -1,0 +1,210 @@
+"""TPU runtime context: device mesh, precision policy, sharding helpers, seeding.
+
+This is the TPU-native replacement for Lightning Fabric (reference L0,
+sheeprl/configs/fabric/default.yaml + sheeprl/cli.py:199). Design differences, on purpose:
+
+- Single-controller SPMD: one Python process drives all local devices through a
+  ``jax.sharding.Mesh``; data parallelism is expressed by sharding the batch on the
+  ``data`` mesh axis and keeping params replicated — XLA inserts the gradient
+  all-reduce over ICI (no DDP wrappers, no NCCL process groups).
+- Multi-host: ``jax.distributed.initialize`` (config ``fabric.multihost``) extends the
+  same mesh over DCN; ``global_rank``/``world_size`` then reflect processes, while the
+  mesh spans all global devices.
+- Precision: a policy pair (param_dtype, compute_dtype). ``bf16-mixed`` = fp32 params +
+  bf16 compute (matches the stability recipe of the reference's ``bf16-true`` runs with
+  dtype-preserving LayerNorms, sheeprl/models/models.py:507-525).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+from contextlib import nullcontext
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_PRECISIONS = {
+    "32-true": (jnp.float32, jnp.float32),
+    "32": (jnp.float32, jnp.float32),
+    "bf16-mixed": (jnp.float32, jnp.bfloat16),
+    "bf16-true": (jnp.bfloat16, jnp.bfloat16),
+    "16-mixed": (jnp.float32, jnp.float16),
+}
+
+
+def seed_everything(seed: int) -> int:
+    """Seed python/numpy; JAX randomness is explicit via PRNG keys derived from the seed.
+
+    Reference: ``fabric.seed_everything`` via the ``reproducible`` wrapper
+    (sheeprl/cli.py:187-197).
+    """
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    os.environ["PYTHONHASHSEED"] = str(seed)
+    return seed
+
+
+@dataclass
+class Runtime:
+    """Accelerator + distributed context handed to every algorithm entrypoint."""
+
+    accelerator: str = "auto"
+    devices: Any = "auto"
+    strategy: str = "auto"
+    precision: str = "32-true"
+    mesh_axes: Sequence[str] = ("data",)
+    callbacks: Sequence[Any] = field(default_factory=list)
+    multihost: bool = False
+
+    def __post_init__(self):
+        if self.multihost and jax.process_count() == 1:  # pragma: no cover - multihost only
+            try:
+                jax.distributed.initialize()
+            except Exception:
+                pass
+        platform = None if self.accelerator in ("auto", "gpu", "cuda") else self.accelerator
+        if self.accelerator in ("tpu", "axon"):
+            platform = None  # default platform is already the TPU under axon
+        try:
+            all_devices = jax.devices(platform) if platform else jax.devices()
+        except RuntimeError:
+            all_devices = jax.devices()
+        n = self.devices
+        if n in ("auto", None, -1, "-1"):
+            n = len(all_devices)
+        n = int(n)
+        if n > len(all_devices):
+            raise ValueError(f"Requested {n} devices but only {len(all_devices)} available: {all_devices}")
+        self._devices = all_devices[:n]
+        axes = tuple(self.mesh_axes)
+        if len(axes) == 1:
+            shape = (n,)
+        else:
+            # trailing axes get size 1 unless configured via `devices` being a list
+            shape = (n,) + (1,) * (len(axes) - 1)
+        self.mesh = Mesh(np.asarray(self._devices).reshape(shape), axes)
+        if self.precision not in _PRECISIONS:
+            raise ValueError(f"Unknown precision '{self.precision}'. Choose from {list(_PRECISIONS)}")
+        self.param_dtype, self.compute_dtype = _PRECISIONS[self.precision]
+
+    # ----- topology ------------------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        """Number of data-parallel shards (devices in the mesh)."""
+        return int(np.prod(self.mesh.devices.shape))
+
+    @property
+    def global_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def node_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return jax.process_index() == 0
+
+    @property
+    def device(self):
+        return self._devices[0]
+
+    # ----- sharding ------------------------------------------------------------------
+    @property
+    def data_sharding(self) -> NamedSharding:
+        """Batch-dim sharding over the 'data' mesh axis."""
+        return NamedSharding(self.mesh, P("data"))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def shard_batch(self, tree):
+        """Move a host pytree to device, sharded on the leading (batch) axis."""
+        sh = self.data_sharding
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+    def replicate(self, tree):
+        """Move a pytree to device, replicated across the mesh."""
+        sh = self.replicated
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
+
+    def local_batch_slice(self, global_batch: int) -> int:
+        if global_batch % self.world_size != 0:
+            raise ValueError(f"Global batch {global_batch} not divisible by world size {self.world_size}")
+        return global_batch // self.world_size
+
+    # ----- precision -----------------------------------------------------------------
+    def cast_compute(self, tree):
+        def cast(x):
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(self.compute_dtype)
+            return x
+
+        return jax.tree_util.tree_map(cast, tree)
+
+    # ----- misc Fabric-parity surface ------------------------------------------------
+    def print(self, *args, **kwargs):
+        if self.is_global_zero:
+            print(*args, **kwargs)
+
+    def call(self, hook_name: str, **kwargs):
+        """Invoke callbacks (reference: fabric.call -> CheckpointCallback)."""
+        for cb in self.callbacks:
+            fn = getattr(cb, hook_name, None)
+            if fn is not None:
+                fn(runtime=self, **kwargs)
+
+    def barrier(self):
+        # Single-controller: nothing to synchronize on host. Multi-controller: sync via
+        # a tiny collective.
+        if jax.process_count() > 1:  # pragma: no cover - multihost only
+            x = jnp.ones(())
+            jax.block_until_ready(
+                jax.pmap(lambda y: jax.lax.psum(y, "i"), axis_name="i")(
+                    jnp.broadcast_to(x, (jax.local_device_count(),))
+                )
+            )
+
+    def seed_everything(self, seed: int) -> int:
+        return seed_everything(seed)
+
+
+def build_runtime(cfg_fabric: Dict[str, Any], extra_callbacks: Optional[Sequence[Any]] = None) -> Runtime:
+    """Instantiate the Runtime from the ``fabric:`` config group."""
+    callbacks = []
+    for cb_spec in cfg_fabric.get("callbacks", []) or []:
+        if isinstance(cb_spec, dict) and "_target_" in cb_spec:
+            from sheeprl_tpu.config import instantiate
+
+            callbacks.append(instantiate(cb_spec))
+        else:
+            callbacks.append(cb_spec)
+    callbacks.extend(extra_callbacks or [])
+    return Runtime(
+        accelerator=cfg_fabric.get("accelerator", "auto"),
+        devices=cfg_fabric.get("devices", "auto"),
+        strategy=cfg_fabric.get("strategy", "auto"),
+        precision=cfg_fabric.get("precision", "32-true"),
+        callbacks=callbacks,
+        multihost=bool(cfg_fabric.get("multihost", False)),
+    )
+
+
+def get_single_device_runtime(runtime: Runtime) -> Runtime:
+    """A 1-device twin of ``runtime`` for player/eval models.
+
+    Reference: ``get_single_device_fabric`` (sheeprl/utils/fabric.py:8-35).
+    """
+    return Runtime(
+        accelerator=runtime.accelerator,
+        devices=1,
+        strategy="auto",
+        precision=runtime.precision,
+        callbacks=list(runtime.callbacks),
+    )

@@ -1,0 +1,447 @@
+"""Environment wrappers (host-side, numpy).
+
+Behavioral parity with reference sheeprl/envs/wrappers.py — ActionRepeat (:48-71),
+RestartOnException (:74-123, the framework's env-level fault tolerance), dilated
+FrameStack (:126-182), RewardAsObservationWrapper (:185-241), GrayscaleRenderWrapper
+(:244-255), ActionsAsObservationWrapper (:258-342), MaskVelocityWrapper (:13-45) —
+re-implemented against the gymnasium 1.x API. Env stepping always stays on host CPU;
+nothing in this module touches JAX.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import gymnasium as gym
+import numpy as np
+
+
+class DictObservationWrapper(gym.Wrapper):
+    """Wrap a non-dict observation space into ``Dict({key: space})``.
+
+    Replaces the reference's use of ``gym.wrappers.TransformObservation`` +
+    manual ``observation_space`` patching (sheeprl/utils/env.py:118-131).
+    """
+
+    def __init__(self, env: gym.Env, key: str):
+        super().__init__(env)
+        self._key = key
+        self.observation_space = gym.spaces.Dict({key: env.observation_space})
+
+    def _wrap(self, obs):
+        return {self._key: obs}
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._wrap(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._wrap(obs), reward, terminated, truncated, info
+
+
+class RenderObservationWrapper(gym.Wrapper):
+    """Add an rgb render of the env as a pixel observation key.
+
+    gymnasium-1.x equivalent of the reference's ``PixelObservationWrapper`` usage
+    (sheeprl/utils/env.py:107-117): keeps the state observation under ``state_key``
+    (unless ``pixels_only``) and adds ``pixel_key`` from ``env.render()``.
+    """
+
+    def __init__(self, env: gym.Env, pixel_key: str, state_key: Optional[str] = None, pixels_only: bool = False):
+        super().__init__(env)
+        self._pixel_key = pixel_key
+        self._state_key = state_key
+        self._pixels_only = pixels_only
+        sample = env.render()
+        if sample is None:
+            raise RuntimeError(
+                "RenderObservationWrapper requires the env to be created with render_mode='rgb_array'"
+            )
+        frame = np.asarray(sample)
+        spaces = {pixel_key: gym.spaces.Box(0, 255, frame.shape, np.uint8)}
+        if not pixels_only:
+            if state_key is None:
+                raise ValueError("state_key is required when pixels_only=False")
+            spaces[state_key] = env.observation_space
+        self.observation_space = gym.spaces.Dict(spaces)
+
+    def _wrap(self, obs):
+        out = {self._pixel_key: np.asarray(self.env.render(), dtype=np.uint8)}
+        if not self._pixels_only:
+            out[self._state_key] = obs
+        return out
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._wrap(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._wrap(obs), reward, terminated, truncated, info
+
+
+class ImageTransformWrapper(gym.Wrapper):
+    """Resize / grayscale / channel-first normalization for the given cnn keys.
+
+    Matches the transform pipeline of sheeprl/utils/env.py:161-198: any 2D/3D pixel
+    obs becomes uint8 ``[C, H, W]`` with ``C`` = 1 (grayscale) or 3 and
+    ``H = W = screen_size``. cv2 ops run on channel-last images.
+    """
+
+    def __init__(self, env: gym.Env, cnn_keys: Sequence[str], screen_size: int, grayscale: bool):
+        super().__init__(env)
+        import cv2  # local import: cv2 is an env-layer-only dependency
+
+        self._cv2 = cv2
+        self._keys = list(cnn_keys)
+        self._size = int(screen_size)
+        self._gray = bool(grayscale)
+        self.observation_space = copy.deepcopy(env.observation_space)
+        channels = 1 if self._gray else 3
+        for k in self._keys:
+            self.observation_space[k] = gym.spaces.Box(0, 255, (channels, self._size, self._size), np.uint8)
+
+    def _transform(self, img: np.ndarray) -> np.ndarray:
+        cv2 = self._cv2
+        if img.ndim == 2:
+            img = img[None]
+        channel_first = img.shape[0] in (1, 3)
+        if channel_first:
+            img = np.transpose(img, (1, 2, 0))
+        if img.shape[:2] != (self._size, self._size):
+            img = cv2.resize(img, (self._size, self._size), interpolation=cv2.INTER_AREA)
+            if img.ndim == 2:
+                img = img[..., None]
+        if self._gray and img.shape[-1] == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None]
+        elif not self._gray and img.shape[-1] == 1:
+            img = np.repeat(img, 3, axis=-1)
+        return np.ascontiguousarray(img.transpose(2, 0, 1).astype(np.uint8))
+
+    def _apply(self, obs):
+        for k in self._keys:
+            obs[k] = self._transform(np.asarray(obs[k]))
+        return obs
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._apply(obs), info
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._apply(obs), reward, terminated, truncated, info
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat the action ``amount`` times, summing rewards (reference :48-71)."""
+
+    def __init__(self, env: gym.Env, amount: int = 1):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError("`amount` should be a positive integer")
+        self._amount = int(amount)
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action):
+        total = 0.0
+        terminated = truncated = False
+        obs, info = None, {}
+        for _ in range(self._amount):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            total += float(reward)
+            if terminated or truncated:
+                break
+        return obs, total, terminated, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Fault tolerance: rebuild a crashed env, rate-limited (reference :74-123).
+
+    A restart surfaces ``info["restart_on_exception"] = True`` so algorithms can patch
+    buffers / reset recurrent states (consumed by DreamerV3, dreamer_v3.py:651-664).
+    """
+
+    def __init__(
+        self,
+        env_fn: Callable[[], gym.Env],
+        exceptions: Union[type, Tuple[type, ...], List[type]] = (Exception,),
+        window: float = 300,
+        maxfails: int = 2,
+        wait: float = 20,
+    ):
+        if not isinstance(exceptions, (tuple, list)):
+            exceptions = (exceptions,)
+        self._env_fn = env_fn
+        self._exceptions = tuple(exceptions)
+        self._window = window
+        self._maxfails = maxfails
+        self._wait = wait
+        self._last_fail_time = time.time()
+        self._fails = 0
+        super().__init__(env_fn())
+
+    def _record_failure(self, err: Exception, phase: str) -> None:
+        now = time.time()
+        if now > self._last_fail_time + self._window:
+            self._last_fail_time = now
+            self._fails = 1
+        else:
+            self._fails += 1
+        if self._fails > self._maxfails:
+            raise RuntimeError(f"The env crashed too many times: {self._fails}") from err
+        gym.logger.warn(f"{phase} - Restarting env after crash with {type(err).__name__}: {err}")
+        time.sleep(self._wait)
+        self.env = self._env_fn()
+
+    def step(self, action):
+        try:
+            return self.env.step(action)
+        except self._exceptions as e:
+            self._record_failure(e, "STEP")
+            obs, info = self.env.reset()
+            info["restart_on_exception"] = True
+            return obs, 0.0, False, False, info
+
+    def reset(self, *, seed=None, options=None):
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except self._exceptions as e:
+            self._record_failure(e, "RESET")
+            obs, info = self.env.reset(seed=seed, options=options)
+            info["restart_on_exception"] = True
+            return obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` frames of each cnn key, with dilation.
+
+    Output shape per key: ``[num_stack, C, H, W]``. A dilation of ``d`` keeps one of
+    every ``d`` frames from a window of ``num_stack * d`` (reference :126-182, incl.
+    the DIAMBRA round-boundary refill).
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1):
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"Invalid value for num_stack, expected a value greater than zero, got {num_stack}")
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Expected an observation space of type gym.spaces.Dict, got: {type(env.observation_space)}"
+            )
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._cnn_keys = [k for k, v in env.observation_space.spaces.items() if cnn_keys and len(v.shape) == 3]
+        if not self._cnn_keys:
+            raise RuntimeError("Specify at least one valid cnn key to be stacked")
+        self.observation_space = copy.deepcopy(env.observation_space)
+        for k in self._cnn_keys:
+            src = env.observation_space[k]
+            self.observation_space[k] = gym.spaces.Box(
+                np.repeat(src.low[None, ...], num_stack, axis=0),
+                np.repeat(src.high[None, ...], num_stack, axis=0),
+                (num_stack, *src.shape),
+                src.dtype,
+            )
+        self._frames: Dict[str, deque] = {k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys}
+
+    def _stacked(self, key: str) -> np.ndarray:
+        picked = list(self._frames[key])[self._dilation - 1 :: self._dilation]
+        assert len(picked) == self._num_stack
+        return np.stack(picked, axis=0)
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        diambra_boundary = (
+            info.get("env_domain") == "DIAMBRA"
+            and {"round_done", "stage_done", "game_done"} <= info.keys()
+            and (info["round_done"] or info["stage_done"] or info["game_done"])
+            and not (terminated or truncated)
+        )
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+            if diambra_boundary:
+                for _ in range(self._num_stack * self._dilation - 1):
+                    self._frames[k].append(obs[k])
+            obs[k] = self._stacked(k)
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, *, seed=None, options=None, **kwargs):
+        obs, info = self.env.reset(seed=seed, **kwargs)
+        for k in self._cnn_keys:
+            self._frames[k].clear()
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+            obs[k] = self._stacked(k)
+        return obs, info
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the last reward under the ``reward`` observation key (reference :185-241)."""
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        low, high = getattr(env, "reward_range", None) or (-np.inf, np.inf)
+        reward_space = gym.spaces.Box(low, high, (1,), np.float32)
+        if isinstance(env.observation_space, gym.spaces.Dict):
+            self._dict_obs = True
+            self.observation_space = gym.spaces.Dict(
+                {"reward": reward_space, **dict(env.observation_space.spaces)}
+            )
+        else:
+            self._dict_obs = False
+            self.observation_space = gym.spaces.Dict({"obs": env.observation_space, "reward": reward_space})
+
+    def _wrap(self, obs, reward) -> Dict[str, Any]:
+        reward_obs = np.asarray(reward, dtype=np.float32).reshape(-1)
+        if self._dict_obs:
+            obs["reward"] = reward_obs
+            return obs
+        return {"obs": obs, "reward": reward_obs}
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._wrap(obs, reward), reward, terminated, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        return self._wrap(obs, 0.0), info
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Upcast grayscale renders to 3 channels so video encoders accept them (:244-255)."""
+
+    def render(self):
+        frame = super().render()
+        if isinstance(frame, np.ndarray):
+            if frame.ndim == 2:
+                frame = frame[..., None]
+            if frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = frame.repeat(3, axis=-1)
+        return frame
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Append a (dilated) stack of past actions under ``action_stack`` (reference :258-342).
+
+    Discrete/multi-discrete actions are one-hot encoded; continuous are raw. ``noop``
+    defines the padding action used after reset.
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Union[float, int, List[int]], dilation: int = 1):
+        super().__init__(env)
+        if num_stack < 1:
+            raise ValueError(
+                "The number of actions to the `action_stack` observation "
+                f"must be greater or equal than 1, got: {num_stack}"
+            )
+        if dilation < 1:
+            raise ValueError(f"The actions stack dilation argument must be greater than zero, got: {dilation}")
+        if not isinstance(noop, (int, float, list)):
+            raise ValueError(f"The noop action must be an integer or float or list, got: {noop} ({type(noop)})")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        self._actions: deque = deque(maxlen=num_stack * dilation)
+        space = env.action_space
+        self._kind = (
+            "continuous"
+            if isinstance(space, gym.spaces.Box)
+            else "multidiscrete" if isinstance(space, gym.spaces.MultiDiscrete) else "discrete"
+        )
+        if self._kind == "continuous":
+            if isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a float for continuous action spaces, got: {noop}")
+            self._dim = space.shape[0]
+            low = np.resize(space.low, self._dim * num_stack)
+            high = np.resize(space.high, self._dim * num_stack)
+            self.noop = np.full((self._dim,), noop, dtype=np.float32)
+        elif self._kind == "multidiscrete":
+            if not isinstance(noop, list):
+                raise ValueError(f"The noop actions must be a list for multi-discrete action spaces, got: {noop}")
+            if len(space.nvec) != len(noop):
+                raise RuntimeError(
+                    "The number of noop actions must be equal to the number of actions of the environment. "
+                    f"Got env_action_space = {space.nvec} and noop = {noop}"
+                )
+            self._dim = int(sum(space.nvec))
+            low, high = 0, 1
+            self.noop = self._one_hot_multi(noop)
+        else:
+            if isinstance(noop, (list, float)):
+                raise ValueError(f"The noop actions must be an integer for discrete action spaces, got: {noop}")
+            self._dim = int(space.n)
+            low, high = 0, 1
+            self.noop = np.zeros((self._dim,), dtype=np.float32)
+            self.noop[noop] = 1.0
+        self.observation_space = copy.deepcopy(env.observation_space)
+        self.observation_space["action_stack"] = gym.spaces.Box(
+            low=low, high=high, shape=(self._dim * num_stack,), dtype=np.float32
+        )
+
+    def _one_hot_multi(self, action) -> np.ndarray:
+        pieces = []
+        for a, n in zip(action, self.env.action_space.nvec):
+            piece = np.zeros((int(n),), dtype=np.float32)
+            piece[int(a)] = 1.0
+            pieces.append(piece)
+        return np.concatenate(pieces, axis=-1)
+
+    def _encode(self, action) -> np.ndarray:
+        if self._kind == "continuous":
+            return np.asarray(action, dtype=np.float32)
+        if self._kind == "multidiscrete":
+            return self._one_hot_multi(action)
+        onehot = np.zeros((self._dim,), dtype=np.float32)
+        onehot[int(action)] = 1.0
+        return onehot
+
+    def _stacked(self) -> np.ndarray:
+        picked = list(self._actions)[self._dilation - 1 :: self._dilation]
+        return np.concatenate(picked, axis=-1).astype(np.float32)
+
+    def step(self, action):
+        self._actions.append(self._encode(action))
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        obs["action_stack"] = self._stacked()
+        return obs, reward, terminated, truncated, info
+
+    def reset(self, *, seed=None, options=None):
+        obs, info = self.env.reset(seed=seed, options=options)
+        self._actions.clear()
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self.noop)
+        obs["action_stack"] = self._stacked()
+        return obs, info
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity entries of classic-control observations (POMDP-ify, :13-45)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        assert env.unwrapped.spec is not None
+        env_id = env.unwrapped.spec.id
+        self.mask = np.ones_like(env.observation_space.sample())
+        try:
+            self.mask[self.velocity_indices[env_id]] = 0.0
+        except KeyError as e:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}") from e
+
+    def observation(self, observation):
+        return observation * self.mask

@@ -1,0 +1,119 @@
+"""Checkpoint save/load for heterogeneous training state.
+
+Reference: ``fabric.save/load`` (torch.save pickles) + CheckpointCallback
+(sheeprl/utils/callback.py:14-148). The TPU build keeps the same state-dict shapes
+(plain dicts of params/opt-state pytrees, counters, buffer states) and the same
+config-sidecar convention. JAX arrays are converted to numpy on save so checkpoints are
+device-agnostic and resumable on any topology; algorithms re-shard on restore.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree, is_leaf=lambda x: isinstance(x, jax.Array))
+
+
+def save_state(path: str, state: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    host_state = _to_host(state)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class CheckpointCallback:
+    """Checkpoint hooks invoked via ``runtime.call`` (reference callback.py:14-148).
+
+    ``keep_last`` garbage-collects old checkpoints. When the buffer is checkpointed,
+    the last ``truncated`` flag of every env stream is patched to True before saving and
+    restored afterwards, so resumed training treats in-flight episodes as truncated
+    (reference callback.py:87-142).
+    """
+
+    def __init__(self, keep_last: Optional[int] = None):
+        self.keep_last = keep_last
+
+    @staticmethod
+    def _sub_buffers(rb):
+        # EnvIndependentReplayBuffer exposes its per-env sub-buffers via .buffer
+        # (a tuple of ReplayBuffers); plain buffers are their own single sub-buffer.
+        buf = getattr(rb, "buffer", None)
+        if isinstance(buf, (list, tuple)) and all(hasattr(b, "_patch_truncated") for b in buf):
+            return list(buf)
+        return [rb]
+
+    def _fix_buffer_pre(self, rb):
+        if rb is None:
+            return None
+        originals = []
+        for b in self._sub_buffers(rb):
+            patch = getattr(b, "_patch_truncated", None)
+            originals.append(patch() if patch else None)
+        return originals
+
+    def _fix_buffer_post(self, rb, originals):
+        if rb is None or originals is None:
+            return
+        for b, orig in zip(self._sub_buffers(rb), originals):
+            if orig is not None and hasattr(b, "_unpatch_truncated"):
+                b._unpatch_truncated(orig)
+
+    def on_checkpoint_coupled(
+        self,
+        runtime,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer=None,
+        **_: Any,
+    ) -> None:
+        if replay_buffer is not None:
+            originals = self._fix_buffer_pre(replay_buffer)
+            state = dict(state)
+            state["rb"] = replay_buffer.state_dict() if hasattr(replay_buffer, "state_dict") else replay_buffer
+        if runtime is None or runtime.is_global_zero:
+            save_state(ckpt_path, state)
+            self._gc(os.path.dirname(ckpt_path))
+        if replay_buffer is not None:
+            self._fix_buffer_post(replay_buffer, originals)
+
+    # decoupled variants keep the same surface as the reference callback
+    def on_checkpoint_player(self, runtime, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, **_: Any):
+        self.on_checkpoint_coupled(runtime, ckpt_path, state, replay_buffer)
+
+    def on_checkpoint_trainer(self, runtime, player, ckpt_path: str, state: Dict[str, Any], **_: Any):
+        self.on_checkpoint_coupled(runtime, ckpt_path, state)
+
+    def _gc(self, ckpt_dir: str) -> None:
+        if not self.keep_last:
+            return
+        try:
+            ckpts = sorted(
+                (f for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")),
+                key=lambda f: os.path.getmtime(os.path.join(ckpt_dir, f)),
+            )
+        except FileNotFoundError:
+            return
+        for f in ckpts[: -self.keep_last]:
+            try:
+                os.remove(os.path.join(ckpt_dir, f))
+            except OSError:
+                pass

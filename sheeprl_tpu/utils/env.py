@@ -1,0 +1,220 @@
+"""Environment factory: normalize every env to a Dict observation space.
+
+Parity with reference sheeprl/utils/env.py:26-249 (make_env / get_dummy_env), adapted
+to the gymnasium 1.x API. Vectorization uses ``SyncVectorEnv`` / ``AsyncVectorEnv``
+with SAME_STEP autoreset so algorithms observe ``final_obs`` / ``final_info`` in the
+step where an episode ends (the 0.29-era semantics the reference was written against).
+Env stepping is host-CPU work by design; the device only ever sees batched arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    DictObservationWrapper,
+    FrameStack,
+    GrayscaleRenderWrapper,
+    ImageTransformWrapper,
+    MaskVelocityWrapper,
+    RenderObservationWrapper,
+    RewardAsObservationWrapper,
+)
+
+
+def make_env(
+    cfg: Dict[str, Any],
+    seed: int,
+    rank: int,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Build a thunk creating one fully-wrapped env instance."""
+
+    def thunk() -> gym.Env:
+        from sheeprl_tpu.config import instantiate
+
+        wrapper_spec = dict(cfg.env.wrapper)
+        if "seed" in wrapper_spec:
+            wrapper_spec["seed"] = seed
+        if "rank" in wrapper_spec:
+            wrapper_spec["rank"] = rank + vector_env_idx
+        env = instantiate(wrapper_spec)
+
+        try:
+            env_spec = str(gym.spec(cfg.env.id).entry_point)
+        except Exception:
+            env_spec = ""
+
+        if cfg.env.action_repeat > 1 and "atari" not in env_spec:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        cnn_encoder_keys = cfg.algo.cnn_keys.encoder
+        mlp_encoder_keys = cfg.algo.mlp_keys.encoder
+        if not (
+            isinstance(mlp_encoder_keys, list)
+            and isinstance(cnn_encoder_keys, list)
+            and len(cnn_encoder_keys + mlp_encoder_keys) > 0
+        ):
+            raise ValueError(
+                "`algo.cnn_keys.encoder` and `algo.mlp_keys.encoder` must be non-empty lists of strings, got: "
+                f"cnn encoder keys `{cnn_encoder_keys}` and mlp encoder keys `{mlp_encoder_keys}`."
+            )
+
+        # Normalize the observation space to a Dict.
+        obs_space = env.observation_space
+        if isinstance(obs_space, gym.spaces.Box) and len(obs_space.shape) < 2:
+            # Vector-only observation.
+            if len(cnn_encoder_keys) > 0:
+                if len(cnn_encoder_keys) > 1:
+                    warnings.warn(
+                        f"Multiple cnn keys specified but only one pixel observation is available in {cfg.env.id}; "
+                        f"keeping {cnn_encoder_keys[0]}"
+                    )
+                env = RenderObservationWrapper(
+                    env,
+                    pixel_key=cnn_encoder_keys[0],
+                    state_key=mlp_encoder_keys[0] if len(mlp_encoder_keys) > 0 else None,
+                    pixels_only=len(mlp_encoder_keys) == 0,
+                )
+            else:
+                if len(mlp_encoder_keys) > 1:
+                    warnings.warn(
+                        f"Multiple mlp keys specified but only one vector observation is available in {cfg.env.id}; "
+                        f"keeping {mlp_encoder_keys[0]}"
+                    )
+                env = DictObservationWrapper(env, mlp_encoder_keys[0])
+        elif isinstance(obs_space, gym.spaces.Box) and 2 <= len(obs_space.shape) <= 3:
+            # Pixel-only observation.
+            if len(cnn_encoder_keys) > 1:
+                warnings.warn(
+                    f"Multiple cnn keys specified but only one pixel observation is available in {cfg.env.id}; "
+                    f"keeping {cnn_encoder_keys[0]}"
+                )
+            elif len(cnn_encoder_keys) == 0:
+                raise ValueError(
+                    "You have selected a pixel observation but no cnn key has been specified. "
+                    "Please set at least one cnn key in the config file: `algo.cnn_keys.encoder=[your_cnn_key]`"
+                )
+            env = DictObservationWrapper(env, cnn_encoder_keys[0])
+
+        if len(set(env.observation_space.keys()) & set(mlp_encoder_keys + cnn_encoder_keys)) == 0:
+            raise ValueError(
+                f"The user specified keys `{mlp_encoder_keys + cnn_encoder_keys}` are not a subset of the "
+                f"environment `{list(env.observation_space.keys())}` observation keys. Please check your config file."
+            )
+
+        env_cnn_keys = {k for k in env.observation_space.spaces.keys() if len(env.observation_space[k].shape) in (2, 3)}
+        cnn_keys = sorted(env_cnn_keys & set(cnn_encoder_keys))
+
+        if cnn_keys:
+            env = ImageTransformWrapper(env, cnn_keys, cfg.env.screen_size, cfg.env.grayscale)
+            if cfg.env.frame_stack > 1:
+                if cfg.env.frame_stack_dilation <= 0:
+                    raise ValueError(
+                        f"The frame stack dilation argument must be greater than zero, got: {cfg.env.frame_stack_dilation}"
+                    )
+                env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        if cfg.env.actions_as_observation.num_stack > 0:
+            env = ActionsAsObservationWrapper(env, **cfg.env.actions_as_observation)
+
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+
+        env.action_space.seed(seed)
+        env.observation_space.seed(seed)
+        if cfg.env.max_episode_steps and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, max_episode_steps=cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
+            if cfg.env.grayscale:
+                env = GrayscaleRenderWrapper(env)
+            try:
+                env = gym.wrappers.RecordVideo(
+                    env,
+                    os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
+                    disable_logger=True,
+                )
+            except Exception as e:  # pragma: no cover - video deps are optional
+                warnings.warn(f"Could not enable video capture: {e}")
+        return env
+
+    return thunk
+
+
+def vectorized_env(env_fns: List[Callable[[], gym.Env]], sync: bool = True):
+    """SAME_STEP autoreset vector env (matches the reference's rollout semantics)."""
+    from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
+
+    if sync or len(env_fns) == 1:
+        return SyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
+    return AsyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
+
+
+def get_dummy_env(id: str, **kwargs):
+    if "continuous" in id:
+        from sheeprl_tpu.envs.dummy import ContinuousDummyEnv
+
+        return ContinuousDummyEnv(**kwargs)
+    elif "multidiscrete" in id:
+        from sheeprl_tpu.envs.dummy import MultiDiscreteDummyEnv
+
+        return MultiDiscreteDummyEnv(**kwargs)
+    elif "discrete" in id:
+        from sheeprl_tpu.envs.dummy import DiscreteDummyEnv
+
+        return DiscreteDummyEnv(**kwargs)
+    raise ValueError(f"Unrecognized dummy environment: {id}")
+
+
+def finished_episodes(info: Dict[str, Any]) -> List[Tuple[float, int]]:
+    """Extract (cumulative_reward, length) for every episode finished this step.
+
+    Handles the gymnasium 1.x vector-env ``final_info`` dict-of-arrays layout (the
+    reference read the 0.29 list-of-dicts layout, ppo.py:332-341).
+    """
+    out: List[Tuple[float, int]] = []
+    final_info = info.get("final_info")
+    if final_info is None:
+        # non-vector env: RecordEpisodeStatistics puts `episode` directly in info
+        ep = info.get("episode")
+        if ep is not None:
+            out.append((float(np.asarray(ep["r"]).reshape(-1)[0]), int(np.asarray(ep["l"]).reshape(-1)[0])))
+        return out
+    if isinstance(final_info, dict):
+        ep = final_info.get("episode")
+        if ep is not None:
+            mask = np.asarray(ep.get("_r", np.ones_like(ep["r"], dtype=bool)))
+            rs = np.asarray(ep["r"]).reshape(-1)
+            ls = np.asarray(ep["l"]).reshape(-1)
+            for i in np.nonzero(np.asarray(mask).reshape(-1))[0]:
+                out.append((float(rs[i]), int(ls[i])))
+    else:  # pragma: no cover - 0.29-style list of dicts
+        for fi in final_info:
+            if fi is not None and "episode" in fi:
+                out.append((float(fi["episode"]["r"]), int(fi["episode"]["l"])))
+    return out
+
+
+def final_observations(info: Dict[str, Any], obs_keys: List[str]) -> Optional[Dict[int, Dict[str, np.ndarray]]]:
+    """Map env-index -> final obs dict for envs that finished this step (for bootstrap)."""
+    fobs = info.get("final_obs")
+    if fobs is None:
+        return None
+    out: Dict[int, Dict[str, np.ndarray]] = {}
+    for i, o in enumerate(np.asarray(fobs, dtype=object)):
+        if o is not None and isinstance(o, dict):
+            out[i] = {k: np.asarray(o[k]) for k in obs_keys if k in o}
+    return out

@@ -1,0 +1,105 @@
+"""Logger backends + versioned run directories.
+
+Reference: sheeprl/utils/logger.py:12-89 (rank-0-only creation, versioned
+``logs/runs/<root>/<run>/version_N`` dirs shared via collective broadcast). On JAX
+single-controller there is one driving process, so the directory is computed locally;
+under multi-controller it is broadcast via ``multihost_utils``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class TensorBoardLogger:
+    def __init__(self, root_dir: str, name: str = ""):
+        from tensorboardX import SummaryWriter
+
+        self.log_dir = os.path.join(root_dir, name) if name else root_dir
+        os.makedirs(self.log_dir, exist_ok=True)
+        self._writer = SummaryWriter(logdir=self.log_dir)
+
+    @property
+    def name(self) -> str:
+        return "tensorboard"
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        for key, value in metrics.items():
+            try:
+                self._writer.add_scalar(key, float(value), global_step=step)
+            except (TypeError, ValueError):
+                pass
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        try:
+            self._writer.add_text("hparams", str(params))
+        except Exception:
+            pass
+
+    def add_video(self, tag: str, video, step: Optional[int] = None, fps: int = 30) -> None:
+        self._writer.add_video(tag, video, global_step=step, fps=fps)
+
+    def finalize(self) -> None:
+        self._writer.close()
+
+    def close(self) -> None:
+        self.finalize()
+
+
+class NullLogger:
+    log_dir = None
+    name = "null"
+
+    def log_metrics(self, metrics, step=None):
+        pass
+
+    def log_hyperparams(self, params):
+        pass
+
+    def finalize(self):
+        pass
+
+    close = finalize
+
+
+def _next_version(base: str) -> int:
+    if not os.path.isdir(base):
+        return 0
+    versions = []
+    for d in os.listdir(base):
+        if d.startswith("version_"):
+            try:
+                versions.append(int(d.split("_", 1)[1]))
+            except ValueError:
+                pass
+    return max(versions) + 1 if versions else 0
+
+
+def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> str:
+    """Versioned run dir: logs/runs/<root_dir>/<run_name>/version_N."""
+    base = os.path.join("logs", "runs", root_dir, run_name)
+    if runtime is None or runtime.is_global_zero:
+        log_dir = os.path.join(base, f"version_{_next_version(base)}")
+        os.makedirs(log_dir, exist_ok=True)
+    else:  # pragma: no cover - multihost only
+        log_dir = None
+    if share and jax.process_count() > 1:  # pragma: no cover - multihost only
+        from jax.experimental import multihost_utils
+
+        log_dir = multihost_utils.broadcast_one_to_all(log_dir)
+    return log_dir
+
+
+def get_logger(runtime, cfg) -> Optional[Any]:
+    """Rank-0 logger instantiation from cfg.metric.logger (``_target_`` style)."""
+    if runtime is not None and not runtime.is_global_zero:
+        return NullLogger()
+    if cfg.metric.log_level == 0 or not getattr(cfg.metric, "logger", None):
+        return NullLogger()
+    from sheeprl_tpu.config import instantiate
+
+    spec = dict(cfg.metric.logger)
+    return instantiate(spec)

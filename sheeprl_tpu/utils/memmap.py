@@ -1,0 +1,205 @@
+"""Memory-mapped array container for out-of-core replay buffers.
+
+API parity with reference sheeprl/utils/memmap.py:22-258 (MemmapArray: ndarray
+protocol, file ownership transfer, pickling that drops ownership). Host-side only —
+device transfer happens when buffers sample into jax.Arrays.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import warnings
+from pathlib import Path
+from sys import getrefcount
+from typing import Any, Optional, Tuple, Union
+
+import numpy as np
+
+_VALID_MODES = ("r+", "w+", "c", "copyonwrite", "readwrite", "write")
+
+
+def is_shared(array: np.ndarray) -> bool:
+    """True when the ndarray is backed by an OS-level memory map."""
+    return isinstance(array, np.ndarray) and hasattr(array, "_mmap")
+
+
+class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
+    """An np.memmap wrapper with explicit file-ownership semantics.
+
+    Ownership rules (matching the reference):
+    - a fresh instance owns its file and deletes temporary files on __del__;
+    - assigning an already-memmapped array (or building via :meth:`from_array` from
+      one pointing at the same file) *transfers nothing*: this instance loses
+      ownership, the source keeps it;
+    - pickling never transfers ownership (the unpickled copy has no ownership).
+    """
+
+    def __init__(
+        self,
+        shape: Union[int, Tuple[int, ...], None],
+        dtype=None,
+        mode: str = "r+",
+        reset: bool = False,
+        filename: Union[str, os.PathLike, None] = None,
+    ):
+        self._is_temp = filename is None
+        if filename is None:
+            fd, path = tempfile.mkstemp(".memmap")
+            os.close(fd)
+            self._filename = Path(path).resolve()
+        else:
+            path = Path(filename).resolve()
+            if path.exists():
+                warnings.warn(
+                    "The specified filename already exists. "
+                    "Please be aware that any modification will be possibly reflected.",
+                    category=UserWarning,
+                )
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.touch(exist_ok=True)
+            self._filename = path
+        self._dtype = dtype
+        self._shape = shape
+        self._mode = mode
+        self._array: Optional[np.memmap] = np.memmap(self._filename, dtype=dtype, shape=shape, mode=mode)
+        if reset:
+            self._array[:] = 0
+        self._has_ownership = True
+        self._array_dir = self._array.__dir__()
+        self.__array_interface__ = self._array.__array_interface__
+
+    # ----- properties ----------------------------------------------------------------
+    @property
+    def filename(self) -> Path:
+        return self._filename
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def has_ownership(self) -> bool:
+        return self._has_ownership
+
+    @has_ownership.setter
+    def has_ownership(self, value: bool):
+        self._has_ownership = bool(value)
+
+    @property
+    def array(self) -> np.memmap:
+        if not os.path.isfile(self._filename):
+            self._array = None
+        if self._array is None:
+            self._array = np.memmap(self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode)
+        return self._array
+
+    @array.setter
+    def array(self, v: Union[np.memmap, np.ndarray]):
+        if not isinstance(v, (np.memmap, np.ndarray)):
+            raise ValueError(f"The value to be set must be an instance of 'np.memmap' or 'np.ndarray', got '{type(v)}'")
+        if is_shared(v):
+            # Point at the other array's file, dropping ownership of ours.
+            self._release()
+            self._filename = Path(v.filename).resolve()
+            self._is_temp = True  # removal responsibility belongs to the source owner
+            self._shape = v.shape
+            self._dtype = v.dtype
+            self._has_ownership = False
+            self.__array_interface__ = v.__array_interface__
+            self._array = np.memmap(self._filename, dtype=self._dtype, shape=self._shape, mode=self._mode)
+        else:
+            if self.array.size != v.size:
+                raise ValueError(
+                    "The shape of the value to be set must be the same as the shape of the memory-mapped array. "
+                    f"Got {v.shape} and {self._shape}"
+                )
+            self._array[:] = np.reshape(v, self._shape)
+            self._array.flush()
+
+    # ----- construction --------------------------------------------------------------
+    @classmethod
+    def from_array(
+        cls,
+        array: Union[np.ndarray, np.memmap, "MemmapArray"],
+        mode: str = "r+",
+        filename: Union[str, os.PathLike, None] = None,
+    ) -> "MemmapArray":
+        filename = Path(filename).resolve() if filename is not None else None
+        is_wrapper = isinstance(array, MemmapArray)
+        if not isinstance(array, (np.ndarray, MemmapArray)):
+            raise ValueError(f"Cannot build a MemmapArray from {type(array)}")
+        out = cls(filename=filename, dtype=array.dtype, shape=array.shape, mode=mode, reset=False)
+        if is_wrapper or is_shared(array):
+            raw = array.array if is_wrapper else array
+            if filename is not None and filename == Path(raw.filename).resolve():
+                out.array = raw  # same file: reference it without taking ownership
+            else:
+                out.array[:] = raw[:]
+        else:
+            out.array = array
+        return out
+
+    # ----- lifecycle -----------------------------------------------------------------
+    def _release(self) -> None:
+        if self._array is not None and self._has_ownership and getrefcount(self._array) <= 3:
+            try:
+                self._array.flush()
+                self._array._mmap.close()
+            except (AttributeError, ValueError):
+                pass
+            self._array = None
+            if self._is_temp and os.path.isfile(self._filename):
+                try:
+                    os.unlink(self._filename)
+                except OSError:
+                    pass
+
+    def __del__(self) -> None:
+        self._release()
+
+    # ----- ndarray protocol ----------------------------------------------------------
+    def __array__(self, dtype=None, copy=None) -> np.ndarray:
+        arr = self.array
+        if dtype is not None:
+            arr = arr.astype(dtype, copy=bool(copy))
+        elif copy:
+            arr = arr.copy()
+        return arr
+
+    def __getattr__(self, attr: str) -> Any:
+        if attr in self.__dir__():
+            return self.__getattribute__(attr)
+        if "_array_dir" not in self.__dir__() or attr not in self.__getattribute__("_array_dir"):
+            raise AttributeError(f"'MemmapArray' object has no attribute '{attr}'")
+        return getattr(self.__getattribute__("array"), attr)
+
+    def __getitem__(self, idx: Any) -> np.ndarray:
+        return self.array[idx]
+
+    def __setitem__(self, idx: Any, value: Any) -> None:
+        self.array[idx] = value
+
+    def __len__(self) -> int:
+        return len(self.array)
+
+    def __repr__(self) -> str:
+        return f"MemmapArray(shape={self._shape}, dtype={self._dtype}, mode={self._mode}, filename={self._filename})"
+
+    # ----- pickling ------------------------------------------------------------------
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_array"] = None
+        state["_has_ownership"] = False
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)

@@ -1,0 +1,180 @@
+"""Metric aggregation (torchmetrics-free).
+
+Reference: sheeprl/utils/metric.py:17-195 (MetricAggregator + RankIndependent variant).
+Metrics here are small host-side accumulators fed with Python floats / numpy / jax
+scalars; device->host transfer happens once per log interval, not per step.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Any, Dict, Iterable, Mapping, Optional, Sequence
+
+import numpy as np
+
+import jax
+
+
+def _to_float(value) -> float:
+    if isinstance(value, (int, float)):
+        return float(value)
+    arr = np.asarray(value)
+    return float(arr.mean()) if arr.size > 1 else float(arr)
+
+
+class Metric:
+    """Base accumulator. Subclasses implement update/compute/reset."""
+
+    def update(self, value) -> None:
+        raise NotImplementedError
+
+    def compute(self) -> float:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+
+class MeanMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **_: Any):
+        self._sum = 0.0
+        self._count = 0
+
+    def update(self, value) -> None:
+        self._sum += _to_float(value)
+        self._count += 1
+
+    def compute(self) -> float:
+        return self._sum / self._count if self._count else math.nan
+
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._count = 0
+
+
+class SumMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **_: Any):
+        self._sum = 0.0
+        self._updated = False
+
+    def update(self, value) -> None:
+        self._sum += _to_float(value)
+        self._updated = True
+
+    def compute(self) -> float:
+        return self._sum if self._updated else math.nan
+
+    def reset(self) -> None:
+        self._sum = 0.0
+        self._updated = False
+
+
+class MaxMetric(Metric):
+    def __init__(self, sync_on_compute: bool = False, **_: Any):
+        self._max = -math.inf
+        self._updated = False
+
+    def update(self, value) -> None:
+        self._max = max(self._max, _to_float(value))
+        self._updated = True
+
+    def compute(self) -> float:
+        return self._max if self._updated else math.nan
+
+    def reset(self) -> None:
+        self._max = -math.inf
+        self._updated = False
+
+
+class LastMetric(Metric):
+    def __init__(self, **_: Any):
+        self._last = math.nan
+
+    def update(self, value) -> None:
+        self._last = _to_float(value)
+
+    def compute(self) -> float:
+        return self._last
+
+    def reset(self) -> None:
+        self._last = math.nan
+
+
+class MetricAggregator:
+    """Dict of metrics with a class-level kill switch.
+
+    Reference: sheeprl/utils/metric.py:17-143. ``compute`` drops NaN results (metrics
+    never updated this window), like the reference's NaN-dropping compute.
+    """
+
+    disabled: bool = False
+
+    def __init__(self, metrics: Optional[Mapping[str, Any]] = None, raise_on_missing: bool = False):
+        self.metrics: Dict[str, Metric] = {}
+        self._raise_on_missing = raise_on_missing
+        for key, value in (metrics or {}).items():
+            self.add(key, value)
+
+    def add(self, name: str, metric) -> None:
+        if self.disabled:
+            return
+        if isinstance(metric, Mapping) and "_target_" in metric:
+            from sheeprl_tpu.config import instantiate
+
+            metric = instantiate(metric)
+        if name in self.metrics:
+            raise ValueError(f"Metric {name} already exists")
+        self.metrics[name] = metric
+
+    def update(self, name: str, value) -> None:
+        if self.disabled:
+            return
+        if name not in self.metrics:
+            if self._raise_on_missing:
+                raise KeyError(f"Metric {name} not registered")
+            return
+        self.metrics[name].update(value)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.metrics
+
+    def pop(self, name: str) -> None:
+        self.metrics.pop(name, None)
+
+    def reset(self) -> None:
+        for m in self.metrics.values():
+            m.reset()
+
+    def compute(self) -> Dict[str, float]:
+        if self.disabled:
+            return {}
+        out: Dict[str, float] = {}
+        for name, m in self.metrics.items():
+            value = m.compute()
+            if value is None or (isinstance(value, float) and math.isnan(value)):
+                continue
+            out[name] = value
+        return out
+
+    def to(self, device=None) -> "MetricAggregator":  # API-parity no-op (host metrics)
+        return self
+
+
+class RankIndependentMetricAggregator(MetricAggregator):
+    """Per-process metrics gathered across hosts at compute time.
+
+    Reference: sheeprl/utils/metric.py:146-195. On single-controller JAX there is one
+    host process per pod slice, so gathering is only needed under multi-controller runs.
+    """
+
+    def compute(self) -> Dict[str, float]:
+        local = super().compute()
+        if jax.process_count() > 1:  # pragma: no cover - multihost only
+            from jax.experimental import multihost_utils
+
+            keys = sorted(local.keys())
+            vals = np.asarray([local[k] for k in keys], dtype=np.float32)
+            gathered = multihost_utils.process_allgather(vals)
+            return {k: float(np.nanmean(gathered[:, i])) for i, k in enumerate(keys)}
+        return local

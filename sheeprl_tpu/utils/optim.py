@@ -1,0 +1,113 @@
+"""Optimizer builders: config-instantiable optax transforms.
+
+Replaces the reference's ``_target_: torch.optim.*`` configs (sheeprl/configs/optim/*)
+with optax chains. Each builder returns an ``optax.GradientTransformation``; algorithms
+wrap it with clipping (``algo.max_grad_norm``) where the reference used
+``fabric.clip_gradients``.
+
+``rmsprop_tf`` reproduces the TF-semantics RMSProp of the reference
+(sheeprl/optim/rmsprop_tf.py:14-156): eps inside the sqrt and a ones-initialized
+accumulator — used by Dreamer-V1/V2 configs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def adam(
+    lr: float = 2e-4,
+    eps: float = 1e-4,
+    weight_decay: float = 0.0,
+    betas: Sequence[float] = (0.9, 0.999),
+    **_: Any,
+) -> optax.GradientTransformation:
+    b1, b2 = betas
+    if weight_decay and weight_decay > 0:
+        return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+    return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+
+
+def adamw(
+    lr: float = 2e-4,
+    eps: float = 1e-4,
+    weight_decay: float = 0.01,
+    betas: Sequence[float] = (0.9, 0.999),
+    **_: Any,
+) -> optax.GradientTransformation:
+    b1, b2 = betas
+    return optax.adamw(lr, b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+
+
+def sgd(lr: float = 1e-3, momentum: float = 0.0, nesterov: bool = False, **_: Any) -> optax.GradientTransformation:
+    return optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+
+
+def rmsprop(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    return optax.rmsprop(lr, decay=alpha, eps=eps, momentum=momentum or None, centered=centered)
+
+
+class RMSpropTFState(NamedTuple):
+    square_avg: Any
+    momentum_buf: Any
+    grad_avg: Any
+
+
+def rmsprop_tf(
+    lr: float = 1e-2,
+    alpha: float = 0.99,
+    eps: float = 1e-10,
+    momentum: float = 0.0,
+    centered: bool = False,
+    **_: Any,
+) -> optax.GradientTransformation:
+    """TF-semantics RMSProp: accumulator initialized to ones, eps added *inside* sqrt.
+
+    ``centered=True`` subtracts the EMA of gradients from the second-moment estimate
+    before the sqrt (reference: sheeprl/optim/rmsprop_tf.py:120-136).
+    """
+
+    def init(params):
+        return RMSpropTFState(
+            square_avg=jax.tree_util.tree_map(jnp.ones_like, params),
+            momentum_buf=jax.tree_util.tree_map(jnp.zeros_like, params),
+            grad_avg=jax.tree_util.tree_map(jnp.zeros_like, params),
+        )
+
+    def update(grads, state, params=None):
+        del params
+        sq = jax.tree_util.tree_map(lambda s, g: alpha * s + (1 - alpha) * g * g, state.square_avg, grads)
+        if centered:
+            gavg = jax.tree_util.tree_map(lambda a, g: alpha * a + (1 - alpha) * g, state.grad_avg, grads)
+            denom = jax.tree_util.tree_map(lambda s, a: jnp.sqrt(s - a * a + eps), sq, gavg)
+        else:
+            gavg = state.grad_avg
+            denom = jax.tree_util.tree_map(lambda s: jnp.sqrt(s + eps), sq)
+        step = jax.tree_util.tree_map(lambda g, d: g / d, grads, denom)
+        if momentum > 0:
+            buf = jax.tree_util.tree_map(lambda b, s: momentum * b + s, state.momentum_buf, step)
+            step = buf
+        else:
+            buf = state.momentum_buf
+        updates = jax.tree_util.tree_map(lambda s: -lr * s, step)
+        return updates, RMSpropTFState(square_avg=sq, momentum_buf=buf, grad_avg=gavg)
+
+    return optax.GradientTransformation(init, update)
+
+
+def with_clipping(tx: optax.GradientTransformation, max_grad_norm: Optional[float]) -> optax.GradientTransformation:
+    """Global-norm clipping before the optimizer (fabric.clip_gradients equivalent)."""
+    if max_grad_norm and max_grad_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(max_grad_norm), tx)
+    return tx

@@ -1,0 +1,89 @@
+"""Decorator-based algorithm/evaluation registry.
+
+Parity with reference sheeprl/utils/registry.py:11-112 — same dict shapes
+(``{module: [{"name", "entrypoint", "decoupled"}]}``) so the CLI dispatch logic and the
+``available_agents`` table have identical semantics.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Dict, List, Union
+
+algorithm_registry: Dict[str, List[Dict[str, Any]]] = {}
+evaluation_registry: Dict[str, List[Dict[str, Any]]] = {}
+
+
+def _register_algorithm(fn: Callable[..., Any], decoupled: bool = False) -> Callable[..., Any]:
+    if fn.__module__ == "__main__":
+        return fn
+    entrypoint = fn.__name__
+    module_split = fn.__module__.split(".")
+    algorithm = module_split[-1]
+    module = ".".join(module_split[:-1])
+    algorithm_registry.setdefault(module, []).append(
+        {"name": algorithm, "entrypoint": entrypoint, "decoupled": decoupled}
+    )
+    mod = sys.modules[fn.__module__]
+    if hasattr(mod, "__all__"):
+        mod.__all__.append(entrypoint)
+    else:
+        mod.__all__ = [entrypoint]
+    return fn
+
+
+def _register_evaluation(fn: Callable[..., Any], algorithms: Union[str, List[str]]) -> Callable[..., Any]:
+    if fn.__module__ == "__main__":
+        return fn
+    entrypoint = fn.__name__
+    module_split = fn.__module__.split(".")
+    module = ".".join(module_split[:-1])
+    evaluation_file = module_split[-1]
+    if isinstance(algorithms, str):
+        algorithms = [algorithms]
+    registered_algos = algorithm_registry.get(module, None)
+    if registered_algos is None:
+        raise ValueError(
+            f"The evaluation function `{module + '.' + entrypoint}` for the algorithms named "
+            f"`{', '.join(algorithms)}` is going to be registered, but no algorithm has been registered!"
+        )
+    registered_algo_names = {algo["name"] for algo in registered_algos}
+    if len(set(algorithms) - registered_algo_names) > 0:
+        raise ValueError(
+            f"You are trying to register the evaluation function "
+            f"`{module + '.' + evaluation_file + '.' + entrypoint}` "
+            f"for algorithms which have not been registered for the module `{module}`!\n"
+            f"Registered algorithms: {', '.join(registered_algo_names)}\n"
+            f"Specified algorithms: {', '.join(algorithms)}"
+        )
+    registered_evals = evaluation_registry.setdefault(module, [])
+    for registered_eval in registered_evals:
+        if registered_eval["name"] in algorithms:
+            raise ValueError(
+                f"Cannot register the evaluate function `{module + '.' + evaluation_file + '.' + entrypoint}` "
+                f"for the algorithm `{registered_eval['name']}`: an evaluation function has already "
+                f"been registered for it in the module `{module}`!"
+            )
+    registered_evals.extend(
+        [{"name": algorithm, "evaluation_file": evaluation_file, "entrypoint": entrypoint} for algorithm in algorithms]
+    )
+    mod = sys.modules[fn.__module__]
+    if hasattr(mod, "__all__"):
+        mod.__all__.append(entrypoint)
+    else:
+        mod.__all__ = [entrypoint]
+    return fn
+
+
+def register_algorithm(decoupled: bool = False):
+    def inner_decorator(fn):
+        return _register_algorithm(fn, decoupled=decoupled)
+
+    return inner_decorator
+
+
+def register_evaluation(algorithms: Union[str, List[str]]):
+    def inner_decorator(fn):
+        return _register_evaluation(fn, algorithms=algorithms)
+
+    return inner_decorator

@@ -1,0 +1,46 @@
+"""Wall-clock timing as a context-decorator with a class-level registry.
+
+Reference: sheeprl/utils/timer.py:16-83. Used around env interaction and train blocks;
+steps-per-second is derived at log time from the accumulated sums.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, ClassVar, Dict, Optional, Type
+
+from sheeprl_tpu.utils.metric import Metric, SumMetric
+
+
+class timer(ContextDecorator):
+    disabled: ClassVar[bool] = False
+    timers: ClassVar[Dict[str, Metric]] = {}
+
+    def __init__(self, name: str, metric: Optional[Metric] = None):
+        self.name = name
+        self.metric = metric
+
+    def __enter__(self):
+        if not timer.disabled:
+            if self.name not in timer.timers:
+                timer.timers[self.name] = self.metric if self.metric is not None else SumMetric()
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not timer.disabled:
+            timer.timers[self.name].update(time.perf_counter() - self._start)
+        return False
+
+    @classmethod
+    def to(cls, device=None):  # API parity: metrics are host-side
+        return cls
+
+    @classmethod
+    def reset(cls) -> None:
+        cls.timers = {}
+
+    @classmethod
+    def compute(cls) -> Dict[str, float]:
+        return {name: m.compute() for name, m in cls.timers.items()}

@@ -1,0 +1,44 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's CPU-Gloo multi-process tests (tests/test_algos/test_algos.py
+`devices` fixture + LT_DEVICES): here multi-device paths run on one host via
+``--xla_force_host_platform_device_count=8``.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_metric_state():
+    from sheeprl_tpu.utils.metric import MetricAggregator
+    from sheeprl_tpu.utils.timer import timer
+
+    yield
+    MetricAggregator.disabled = False
+    timer.disabled = False
+    timer.reset()
+
+
+@pytest.fixture()
+def standard_args():
+    return [
+        "exp=dummy",
+        "dry_run=True",
+        "env=dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.devices=1",
+        "metric.log_level=0",
+        "checkpoint.save_last=False",
+    ]

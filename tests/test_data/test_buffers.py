@@ -1,0 +1,139 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+
+
+def _data(t, n, key="observations", extra=()):
+    d = {key: np.arange(t * n).reshape(t, n, 1).astype(np.float32)}
+    for k in extra:
+        d[k] = np.zeros((t, n, 1), dtype=np.float32)
+    return d
+
+
+def test_init_validation():
+    with pytest.raises(ValueError):
+        ReplayBuffer(0)
+    with pytest.raises(ValueError):
+        ReplayBuffer(4, 0)
+
+
+def test_add_and_wraparound():
+    rb = ReplayBuffer(buffer_size=4, n_envs=2)
+    rb.add(_data(3, 2))
+    assert not rb.full
+    rb.add(_data(3, 2))
+    assert rb.full
+    assert rb["observations"].shape == (4, 2, 1)
+    # pos should have wrapped to 2
+    assert rb._pos == 2
+
+
+def test_add_longer_than_buffer():
+    rb = ReplayBuffer(buffer_size=4, n_envs=1)
+    data = _data(10, 1)
+    rb.add(data)
+    assert rb.full
+    # keeps the most recent rows
+    assert float(rb["observations"].max()) == 9.0
+
+
+def test_add_validate_args():
+    rb = ReplayBuffer(4, 1)
+    with pytest.raises(ValueError):
+        rb.add([1, 2, 3], validate_args=True)
+    with pytest.raises(ValueError):
+        rb.add({"a": [1]}, validate_args=True)
+    with pytest.raises(RuntimeError):
+        rb.add({"a": np.zeros((4,))}, validate_args=True)
+    with pytest.raises(RuntimeError):
+        rb.add({"a": np.zeros((4, 1, 1)), "b": np.zeros((3, 1, 1))}, validate_args=True)
+
+
+def test_sample_shape():
+    rb = ReplayBuffer(8, 2)
+    rb.add(_data(8, 2))
+    s = rb.sample(5, n_samples=3)
+    assert s["observations"].shape == (3, 5, 1)
+
+
+def test_sample_errors():
+    rb = ReplayBuffer(8, 1)
+    with pytest.raises(ValueError):
+        rb.sample(0)
+    with pytest.raises(ValueError):
+        rb.sample(1)  # empty
+    rb.add(_data(1, 1))
+    with pytest.raises(RuntimeError):
+        rb.sample(1, sample_next_obs=True)  # needs at least 2
+
+
+def test_sample_next_obs():
+    rb = ReplayBuffer(8, 1)
+    rb.add(_data(6, 1))
+    s = rb.sample(16, sample_next_obs=True)
+    assert "next_observations" in s
+    np.testing.assert_allclose(s["next_observations"], s["observations"] + 1)
+
+
+def test_sample_next_obs_wraparound_validity():
+    rb = ReplayBuffer(4, 1)
+    rb.add(_data(6, 1))  # pos=2, full
+    s = rb.sample(64, sample_next_obs=True)
+    # the transition crossing the write head (pos-1 -> pos) must never be sampled
+    assert not np.any(s["observations"] == 1.0) or np.all(
+        s["next_observations"][s["observations"] == 1.0] == 2.0
+    )
+
+
+def test_memmap_buffer(tmp_path):
+    rb = ReplayBuffer(8, 2, memmap=True, memmap_dir=tmp_path / "rb")
+    rb.add(_data(4, 2))
+    assert rb.is_memmap
+    s = rb.sample(3)
+    assert s["observations"].shape == (1, 3, 1)
+    assert (tmp_path / "rb" / "observations.memmap").exists()
+
+
+def test_memmap_requires_dir():
+    with pytest.raises(ValueError):
+        ReplayBuffer(8, 1, memmap=True, memmap_dir=None)
+
+
+def test_memmap_invalid_mode(tmp_path):
+    with pytest.raises(ValueError):
+        ReplayBuffer(8, 1, memmap=True, memmap_dir=tmp_path, memmap_mode="r")
+
+
+def test_getitem_setitem():
+    rb = ReplayBuffer(4, 2)
+    with pytest.raises(RuntimeError):
+        rb["observations"]
+    rb.add(_data(2, 2))
+    with pytest.raises(TypeError):
+        rb[0]
+    rb["new_key"] = np.ones((4, 2, 3), dtype=np.float32)
+    assert rb["new_key"].shape == (4, 2, 3)
+    with pytest.raises(RuntimeError):
+        rb["bad"] = np.ones((2, 2))
+    with pytest.raises(ValueError):
+        rb["bad"] = "not an array"
+
+
+def test_sample_arrays_device():
+    import jax
+
+    rb = ReplayBuffer(8, 1)
+    rb.add(_data(8, 1))
+    out = rb.sample_arrays(4, device=jax.devices()[0])
+    assert isinstance(out["observations"], jax.Array)
+
+
+def test_state_dict_roundtrip():
+    rb = ReplayBuffer(8, 2)
+    rb.add(_data(5, 2))
+    state = rb.state_dict()
+    rb2 = ReplayBuffer(8, 2)
+    rb2.load_state_dict(state)
+    np.testing.assert_array_equal(np.asarray(rb2["observations"]), np.asarray(rb["observations"]))
+    assert rb2._pos == rb._pos and rb2.full == rb.full

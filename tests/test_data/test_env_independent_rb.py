@@ -1,0 +1,56 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+
+def _data(t, n):
+    return {"observations": np.arange(t * n).reshape(t, n, 1).astype(np.float32)}
+
+
+def test_add_all_envs():
+    rb = EnvIndependentReplayBuffer(8, n_envs=3)
+    rb.add(_data(4, 3))
+    assert all(not b.empty for b in rb.buffer)
+    assert rb.buffer[0].n_envs == 1
+
+
+def test_add_subset_indices():
+    rb = EnvIndependentReplayBuffer(8, n_envs=3)
+    rb.add(_data(4, 2), indices=[0, 2])
+    assert rb.buffer[1].empty
+    with pytest.raises(ValueError):
+        rb.add(_data(4, 2), indices=[0])
+
+
+def test_sample_concat():
+    rb = EnvIndependentReplayBuffer(8, n_envs=2)
+    rb.add(_data(8, 2))
+    s = rb.sample(6)
+    assert s["observations"].shape == (1, 6, 1)
+
+
+def test_sample_sequential_cls():
+    rb = EnvIndependentReplayBuffer(16, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    rb.add(_data(16, 2))
+    s = rb.sample(4, sequence_length=5)
+    assert s["observations"].shape == (1, 5, 4, 1)
+    diffs = np.diff(s["observations"][..., 0], axis=1)
+    assert np.all(diffs == 2)  # per-env streams are contiguous with stride n_envs
+
+
+def test_memmap_env_independent(tmp_path):
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, memmap=True, memmap_dir=tmp_path / "ei")
+    rb.add(_data(4, 2))
+    assert all(rb.is_memmap)
+
+
+def test_state_dict_roundtrip():
+    rb = EnvIndependentReplayBuffer(8, n_envs=2)
+    rb.add(_data(4, 2))
+    state = rb.state_dict()
+    rb2 = EnvIndependentReplayBuffer(8, n_envs=2)
+    rb2.load_state_dict(state)
+    np.testing.assert_array_equal(
+        np.asarray(rb2.buffer[0]["observations"]), np.asarray(rb.buffer[0]["observations"])
+    )

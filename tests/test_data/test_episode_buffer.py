@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EpisodeBuffer
+
+
+def _episode(length, n_envs=1, terminated_at_end=True):
+    d = {
+        "observations": np.arange(length * n_envs).reshape(length, n_envs, 1).astype(np.float32),
+        "terminated": np.zeros((length, n_envs, 1), dtype=np.float32),
+        "truncated": np.zeros((length, n_envs, 1), dtype=np.float32),
+    }
+    if terminated_at_end:
+        d["terminated"][-1] = 1
+    return d
+
+
+def test_init_validation():
+    with pytest.raises(ValueError):
+        EpisodeBuffer(0, 1)
+    with pytest.raises(ValueError):
+        EpisodeBuffer(8, 0)
+    with pytest.raises(ValueError):
+        EpisodeBuffer(4, 8)
+
+
+def test_add_complete_episode():
+    eb = EpisodeBuffer(32, minimum_episode_length=2)
+    eb.add(_episode(5))
+    assert len(eb) == 5
+    assert len(eb.buffer) == 1
+
+
+def test_open_episode_accumulates():
+    eb = EpisodeBuffer(32, 2)
+    eb.add(_episode(3, terminated_at_end=False))
+    assert len(eb) == 0  # still open
+    eb.add(_episode(3))
+    assert len(eb) == 6
+
+
+def test_too_short_episode_raises():
+    eb = EpisodeBuffer(32, 4)
+    with pytest.raises(RuntimeError):
+        eb.add(_episode(2))
+
+
+def test_eviction_of_oldest():
+    eb = EpisodeBuffer(10, 2)
+    eb.add(_episode(4))
+    eb.add(_episode(4))
+    eb.add(_episode(4))  # 12 > 10: first must be evicted
+    assert len(eb) <= 10
+    assert len(eb.buffer) == 2
+
+
+def test_sample_shapes():
+    eb = EpisodeBuffer(64, 2)
+    eb.add(_episode(10))
+    eb.add(_episode(8))
+    s = eb.sample(3, n_samples=2, sequence_length=4)
+    assert s["observations"].shape == (2, 4, 3, 1)
+
+
+def test_sample_no_valid_episode():
+    eb = EpisodeBuffer(64, 2)
+    eb.add(_episode(3))
+    with pytest.raises(RuntimeError):
+        eb.sample(1, sequence_length=10)
+
+
+def test_sample_next_obs():
+    eb = EpisodeBuffer(64, 2, obs_keys=("observations",))
+    eb.add(_episode(10))
+    s = eb.sample(4, sequence_length=3, sample_next_obs=True)
+    np.testing.assert_allclose(s["next_observations"][..., 0], s["observations"][..., 0] + 1)
+
+
+def test_prioritize_ends_samples_tail():
+    eb = EpisodeBuffer(64, 2, prioritize_ends=True)
+    eb.add(_episode(8))
+    s = eb.sample(64, sequence_length=4)
+    # with prioritize_ends the last window (starting at ep_len - L) must appear
+    starts = s["observations"][0, 0, :, 0]
+    assert (starts == 4).any()
+
+
+def test_memmap_episode(tmp_path):
+    eb = EpisodeBuffer(32, 2, memmap=True, memmap_dir=tmp_path / "ep")
+    eb.add(_episode(6))
+    assert eb.is_memmap
+    s = eb.sample(2, sequence_length=3)
+    assert s["observations"].shape == (1, 3, 2, 1)
+
+
+def test_multi_env_split():
+    eb = EpisodeBuffer(64, 2, n_envs=2)
+    data = _episode(6, n_envs=2)
+    eb.add(data)
+    assert len(eb.buffer) == 2

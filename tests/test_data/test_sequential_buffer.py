@@ -1,0 +1,59 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import SequentialReplayBuffer
+
+
+def _data(t, n):
+    return {"observations": np.arange(t * n).reshape(t, n, 1).astype(np.float32)}
+
+
+def test_sample_shape_and_contiguity():
+    rb = SequentialReplayBuffer(16, 2)
+    rb.add(_data(16, 2))
+    s = rb.sample(3, n_samples=2, sequence_length=5)
+    assert s["observations"].shape == (2, 5, 3, 1)
+    obs = s["observations"]
+    # consecutive elements along the sequence axis differ by n_envs (env stream stride)
+    diffs = np.diff(obs[..., 0], axis=1)
+    assert np.all((diffs == 2) | (diffs == 2 - 16 * 2))  # wraparound allowed
+
+
+def test_sample_not_enough_data():
+    rb = SequentialReplayBuffer(16, 1)
+    rb.add(_data(4, 1))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=10)
+
+
+def test_sample_seq_longer_than_buffer():
+    rb = SequentialReplayBuffer(8, 1)
+    rb.add(_data(10, 1))
+    with pytest.raises(ValueError):
+        rb.sample(1, sequence_length=9)
+
+
+def test_full_buffer_avoids_write_head():
+    rb = SequentialReplayBuffer(8, 1)
+    rb.add(_data(12, 1))  # full, pos=4
+    s = rb.sample(128, sequence_length=3)
+    seqs = s["observations"][..., 0]  # [n_samples, L, B]
+    # valid start values: sequences must be increments of 1 (contiguous stream)
+    diffs = np.diff(seqs, axis=1)
+    assert np.all(diffs == 1)
+
+
+def test_sample_next_obs_sequences():
+    rb = SequentialReplayBuffer(16, 1)
+    rb.add(_data(16, 1))
+    s = rb.sample(4, sequence_length=4, sample_next_obs=True)
+    np.testing.assert_allclose(
+        s["next_observations"][..., 0] % 16, (s["observations"][..., 0] + 1) % 16
+    )
+
+
+def test_memmap_sequential(tmp_path):
+    rb = SequentialReplayBuffer(16, 2, memmap=True, memmap_dir=tmp_path / "seq")
+    rb.add(_data(16, 2))
+    s = rb.sample(2, sequence_length=4)
+    assert s["observations"].shape == (1, 4, 2, 1)

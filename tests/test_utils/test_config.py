@@ -1,0 +1,66 @@
+import os
+
+import pytest
+
+from sheeprl_tpu.config import ConfigError, compose, instantiate
+from sheeprl_tpu.utils.utils import dotdict
+
+
+def test_compose_ppo_defaults():
+    cfg = compose(overrides=["exp=ppo"])
+    assert cfg.algo.name == "ppo"
+    assert cfg.env.id == "CartPole-v1"
+    assert cfg.buffer.size == cfg.algo.rollout_steps
+    assert isinstance(cfg.algo.optimizer.lr, float)
+    assert cfg.algo.encoder.dense_units == cfg.algo.dense_units
+
+
+def test_cli_overrides_win():
+    cfg = compose(overrides=["exp=ppo", "algo.rollout_steps=7", "seed=123"])
+    assert cfg.algo.rollout_steps == 7
+    assert cfg.buffer.size == 7  # interpolation resolved after overrides
+    assert cfg.seed == 123
+
+
+def test_group_swap():
+    cfg = compose(overrides=["exp=ppo", "env=dummy"])
+    assert cfg.env.id == "discrete_dummy"
+
+
+def test_missing_exp_raises():
+    with pytest.raises(ConfigError):
+        compose(overrides=[])
+
+
+def test_missing_mandatory_value_raises():
+    with pytest.raises(ConfigError, match="algo.total_steps"):
+        compose(overrides=["exp=default", "algo.name=x", "algo.per_rank_batch_size=1", "buffer.size=1", "env=dummy"])
+
+
+def test_instantiate_partial():
+    fn = instantiate({"_target_": "sheeprl_tpu.utils.optim.adam", "_partial_": True, "lr": 0.5})
+    tx = fn()
+    assert hasattr(tx, "init") and hasattr(tx, "update")
+
+
+def test_search_path_env(tmp_path, monkeypatch):
+    exp_dir = tmp_path / "exp"
+    exp_dir.mkdir()
+    (exp_dir / "custom.yaml").write_text(
+        "# @package _global_\ndefaults:\n  - override /algo: ppo\n  - override /env: dummy\n"
+        "algo:\n  total_steps: 1\n  per_rank_batch_size: 1\nbuffer:\n  size: 4\n"
+    )
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", f"file://{tmp_path}")
+    cfg = compose(overrides=["exp=custom"])
+    assert cfg.algo.total_steps == 1
+    assert cfg.env.id == "discrete_dummy"
+
+
+def test_dotdict_attribute_access():
+    d = dotdict({"a": {"b": {"c": 1}}, "l": [{"x": 2}]})
+    assert d.a.b.c == 1
+    assert d.l[0].x == 2
+    d.a.b.c = 5
+    assert d["a"]["b"]["c"] == 5
+    plain = d.as_dict()
+    assert type(plain["a"]) is dict

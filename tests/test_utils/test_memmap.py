@@ -1,0 +1,63 @@
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.memmap import MemmapArray, is_shared
+
+
+def test_create_and_write(tmp_path):
+    arr = MemmapArray(shape=(4, 3), dtype=np.float32, filename=tmp_path / "a.memmap")
+    arr[:] = np.ones((4, 3), dtype=np.float32)
+    assert arr.shape == (4, 3)
+    np.testing.assert_array_equal(np.asarray(arr), np.ones((4, 3)))
+    assert is_shared(arr.array)
+
+
+def test_temporary_file_cleanup():
+    arr = MemmapArray(shape=(2,), dtype=np.float32)
+    path = arr.filename
+    assert path.exists()
+    del arr
+    assert not path.exists()
+
+
+def test_from_array_copies(tmp_path):
+    src = np.arange(6, dtype=np.int32).reshape(2, 3)
+    mm = MemmapArray.from_array(src, filename=tmp_path / "b.memmap")
+    np.testing.assert_array_equal(mm[:], src)
+    src[0, 0] = 100
+    assert mm[0, 0] == 0  # copied, not aliased
+
+
+def test_ownership_not_transferred_same_file(tmp_path):
+    a = MemmapArray(shape=(3,), dtype=np.float32, filename=tmp_path / "c.memmap")
+    a[:] = 7
+    b = MemmapArray.from_array(a, filename=tmp_path / "c.memmap")
+    assert not b.has_ownership
+    assert a.has_ownership
+    np.testing.assert_array_equal(b[:], a[:])
+
+
+def test_pickle_drops_ownership(tmp_path):
+    a = MemmapArray(shape=(3,), dtype=np.float32, filename=tmp_path / "d.memmap")
+    a[:] = 3
+    b = pickle.loads(pickle.dumps(a))
+    assert not b.has_ownership
+    np.testing.assert_array_equal(b[:], np.full((3,), 3, dtype=np.float32))
+
+
+def test_ndarray_ops(tmp_path):
+    a = MemmapArray(shape=(3,), dtype=np.float32, filename=tmp_path / "e.memmap")
+    a[:] = 2
+    out = a + 1
+    np.testing.assert_array_equal(out, np.full((3,), 3, dtype=np.float32))
+    assert len(a) == 3
+
+
+def test_set_array_wrong_size(tmp_path):
+    a = MemmapArray(shape=(3,), dtype=np.float32, filename=tmp_path / "f.memmap")
+    with pytest.raises(ValueError):
+        a.array = np.zeros((10,), dtype=np.float32)
+    with pytest.raises(ValueError):
+        a.array = "nope"

@@ -1,0 +1,59 @@
+import pytest
+
+from sheeprl_tpu.utils.utils import Ratio
+
+
+def test_ratio_one_to_one():
+    r = Ratio(ratio=1.0)
+    total = 0
+    for step in range(1, 11):
+        total += r(step * 4)
+    assert total == 40
+
+
+def test_ratio_fractional():
+    r = Ratio(ratio=0.5)
+    total = 0
+    for step in range(1, 101):
+        total += r(step)
+    assert total == pytest.approx(50, abs=1)
+
+
+def test_ratio_zero():
+    r = Ratio(ratio=0.0)
+    assert r(100) == 0
+
+
+def test_ratio_pretrain():
+    r = Ratio(ratio=1.0, pretrain_steps=16)
+    assert r(20) == 16  # first call: int(pretrain_steps * ratio)
+    assert r(24) == 4  # afterwards: delta from the first-call step count
+
+
+def test_ratio_pretrain_scaled_by_ratio():
+    r = Ratio(ratio=0.5, pretrain_steps=100)
+    assert r(200) == 50
+
+
+def test_ratio_pretrain_clamped_warns():
+    import pytest as _pytest
+
+    r = Ratio(ratio=1.0, pretrain_steps=16)
+    with _pytest.warns(UserWarning):
+        assert r(8) == 8  # pretrain clamped to current steps
+
+
+def test_ratio_state_roundtrip():
+    r = Ratio(ratio=0.25)
+    r(10)
+    state = r.state_dict()
+    r2 = Ratio(ratio=1.0)
+    r2.load_state_dict(state)
+    assert r2.state_dict() == state
+
+
+def test_ratio_invalid():
+    with pytest.raises(ValueError):
+        Ratio(ratio=-1)
+    with pytest.raises(ValueError):
+        Ratio(ratio=1, pretrain_steps=-1)

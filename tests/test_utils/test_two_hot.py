@@ -1,0 +1,32 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.utils import symexp, symlog, two_hot_decoder, two_hot_encoder
+
+
+@pytest.mark.parametrize("value", [-250.0, -17.3, -1.0, 0.0, 0.5, 1.0, 42.0, 299.0])
+def test_two_hot_round_trip(value):
+    encoded = two_hot_encoder(jnp.array([value]), support_range=300, num_buckets=255)
+    assert encoded.shape == (255,)
+    np.testing.assert_allclose(float(encoded.sum()), 1.0, rtol=1e-5)
+    decoded = two_hot_decoder(encoded, support_range=300)
+    np.testing.assert_allclose(float(decoded[0]), value, rtol=2e-2, atol=1e-2)
+
+
+def test_two_hot_batched_shapes():
+    values = jnp.ones((4, 8, 1)) * 3.0
+    enc = two_hot_encoder(values, 300, 255)
+    assert enc.shape == (4, 8, 255)
+    dec = two_hot_decoder(enc, 300)
+    assert dec.shape == (4, 8, 1)
+
+
+def test_two_hot_at_most_two_nonzero():
+    enc = np.asarray(two_hot_encoder(jnp.array([17.3]), 300, 255))
+    assert (enc > 0).sum() <= 2
+
+
+def test_symlog_symexp_inverse():
+    x = jnp.array([-1000.0, -1.0, 0.0, 0.1, 500.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x), rtol=1e-5, atol=1e-5)
