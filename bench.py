@@ -1,0 +1,48 @@
+"""Benchmark entrypoint for the driver: prints ONE JSON line.
+
+Metric: PPO env-steps/sec on CartPole-v1 (BASELINE.md target metric #1). The
+reference anchor is the README PPO wall-clock benchmark: 81.27 s for 65_536 steps on
+4 CPUs => ~806 env-steps/sec (sheeprl v0.5.5, SB3 comparison table README.md:99-115).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def bench_ppo(total_steps: int = 16384) -> dict:
+    from sheeprl_tpu.cli import run
+
+    t0 = time.perf_counter()
+    run(
+        overrides=[
+            "exp=ppo",
+            f"algo.total_steps={total_steps}",
+            "algo.rollout_steps=128",
+            "algo.per_rank_batch_size=64",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.run_test=False",
+            "metric.log_level=0",
+            "metric.disable_timer=True",
+            "checkpoint.every=999999999",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+        ]
+    )
+    elapsed = time.perf_counter() - t0
+    steps_per_sec = total_steps / elapsed
+    baseline_sps = 65536 / 81.27  # reference PPO benchmark on 4 CPUs (README.md:99-115)
+    return {
+        "metric": "ppo_cartpole_env_steps_per_sec",
+        "value": round(steps_per_sec, 2),
+        "unit": "env-steps/s",
+        "vs_baseline": round(steps_per_sec / baseline_sps, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench_ppo()))

@@ -1,0 +1,287 @@
+"""A2C, coupled training (reference sheeprl/algos/a2c/a2c.py:26-118 train, :118 main).
+
+Same rollout skeleton as PPO; the optimization phase is one jitted call that
+accumulates gradients across minibatches (`lax.scan`) and applies a single optimizer
+step — the in-graph equivalent of the reference's `fabric.no_backward_sync`
+gradient-accumulation loop.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
+from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
+from sheeprl_tpu.algos.ppo.loss import entropy_loss
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, save_configs
+
+
+def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys):
+    global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    n_minibatches = max(n_data // global_bs, 1)
+    data_sharding = NamedSharding(runtime.mesh, P("data"))
+
+    def loss_fn(params, batch):
+        norm_obs = normalize_obs(batch, [], obs_keys)
+        actions = (
+            jnp.split(batch["actions"], np.cumsum(agent.actions_dim)[:-1].tolist(), axis=-1)
+            if len(agent.actions_dim) > 1
+            else [batch["actions"]]
+        )
+        actor_outs, new_values = agent.apply(params, norm_obs)
+        logprobs, entropy = evaluate_actions(actor_outs, actions, agent.is_continuous, agent.distribution)
+        advantages = batch["advantages"]
+        if cfg.algo.normalize_advantages:
+            advantages = normalize_tensor(advantages)
+        pg_loss = policy_loss(logprobs, advantages, cfg.algo.loss_reduction)
+        v_loss = value_loss(new_values, batch["returns"], cfg.algo.loss_reduction)
+        ent_loss = entropy_loss(entropy, cfg.algo.loss_reduction)
+        total = pg_loss + cfg.algo.vf_coef * v_loss + cfg.algo.ent_coef * ent_loss
+        return total, (pg_loss, v_loss)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train(params, opt_state, data, next_values, key):
+        returns, advantages = gae(
+            data["rewards"],
+            data["values"],
+            data["dones"],
+            next_values,
+            cfg.algo.rollout_steps,
+            cfg.algo.gamma,
+            cfg.algo.gae_lambda,
+        )
+        data = dict(data)
+        data["returns"] = returns
+        data["advantages"] = advantages
+        flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in data.items()}
+        n_keep = n_minibatches * global_bs
+        perm = jax.random.permutation(key, n_data)[:n_keep].reshape(n_minibatches, global_bs)
+
+        def accumulate(carry, idx):
+            grads_acc, pg_acc, v_acc = carry
+            batch = jax.tree_util.tree_map(
+                lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
+            )
+            (_, (pg, vl)), grads = grad_fn(params, batch)
+            grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+            return (grads_acc, pg_acc + pg, v_acc + vl), None
+
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        (grads, pg_sum, v_sum), _ = jax.lax.scan(
+            accumulate, (zero_grads, jnp.float32(0), jnp.float32(0)), perm
+        )
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {
+            "Loss/policy_loss": pg_sum / n_minibatches,
+            "Loss/value_loss": v_sum / n_minibatches,
+        }
+
+    return jax.jit(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        raise ValueError("A2C is vector-observation only: do not set `algo.cnn_keys.encoder`")
+    world_size = runtime.world_size
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    n_envs = cfg.env.num_envs * world_size
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = cfg.algo.mlp_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    agent, params, player = build_agent(
+        runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    if state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+    opt_state = runtime.replicate(opt_state)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=obs_keys,
+    )
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(n_envs * cfg.algo.rollout_steps)
+    total_iters = cfg.algo.total_steps // policy_steps_per_iter if not cfg.dry_run else 1
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+    n_data = cfg.algo.rollout_steps * n_envs
+
+    train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys)
+    rng = jax.random.PRNGKey(cfg.seed)
+
+    step_data = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(cfg.algo.rollout_steps):
+            policy_step += n_envs
+
+            with timer("Time/env_interaction_time", SumMetric()):
+                jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
+                cat_actions, env_actions, logprobs, values, rng = player(jax_obs, rng)
+                real_actions = np.asarray(env_actions)
+                np_actions = np.asarray(cat_actions)
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
+
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis]
+            step_data["actions"] = np_actions[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            if cfg.buffer.memmap:
+                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs = {}
+            for k in obs_keys:
+                step_data[k] = obs[k][np.newaxis]
+                next_obs[k] = obs[k]
+
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        local_data = rb.to_arrays(dtype=np.float32)
+        if cfg.buffer.size > cfg.algo.rollout_steps:
+            idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+            local_data = {k: v[idx] for k, v in local_data.items()}
+        with timer("Time/train_time", SumMetric()):
+            jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
+            next_values = player.get_values(jax_obs)
+            rng, train_key = jax.random.split(rng)
+            device_data = {k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")}
+            params, opt_state, train_metrics = train_fn(params, opt_state, device_data, next_values, train_key)
+            jax.block_until_ready(params)
+            player.params = params
+        train_step += world_size
+
+        if cfg.metric.log_level > 0:
+            if aggregator:
+                for k, v in train_metrics.items():
+                    if k in aggregator:
+                        aggregator.update(k, float(v))
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "optimizer": jax.device_get(opt_state),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

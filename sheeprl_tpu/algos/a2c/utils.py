@@ -1,0 +1,23 @@
+"""A2C utilities (reference sheeprl/algos/a2c/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.utils import test  # noqa: F401  (same greedy test loop)
+
+AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def normalize_obs(obs, cnn_keys: Sequence[str], obs_keys: Sequence[str]):
+    return {k: jnp.asarray(obs[k], dtype=jnp.float32) for k in obs_keys}
+
+
+def prepare_obs(runtime, obs: Dict[str, np.ndarray], *, num_envs: int = 1, **kwargs) -> Dict[str, jax.Array]:
+    """A2C is vector-obs only (reference utils.py:16-21)."""
+    return {k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(num_envs, -1)) for k, v in obs.items()}

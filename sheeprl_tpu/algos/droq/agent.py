@@ -1,0 +1,79 @@
+"""DroQ agent: SAC with Dropout+LayerNorm critics (reference sheeprl/algos/droq/agent.py).
+
+DROQCritic (:20) adds per-layer Dropout + LayerNorm to the SAC critic; the actor and
+player are the SAC ones. Ensemble params stay stacked (vmapped init) but training
+updates critics sequentially with fresh target noise, matching the reference's
+per-critic update/EMA interleaving (droq.py:95-117).
+"""
+
+from __future__ import annotations
+
+from math import prod
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor, SACParams, SACPlayer, init_sac_params
+from sheeprl_tpu.models.models import MLP
+
+
+class DROQCritic(nn.Module):
+    """Q(s, a) MLP with Dropout before LayerNorm before activation (reference :20-54)."""
+
+    hidden_size: int = 256
+    num_critics: int = 1
+    dropout: float = 0.0
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, deterministic: bool = True) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+        return MLP(
+            input_dims=1,
+            output_dim=self.num_critics,
+            hidden_sizes=(self.hidden_size, self.hidden_size),
+            dropout_rate=self.dropout if self.dropout > 0 else None,
+            layer_norm=True,
+            dtype=self.dtype,
+        )(x, deterministic=deterministic).astype(jnp.float32)
+
+
+def build_agent(
+    runtime,
+    cfg,
+    obs_space: gymnasium.spaces.Dict,
+    action_space: gymnasium.spaces.Box,
+    agent_state: Optional[Dict[str, Any]] = None,
+):
+    """Returns (actor, critic, params: SACParams, player). Reference: agent.py:222."""
+    act_dim = prod(action_space.shape)
+    obs_dim = sum(prod(obs_space[k].shape) for k in cfg.algo.mlp_keys.encoder)
+    actor = SACActor(
+        action_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        action_low=tuple(np.asarray(action_space.low, dtype=np.float32).tolist()),
+        action_high=tuple(np.asarray(action_space.high, dtype=np.float32).tolist()),
+        dtype=runtime.compute_dtype,
+    )
+    critic = DROQCritic(
+        hidden_size=cfg.algo.critic.hidden_size,
+        num_critics=1,
+        dropout=cfg.algo.critic.dropout,
+        dtype=runtime.compute_dtype,
+    )
+    params = init_sac_params(
+        jax.random.PRNGKey(cfg.seed), actor, critic, cfg.algo.critic.n, obs_dim, act_dim, cfg.algo.alpha.alpha
+    )
+    if agent_state is not None:
+        params = jax.tree_util.tree_map(jnp.asarray, agent_state)
+        if not isinstance(params, SACParams):
+            params = SACParams(*params) if isinstance(params, (tuple, list)) else SACParams(**params)
+    params = runtime.replicate(params)
+    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
+    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    player = SACPlayer(actor, params.actor, action_scale, action_bias)
+    return actor, critic, params, player

@@ -1,0 +1,78 @@
+"""PPO utilities: obs preparation, test loop, registry contracts.
+
+Reference: sheeprl/algos/ppo/utils.py (AGGREGATOR_KEYS :21, MODELS_TO_REGISTER :22,
+prepare_obs :25, test :39, normalize_obs, log_models).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def normalize_obs(
+    obs: Dict[str, jax.Array], cnn_keys: Sequence[str], obs_keys: Sequence[str]
+) -> Dict[str, jax.Array]:
+    """uint8 pixels -> [-0.5, 0.5] floats; mlp keys pass through as f32."""
+    out = {}
+    for k in obs_keys:
+        v = jnp.asarray(obs[k], dtype=jnp.float32)
+        out[k] = v / 255.0 - 0.5 if k in cnn_keys else v
+    return out
+
+
+def prepare_obs(
+    runtime, obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = [], num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """Host obs dict -> normalized device arrays [num_envs, ...]; frame-stacked cnn
+    keys collapse the stack into channels (reference utils.py:25-36)."""
+    out = {}
+    for k, v in obs.items():
+        arr = np.asarray(v, dtype=np.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(num_envs, -1, *arr.shape[-2:])
+            arr = arr / 255.0 - 0.5
+        else:
+            arr = arr.reshape(num_envs, -1)
+        out[k] = jnp.asarray(arr)
+    return out
+
+
+def test(player, runtime, cfg, log_dir: str) -> None:
+    """Greedy evaluation episode (reference utils.py:39-66)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    key = jax.random.PRNGKey(cfg.seed)
+    while not done:
+        jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        env_actions, key = player.get_actions(jax_obs, key, greedy=True)
+        real_actions = np.asarray(env_actions)[0]
+        obs, reward, terminated, truncated, _ = env.step(
+            np.asarray(real_actions).reshape(env.action_space.shape)
+        )
+        done = terminated or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    if cfg.metric.log_level > 0:
+        runtime.print(f"Test - Reward: {cumulative_rew}")
+        if hasattr(runtime, "logger") and runtime.logger is not None:
+            runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

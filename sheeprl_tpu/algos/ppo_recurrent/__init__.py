@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.ppo_recurrent import ppo_recurrent  # noqa: F401
+from sheeprl_tpu.algos.ppo_recurrent import evaluate  # noqa: F401

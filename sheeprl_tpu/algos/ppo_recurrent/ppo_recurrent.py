@@ -1,0 +1,414 @@
+"""Recurrent PPO (reference sheeprl/algos/ppo_recurrent/ppo_recurrent.py:31-120 train,
+:120 main).
+
+BPTT over sequence chunks. Host side splits the rollout into per-env episodes, chunks
+them to ``per_rank_sequence_length``, pads, and buckets the sequence count to a
+power-of-two so the jitted train function (epochs x minibatches via ``lax.scan``,
+masked losses) retraces only on bucket growth — not every iteration.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, List
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
+from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+
+
+def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
+    return (x * mask).sum() / jnp.clip(mask.sum(), 1, None)
+
+
+def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys):
+    update_epochs = int(cfg.algo.update_epochs)
+    n_batches = max(int(cfg.algo.per_rank_num_batches), 1)
+    data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+
+    def loss_fn(params, batch, clip_coef, ent_coef):
+        norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
+        actions = (
+            jnp.split(batch["actions"], np.cumsum(agent.actions_dim)[:-1].tolist(), axis=-1)
+            if len(agent.actions_dim) > 1
+            else [batch["actions"]]
+        )
+        mask = batch["mask"]
+        actor_outs, values, _ = agent.apply(
+            params, norm_obs, batch["prev_actions"], (batch["prev_hx"], batch["prev_cx"]), mask
+        )
+        new_logprobs, entropy = evaluate_actions(actor_outs, actions, agent.is_continuous, agent.distribution)
+        advantages = batch["advantages"]
+        if cfg.algo.normalize_advantages:
+            # masked normalization (reference ppo_recurrent.py:77-81)
+            n = jnp.clip(mask.sum(), 1, None)
+            mean = (advantages * mask).sum() / n
+            var = (((advantages - mean) * mask) ** 2).sum() / n
+            advantages = (advantages - mean) / (jnp.sqrt(var) + 1e-8) * mask
+        pg = policy_loss(new_logprobs, batch["logprobs"], advantages, clip_coef, "none")
+        pg_loss = _masked_mean(pg, mask)
+        if cfg.algo.clip_vloss:
+            v_unclipped = (values - batch["returns"]) ** 2
+            v_clipped_pred = batch["values"] + jnp.clip(values - batch["values"], -clip_coef, clip_coef)
+            v_clipped = (v_clipped_pred - batch["returns"]) ** 2
+            v_loss = 0.5 * _masked_mean(jnp.maximum(v_unclipped, v_clipped), mask)
+        else:
+            v_loss = _masked_mean((values - batch["returns"]) ** 2, mask)
+        ent_loss = -_masked_mean(entropy, mask)
+        total = pg_loss + cfg.algo.vf_coef * v_loss + cfg.algo.ent_coef * ent_loss
+        return total, (pg_loss, v_loss, ent_loss)
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    def train(params, opt_state, data, key, clip_coef, ent_coef):
+        n_seq = next(iter(data.values())).shape[1]
+        batch_size = max(n_seq // n_batches, 1)
+        n_mb = n_seq // batch_size
+
+        epoch_keys = jax.random.split(key, update_epochs)
+        perms = jnp.stack([jax.random.permutation(k, n_seq)[: n_mb * batch_size] for k in epoch_keys])
+        perms = perms.reshape(update_epochs * n_mb, batch_size)
+
+        def minibatch_step(carry, idx):
+            params, opt_state = carry
+            batch = jax.tree_util.tree_map(
+                lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=1), data_sharding), data
+            )
+            # initial LSTM states of each sequence: [B, H]
+            batch = dict(batch)
+            batch["prev_hx"] = batch["prev_hx"][0]
+            batch["prev_cx"] = batch["prev_cx"][0]
+            (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), jnp.stack([pg, vl, ent])
+
+        (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
+        metrics = losses.mean(axis=0)
+        return params, opt_state, {
+            "Loss/policy_loss": metrics[0],
+            "Loss/value_loss": metrics[1],
+            "Loss/entropy_loss": metrics[2],
+        }
+
+    return jax.jit(train, donate_argnums=(0, 1))
+
+
+def _chunk_and_pad(local_data: Dict[str, np.ndarray], dones: np.ndarray, sl: int, n_envs: int):
+    """Split the rollout into per-env episodes, chunk to length <= sl, pad + mask.
+
+    Returns dict of arrays [sl, n_seq_padded, ...] with a `mask` key; n_seq is
+    bucketed to the next power of two (zero-mask padding) for jit-shape stability.
+    """
+    sequences: Dict[str, List[np.ndarray]] = {k: [] for k in local_data.keys()}
+    lengths: List[int] = []
+    T = next(iter(local_data.values())).shape[0]
+    for env_id in range(n_envs):
+        ends = np.nonzero(dones[:, env_id, 0])[0].tolist()
+        ends.append(T - 1)
+        start = 0
+        for stop in ends:
+            if stop + 1 <= start:
+                continue
+            ep_slice = slice(start, stop + 1)
+            ep_len = stop + 1 - start
+            for s0 in range(0, ep_len, sl):
+                s1 = min(s0 + sl, ep_len)
+                for k, v in local_data.items():
+                    sequences[k].append(v[ep_slice][s0:s1, env_id])
+                lengths.append(s1 - s0)
+            start = stop + 1
+    n_seq = len(lengths)
+    bucket = 1
+    while bucket < n_seq:
+        bucket *= 2
+    out: Dict[str, np.ndarray] = {}
+    for k, chunks in sequences.items():
+        sample_shape = chunks[0].shape[1:]
+        arr = np.zeros((sl, bucket, *sample_shape), dtype=np.float32)
+        for i, c in enumerate(chunks):
+            arr[: c.shape[0], i] = c
+        out[k] = arr
+    mask = np.zeros((sl, bucket, 1), dtype=np.float32)
+    for i, ln in enumerate(lengths):
+        mask[:ln, i] = 1.0
+    out["mask"] = mask
+    return out
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in cfg.env.wrapper._target_.lower():
+        raise ValueError("MineDojo is not currently supported by PPO-recurrent agent.")
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+    world_size = runtime.world_size
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    n_envs = cfg.env.num_envs * world_size
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    if cfg.algo.rollout_steps % cfg.algo.per_rank_sequence_length != 0:
+        raise ValueError(
+            "The rollout steps must be a multiple of the per_rank_sequence_length, got "
+            f"{cfg.algo.rollout_steps} and {cfg.algo.per_rank_sequence_length}"
+        )
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+
+    agent, params, player = build_agent(
+        runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+
+    policy_steps_per_iter = int(n_envs * cfg.algo.rollout_steps)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    if state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+    opt_state = runtime.replicate(opt_state)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=obs_keys,
+    )
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs * cfg.algo.rollout_steps if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+
+    train_fn = make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys)
+    rng = jax.random.PRNGKey(cfg.seed)
+    h = cfg.algo.rnn.lstm.hidden_size
+
+    step_data = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        if k in cnn_keys:
+            next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
+        step_data[k] = next_obs[k][np.newaxis]
+    prev_states = player.initial_states(h)
+    prev_actions = np.zeros((n_envs, sum(actions_dim)), dtype=np.float32)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        for _ in range(cfg.algo.rollout_steps):
+            policy_step += n_envs
+
+            with timer("Time/env_interaction_time", SumMetric()):
+                jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                jax_obs = {k: v[None] for k, v in jax_obs.items()}  # add T=1
+                cat_actions, env_actions, logprobs, values, states, rng = player(
+                    jax_obs, jnp.asarray(prev_actions)[None], prev_states, rng
+                )
+                real_actions = np.asarray(env_actions)
+                obs, rewards, terminated, truncated, info = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                rewards = np.asarray(rewards, dtype=np.float32)
+                # bootstrap on truncation (reference ppo_recurrent.py:312-336)
+                truncated_envs = np.nonzero(truncated)[0]
+                if len(truncated_envs) > 0 and "final_obs" in info:
+                    final_obs_arr = np.asarray(info["final_obs"], dtype=object)
+                    for te in truncated_envs:
+                        fo = final_obs_arr[te]
+                        if fo is None:
+                            continue
+                        f_obs = {}
+                        for k in obs_keys:
+                            v = np.asarray(fo[k], dtype=np.float32)
+                            if k in cnn_keys:
+                                v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+                            f_obs[k] = jnp.asarray(v)[None, None]
+                        te_states = tuple(s[te : te + 1] for s in states)
+                        te_prev_act = jnp.asarray(cat_actions).reshape(n_envs, -1)[te : te + 1][None]
+                        val, _ = player.get_values(f_obs, te_prev_act, te_states)
+                        rewards[te] += cfg.algo.gamma * float(np.asarray(val).reshape(-1)[0])
+                dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.float32)
+                rewards = rewards.reshape(n_envs, -1)
+
+            step_data["dones"] = dones[np.newaxis]
+            step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
+            step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
+            step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
+            step_data["rewards"] = rewards[np.newaxis]
+            step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
+            step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
+            step_data["prev_actions"] = prev_actions.reshape(1, n_envs, -1)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            # reset recurrent state / prev action on done (reference :356-371)
+            prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
+            if cfg.algo.reset_recurrent_state_on_done:
+                not_done = jnp.asarray(1.0 - dones, dtype=jnp.float32)
+                prev_states = tuple(not_done * s for s in states)
+            else:
+                prev_states = states
+
+            next_obs = {}
+            for k in obs_keys:
+                _obs = obs[k]
+                if k in cnn_keys:
+                    _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                step_data[k] = _obs[np.newaxis]
+                next_obs[k] = _obs
+
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        local_data = rb.to_arrays(dtype=np.float32)
+        with timer("Time/train_time", SumMetric()):
+            jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+            jax_obs = {k: v[None] for k, v in jax_obs.items()}
+            next_values, _ = player.get_values(jax_obs, jnp.asarray(prev_actions)[None], prev_states)
+            returns, advantages = gae(
+                jnp.asarray(local_data["rewards"]),
+                jnp.asarray(local_data["values"]),
+                jnp.asarray(local_data["dones"]),
+                next_values,
+                cfg.algo.rollout_steps,
+                cfg.algo.gamma,
+                cfg.algo.gae_lambda,
+            )
+            local_data["returns"] = np.asarray(returns, dtype=np.float32)
+            local_data["advantages"] = np.asarray(advantages, dtype=np.float32)
+            padded = _chunk_and_pad(
+                local_data, local_data["dones"], cfg.algo.per_rank_sequence_length, n_envs
+            )
+            device_data = {k: jnp.asarray(v) for k, v in padded.items()}
+            rng, train_key = jax.random.split(rng)
+            params, opt_state, train_metrics = train_fn(
+                params,
+                opt_state,
+                device_data,
+                train_key,
+                jnp.float32(cfg.algo.clip_coef),
+                jnp.float32(cfg.algo.ent_coef),
+            )
+            jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+            player.params = params
+        train_step += world_size
+
+        if cfg.metric.log_level > 0:
+            if aggregator:
+                for k, v in train_metrics.items():
+                    if k in aggregator:
+                        aggregator.update(k, float(v))
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if cfg.algo.anneal_clip_coef:
+            cfg.algo.clip_coef = polynomial_decay(
+                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+        if cfg.algo.anneal_ent_coef:
+            cfg.algo.ent_coef = polynomial_decay(
+                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+            )
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "optimizer": jax.device_get(opt_state),
+                "iter_num": iter_num * world_size,
+                "batch_size": -1,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        from sheeprl_tpu.algos.ppo_recurrent.utils import test
+
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

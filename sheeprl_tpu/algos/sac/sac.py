@@ -1,0 +1,389 @@
+"""SAC, coupled training (reference sheeprl/algos/sac/sac.py:32-120 train, :82 main).
+
+TPU-first structure: per iteration the replay buffer is sampled ONCE for all gradient
+steps (G x B batch), moved to HBM, and a single jitted call `lax.scan`s over the G
+minibatches — critic update, conditional target-EMA, actor update, alpha update per
+step. The reference's per-minibatch Python loop with three backward passes becomes one
+fused XLA program; the alpha-grad all-reduce (reference sac.py:73) happens implicitly
+through the sharded batch.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from math import prod
+from typing import Any, Dict, NamedTuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.sac.agent import (
+    SACParams,
+    actor_action_and_log_prob,
+    build_agent,
+    ensemble_q_values,
+)
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+
+
+class SACOptStates(NamedTuple):
+    qf: Any
+    actor: Any
+    alpha: Any
+
+
+def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every: int):
+    n_critics = int(cfg.algo.critic.n)
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    data_sharding = NamedSharding(runtime.mesh, P("data"))
+    qf_tx = instantiate(dict(cfg.algo.critic.optimizer))()
+    actor_tx = instantiate(dict(cfg.algo.actor.optimizer))()
+    alpha_tx = instantiate(dict(cfg.algo.alpha.optimizer))()
+
+    def init_opt(params: SACParams) -> SACOptStates:
+        return SACOptStates(
+            qf=qf_tx.init(params.critics),
+            actor=actor_tx.init(params.actor),
+            alpha=alpha_tx.init(params.log_alpha),
+        )
+
+    def single_update(carry, inp):
+        params, opt_states, update_idx = carry
+        batch, key = inp
+        batch = jax.tree_util.tree_map(
+            lambda v: jax.lax.with_sharding_constraint(v, data_sharding), batch
+        )
+        k_next, k_actor = jax.random.split(key)
+        alpha = jnp.exp(params.log_alpha)
+
+        # ---- critic update (Eq. 5): target from next actions under current policy
+        mean, log_std = actor.apply(params.actor, batch["next_observations"])
+        next_actions, next_logp = actor_action_and_log_prob(mean, log_std, k_next, action_scale, action_bias)
+        next_q = ensemble_q_values(critic, params.target_critics, batch["next_observations"], next_actions)
+        min_next_q = jnp.min(next_q, axis=-1, keepdims=True) - alpha * next_logp
+        target_q = batch["rewards"] + (1 - batch["terminated"]) * gamma * min_next_q
+        target_q = jax.lax.stop_gradient(target_q)
+
+        def qf_loss_fn(critics_params):
+            qs = ensemble_q_values(critic, critics_params, batch["observations"], batch["actions"])
+            return critic_loss(qs, target_q, n_critics)
+
+        qf_l, qf_grads = jax.value_and_grad(qf_loss_fn)(params.critics)
+        qf_updates, qf_opt = qf_tx.update(qf_grads, opt_states.qf, params.critics)
+        new_critics = optax.apply_updates(params.critics, qf_updates)
+
+        # ---- target EMA every `ema_every` updates (reference sac.py:55-56)
+        new_targets = jax.lax.cond(
+            update_idx % ema_every == 0,
+            lambda tgt: polyak_update(new_critics, tgt, tau),
+            lambda tgt: tgt,
+            params.target_critics,
+        )
+
+        # ---- actor update (Eq. 7)
+        def actor_loss_fn(actor_params):
+            m, ls = actor.apply(actor_params, batch["observations"])
+            acts, logp = actor_action_and_log_prob(m, ls, k_actor, action_scale, action_bias)
+            qs = ensemble_q_values(critic, new_critics, batch["observations"], acts)
+            min_q = jnp.min(qs, axis=-1, keepdims=True)
+            return policy_loss(alpha, logp, min_q), logp
+
+        (actor_l, logp), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params.actor)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states.actor, params.actor)
+        new_actor = optax.apply_updates(params.actor, actor_updates)
+
+        # ---- alpha update (Eq. 17)
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, jax.lax.stop_gradient(logp), target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params.log_alpha)
+        alpha_updates, alpha_opt = alpha_tx.update(alpha_grads, opt_states.alpha, params.log_alpha)
+        new_log_alpha = optax.apply_updates(params.log_alpha, alpha_updates)
+
+        new_params = SACParams(
+            actor=new_actor, critics=new_critics, target_critics=new_targets, log_alpha=new_log_alpha
+        )
+        new_opt = SACOptStates(qf=qf_opt, actor=actor_opt, alpha=alpha_opt)
+        return (new_params, new_opt, update_idx + 1), jnp.stack([qf_l, actor_l, alpha_l])
+
+    def train(params, opt_states, batches, key, update_start):
+        g = next(iter(batches.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states, update_end), losses = jax.lax.scan(
+            single_update, (params, opt_states, update_start), (batches, keys)
+        )
+        mean_losses = losses.mean(axis=0)
+        return params, opt_states, update_end, {
+            "Loss/value_loss": mean_losses[0],
+            "Loss/policy_loss": mean_losses[1],
+            "Loss/alpha_loss": mean_losses[2],
+        }
+
+    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in cfg.env.wrapper._target_.lower():
+        raise ValueError("MineDojo is not currently supported by SAC agent.")
+    world_size = runtime.world_size
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    n_envs = cfg.env.num_envs * world_size
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
+                f"Provided environment: {cfg.env.id}"
+            )
+
+    actor, critic, params, player = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    act_dim = prod(action_space.shape)
+    target_entropy = jnp.float32(-act_dim)
+    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
+    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+
+    policy_steps_per_iter = int(n_envs)
+    ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+    init_opt, train_fn = make_train_fn(
+        actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every
+    )
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    opt_states = runtime.replicate(opt_states)
+    update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(0)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // n_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=("observations",),
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    cumulative_grad_steps = 0
+
+    obs = envs.reset(seed=cfg.seed)[0]
+    obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += n_envs
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            if iter_num < learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                rng, act_key = jax.random.split(rng)
+                actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
+            next_obs, rewards, terminated, truncated, info = envs.step(
+                actions.reshape(envs.action_space.shape)
+            )
+            next_obs_vec = np.concatenate(
+                [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
+            )
+            # the real next obs for terminated envs is in final_obs (SAME_STEP autoreset)
+            real_next_obs = next_obs_vec.copy()
+            if "final_obs" in info:
+                for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
+                    if fo is not None:
+                        real_next_obs[idx] = np.concatenate(
+                            [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
+                        )
+
+        step_data = {
+            "terminated": np.asarray(terminated).reshape(1, n_envs, -1).astype(np.uint8),
+            "truncated": np.asarray(truncated).reshape(1, n_envs, -1).astype(np.uint8),
+            "actions": np.asarray(actions).reshape(1, n_envs, -1).astype(np.float32),
+            "observations": obs_vec[np.newaxis],
+            "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
+        }
+        if not cfg.buffer.sample_next_obs:
+            step_data["next_observations"] = real_next_obs[np.newaxis]
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        obs_vec = next_obs_vec
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        # ---- training phase
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time", SumMetric()):
+                    sample = rb.sample(
+                        batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size * world_size,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                    )
+                    g = per_rank_gradient_steps
+                    bs = cfg.algo.per_rank_batch_size * world_size
+                    batches = {
+                        k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]))
+                        for k, v in sample.items()
+                    }
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_states, update_counter, train_metrics = train_fn(
+                        params, opt_states, batches, train_key, update_counter
+                    )
+                    jax.block_until_ready(params.actor)
+                    player.params = params.actor
+                    cumulative_grad_steps += g
+                train_step += world_size * g
+
+        if cfg.metric.log_level > 0 and policy_step > 0:
+            if iter_num >= learning_starts and "train_metrics" in dir():
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                if cumulative_grad_steps > 0:
+                    logger.log_metrics(
+                        {"Params/replay_ratio": cumulative_grad_steps * world_size / policy_step}, policy_step
+                    )
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "opt_states": jax.device_get(opt_states),
+                "update_counter": int(update_counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

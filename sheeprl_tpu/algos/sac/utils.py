@@ -1,0 +1,50 @@
+"""SAC utilities (reference sheeprl/algos/sac/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(runtime, obs: Dict[str, np.ndarray], *, num_envs: int = 1, **kwargs) -> jax.Array:
+    """Concat mlp keys into the flat `observations` vector (reference utils.py:14-20)."""
+    mlp_keys = kwargs.get("mlp_keys", list(obs.keys()))
+    with jax.default_device(jax.devices()[0]):
+        return jnp.asarray(
+            np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1)
+        )
+
+
+def test(player, runtime, cfg, log_dir: str) -> None:
+    """Greedy evaluation episode (reference utils.py:23-51)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        jax_obs = prepare_obs(runtime, obs, num_envs=1, mlp_keys=cfg.algo.mlp_keys.encoder)
+        action = np.asarray(player.get_actions(jax_obs, greedy=True))[0]
+        obs, reward, terminated, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = terminated or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    if cfg.metric.log_level > 0:
+        runtime.print(f"Test - Reward: {cumulative_rew}")
+        if getattr(runtime, "logger", None) is not None:
+            runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

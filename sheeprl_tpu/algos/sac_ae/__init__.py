@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.sac_ae import sac_ae  # noqa: F401
+from sheeprl_tpu.algos.sac_ae import evaluate  # noqa: F401

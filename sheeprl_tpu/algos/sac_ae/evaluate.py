@@ -1,0 +1,33 @@
+"""SAC-AE evaluation entrypoint (reference sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate_sac_ae(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    env.close()
+
+    _, _, player = build_agent(runtime, cfg, observation_space, action_space, state["agent"])
+    test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

@@ -1,0 +1,435 @@
+"""SAC-AE training (reference sheeprl/algos/sac_ae/sac_ae.py:35-120 train, :120 main).
+
+Pixel SAC + autoencoder. One jitted call scans the G gradient steps of an iteration;
+each step: critic update -> conditional target/encoder EMA (freqs on the cumulative
+update counter) -> conditional actor+alpha update (detached encoder features) ->
+conditional decoder/encoder reconstruction update with bit-reduced + dequantized
+targets (reference utils.py:68-76).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from math import prod
+from typing import Any, Dict, NamedTuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.sac.agent import actor_action_and_log_prob
+from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
+from sheeprl_tpu.algos.sac_ae.agent import SACAEParams, build_agent
+from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+
+
+class SACAEOptStates(NamedTuple):
+    qf: Any
+    actor: Any
+    alpha: Any
+    encoder: Any
+    decoder: Any
+
+
+def make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entropy):
+    encoder, decoder, qf, actor_head = (
+        modules["encoder"],
+        modules["decoder"],
+        modules["qf"],
+        modules["actor_head"],
+    )
+    n_critics = int(cfg.algo.critic.n)
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    encoder_tau = float(cfg.algo.encoder.tau)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    actor_freq = int(cfg.algo.actor.per_rank_update_freq)
+    decoder_freq = int(cfg.algo.decoder.per_rank_update_freq)
+    l2_lambda = float(cfg.algo.decoder.l2_lambda)
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_dec_keys = list(cfg.algo.cnn_keys.decoder)
+    mlp_dec_keys = list(cfg.algo.mlp_keys.decoder)
+    data_sharding = NamedSharding(runtime.mesh, P("data"))
+
+    qf_tx = instantiate(dict(cfg.algo.critic.optimizer))()
+    actor_tx = instantiate(dict(cfg.algo.actor.optimizer))()
+    alpha_tx = instantiate(dict(cfg.algo.alpha.optimizer))()
+    encoder_tx = instantiate(dict(cfg.algo.encoder.optimizer))()
+    decoder_tx = instantiate(dict(cfg.algo.decoder.optimizer))()
+
+    def init_opt(params: SACAEParams) -> SACAEOptStates:
+        return SACAEOptStates(
+            qf=qf_tx.init(params.qfs),
+            actor=actor_tx.init(params.actor),
+            alpha=alpha_tx.init(params.log_alpha),
+            encoder=encoder_tx.init(params.encoder),
+            decoder=decoder_tx.init(params.decoder),
+        )
+
+    def normalize(batch, prefix=""):
+        out = {}
+        for k in cnn_keys + mlp_keys:
+            v = batch[prefix + k]
+            out[k] = v / 255.0 if k in cnn_keys else v
+        return out
+
+    def q_ensemble(qfs_params, feats, action):
+        qs = jax.vmap(lambda p: qf.apply(p, feats, action))(qfs_params)
+        return jnp.moveaxis(qs[..., 0], 0, -1)
+
+    def single_update(carry, inp):
+        params, opt_states, counter = carry
+        batch, key = inp
+        batch = jax.tree_util.tree_map(lambda v: jax.lax.with_sharding_constraint(v, data_sharding), batch)
+        obs = normalize(batch)
+        next_obs = normalize(batch, prefix="next_")
+        alpha = jnp.exp(params.log_alpha)
+        key, k_next, k_actor, k_noise = jax.random.split(key, 4)
+
+        # ---- critic update
+        next_feats_actor = encoder.apply(params.encoder, next_obs)
+        mean, log_std = actor_head.apply(params.actor, next_feats_actor)
+        next_actions, next_logp = actor_action_and_log_prob(mean, log_std, k_next, action_scale, action_bias)
+        next_feats_target = encoder.apply(params.target_encoder, next_obs)
+        next_q = q_ensemble(params.target_qfs, next_feats_target, next_actions)
+        min_next_q = jnp.min(next_q, axis=-1, keepdims=True) - alpha * next_logp
+        target_q = jax.lax.stop_gradient(batch["rewards"] + (1 - batch["terminated"]) * gamma * min_next_q)
+
+        def qf_loss_fn(trainable):
+            enc_p, qfs_p = trainable
+            feats = encoder.apply(enc_p, obs)
+            qs = q_ensemble(qfs_p, feats, batch["actions"])
+            return critic_loss(qs, target_q, n_critics)
+
+        qf_l, (enc_grads_q, qf_grads) = jax.value_and_grad(qf_loss_fn)((params.encoder, params.qfs))
+        qf_updates, qf_opt = qf_tx.update(qf_grads, opt_states.qf, params.qfs)
+        new_qfs = optax.apply_updates(params.qfs, qf_updates)
+        enc_updates, enc_opt = encoder_tx.update(enc_grads_q, opt_states.encoder, params.encoder)
+        new_encoder = optax.apply_updates(params.encoder, enc_updates)
+
+        # ---- conditional target EMAs
+        do_ema = counter % target_freq == 0
+        new_target_qfs = jax.tree_util.tree_map(
+            lambda p, t: jnp.where(do_ema, tau * p + (1 - tau) * t, t), new_qfs, params.target_qfs
+        )
+        new_target_encoder = jax.tree_util.tree_map(
+            lambda p, t: jnp.where(do_ema, encoder_tau * p + (1 - encoder_tau) * t, t),
+            new_encoder,
+            params.target_encoder,
+        )
+
+        # ---- conditional actor + alpha update (detached encoder features)
+        do_actor = counter % actor_freq == 0
+
+        def actor_loss_fn(actor_params):
+            feats = jax.lax.stop_gradient(encoder.apply(new_encoder, obs))
+            m, ls = actor_head.apply(actor_params, feats)
+            acts, logp = actor_action_and_log_prob(m, ls, k_actor, action_scale, action_bias)
+            qs = q_ensemble(new_qfs, feats, acts)
+            min_q = jnp.min(qs, axis=-1, keepdims=True)
+            return policy_loss(alpha, logp, min_q), logp
+
+        (actor_l, logp), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params.actor)
+        actor_grads = jax.tree_util.tree_map(lambda g: jnp.where(do_actor, g, jnp.zeros_like(g)), actor_grads)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states.actor, params.actor)
+        new_actor = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(do_actor, p + u, p), params.actor, actor_updates
+        )
+
+        def alpha_loss_fn(log_alpha):
+            return entropy_loss(log_alpha, jax.lax.stop_gradient(logp), target_entropy)
+
+        alpha_l, alpha_grads = jax.value_and_grad(alpha_loss_fn)(params.log_alpha)
+        alpha_grads = jnp.where(do_actor, alpha_grads, jnp.zeros_like(alpha_grads))
+        alpha_updates, alpha_opt = alpha_tx.update(alpha_grads, opt_states.alpha, params.log_alpha)
+        new_log_alpha = jnp.where(do_actor, params.log_alpha + alpha_updates, params.log_alpha)
+
+        # ---- conditional reconstruction update (encoder + decoder)
+        do_dec = counter % decoder_freq == 0
+
+        def recon_loss_fn(trainable):
+            enc_p, dec_p = trainable
+            hidden = encoder.apply(enc_p, obs)
+            rec = decoder.apply(dec_p, hidden)
+            loss = jnp.float32(0)
+            for k in cnn_dec_keys + mlp_dec_keys:
+                if k in cnn_dec_keys:
+                    target = preprocess_obs(batch[k], k_noise, bits=5)
+                else:
+                    target = batch[k]
+                loss = loss + ((target - rec[k]) ** 2).mean() + l2_lambda * (0.5 * (hidden**2).sum(1)).mean()
+            return loss
+
+        rec_l, (enc_grads_r, dec_grads) = jax.value_and_grad(recon_loss_fn)((new_encoder, params.decoder))
+        enc_grads_r = jax.tree_util.tree_map(lambda g: jnp.where(do_dec, g, jnp.zeros_like(g)), enc_grads_r)
+        dec_grads = jax.tree_util.tree_map(lambda g: jnp.where(do_dec, g, jnp.zeros_like(g)), dec_grads)
+        enc_updates2, enc_opt = encoder_tx.update(enc_grads_r, enc_opt, new_encoder)
+        new_encoder = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(do_dec, p + u, p), new_encoder, enc_updates2
+        )
+        dec_updates, dec_opt = decoder_tx.update(dec_grads, opt_states.decoder, params.decoder)
+        new_decoder = jax.tree_util.tree_map(
+            lambda p, u: jnp.where(do_dec, p + u, p), params.decoder, dec_updates
+        )
+
+        new_params = SACAEParams(
+            encoder=new_encoder,
+            target_encoder=new_target_encoder,
+            qfs=new_qfs,
+            target_qfs=new_target_qfs,
+            actor=new_actor,
+            decoder=new_decoder,
+            log_alpha=new_log_alpha,
+        )
+        new_opt = SACAEOptStates(qf=qf_opt, actor=actor_opt, alpha=alpha_opt, encoder=enc_opt, decoder=dec_opt)
+        return (new_params, new_opt, counter + 1), jnp.stack([qf_l, actor_l, alpha_l, rec_l])
+
+    def train(params, opt_states, batches, key, counter):
+        g = next(iter(batches.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states, counter), losses = jax.lax.scan(
+            single_update, (params, opt_states, counter), (batches, keys)
+        )
+        mean_losses = losses.mean(axis=0)
+        return params, opt_states, counter, {
+            "Loss/value_loss": mean_losses[0],
+            "Loss/policy_loss": mean_losses[1],
+            "Loss/alpha_loss": mean_losses[2],
+            "Loss/reconstruction_loss": mean_losses[3],
+        }
+
+    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in cfg.env.wrapper._target_.lower():
+        raise ValueError("MineDojo is not currently supported by SAC-AE agent.")
+    world_size = runtime.world_size
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    n_envs = cfg.env.num_envs * world_size
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC-AE agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+    if len(obs_keys) == 0:
+        raise RuntimeError("You should specify at least one observation key")
+
+    modules, params, player = build_agent(
+        runtime, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    act_dim = prod(action_space.shape)
+    target_entropy = jnp.float32(-act_dim)
+    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
+    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entropy)
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    opt_states = runtime.replicate(opt_states)
+    update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(1)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // n_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=tuple(obs_keys),
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    last_train = 0
+    train_step = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(n_envs)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    rng = jax.random.PRNGKey(cfg.seed)
+
+    def to_stored(o, k):
+        arr = np.asarray(o[k])
+        if k in cnn_keys:
+            return arr.reshape(n_envs, -1, *arr.shape[-2:])
+        return arr.reshape(n_envs, -1)
+
+    obs = envs.reset(seed=cfg.seed)[0]
+    stored_obs = {k: to_stored(obs, k) for k in obs_keys}
+
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += n_envs
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            if iter_num < learning_starts:
+                actions = envs.action_space.sample()
+            else:
+                rng, act_key = jax.random.split(rng)
+                jax_obs = prepare_obs(runtime, stored_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                actions = np.asarray(player.get_actions(jax_obs, act_key))
+            next_obs, rewards, terminated, truncated, info = envs.step(actions.reshape(envs.action_space.shape))
+            stored_next = {k: to_stored(next_obs, k) for k in obs_keys}
+            real_next = {k: v.copy() for k, v in stored_next.items()}
+            if "final_obs" in info:
+                for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
+                    if fo is not None:
+                        for k in obs_keys:
+                            arr = np.asarray(fo[k])
+                            if k in cnn_keys:
+                                arr = arr.reshape(-1, *arr.shape[-2:])
+                            else:
+                                arr = arr.reshape(-1)
+                            real_next[k][idx] = arr
+
+        step_data = {k: stored_obs[k][np.newaxis] for k in obs_keys}
+        if not cfg.buffer.sample_next_obs:
+            for k in obs_keys:
+                step_data[f"next_{k}"] = real_next[k][np.newaxis]
+        step_data["terminated"] = np.asarray(terminated).reshape(1, n_envs, -1).astype(np.float32)
+        step_data["truncated"] = np.asarray(truncated).reshape(1, n_envs, -1).astype(np.float32)
+        step_data["actions"] = np.asarray(actions).reshape(1, n_envs, -1).astype(np.float32)
+        step_data["rewards"] = np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1)
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        stored_obs = stored_next
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        if iter_num >= learning_starts:
+            per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time", SumMetric()):
+                    g = per_rank_gradient_steps
+                    bs = cfg.algo.per_rank_batch_size * world_size
+                    sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
+                    batches = {
+                        k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]))
+                        for k, v in sample.items()
+                    }
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_states, update_counter, train_metrics = train_fn(
+                        params, opt_states, batches, train_key, update_counter
+                    )
+                    jax.block_until_ready(params.actor)
+                    player.encoder_params = params.encoder
+                    player.actor_params = params.actor
+                train_step += world_size * g
+                if cfg.metric.log_level > 0 and aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]}, policy_step
+                    )
+                if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": jax.device_get(params),
+                "opt_states": jax.device_get(opt_states),
+                "update_counter": int(update_counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

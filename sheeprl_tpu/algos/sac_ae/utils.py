@@ -1,0 +1,67 @@
+"""SAC-AE utilities (reference sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
+
+
+def preprocess_obs(obs: jax.Array, key: jax.Array, bits: int = 8) -> jax.Array:
+    """Bit-reduction + uniform dequantization noise (reference utils.py:68-76,
+    from https://arxiv.org/abs/1807.03039). Input uint8-valued floats [0, 255]."""
+    bins = 2**bits
+    if bits < 8:
+        obs = jnp.floor(obs / 2 ** (8 - bits))
+    obs = obs / bins
+    obs = obs + jax.random.uniform(key, obs.shape, dtype=obs.dtype) / bins
+    return obs - 0.5
+
+
+def prepare_obs(
+    runtime, obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = [], num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """cnn keys -> [0,1] floats with stacked frames folded into channels."""
+    out = {}
+    for k, v in obs.items():
+        arr = np.asarray(v, dtype=np.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(num_envs, -1, *arr.shape[-2:]) / 255.0
+        else:
+            arr = arr.reshape(num_envs, -1)
+        out[k] = jnp.asarray(arr)
+    return out
+
+
+def test(player, runtime, cfg, log_dir: str) -> None:
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    while not done:
+        jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=1)
+        action = np.asarray(player.get_actions(jax_obs, greedy=True))[0]
+        obs, reward, terminated, truncated, _ = env.step(action.reshape(env.action_space.shape))
+        done = terminated or truncated
+        cumulative_rew += reward
+        if cfg.dry_run:
+            done = True
+    if cfg.metric.log_level > 0:
+        runtime.print(f"Test - Reward: {cumulative_rew}")
+        if getattr(runtime, "logger", None) is not None:
+            runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()

@@ -25,7 +25,7 @@ class _DummyBase(gym.Env):
         if dict_obs_space:
             self.observation_space = gym.spaces.Dict(
                 {
-                    "rgb": gym.spaces.Box(0, 256, shape=image_size, dtype=np.uint8),
+                    "rgb": gym.spaces.Box(0, 255, shape=image_size, dtype=np.uint8),
                     "state": gym.spaces.Box(-20, 20, shape=vector_shape, dtype=np.float32),
                 }
             )

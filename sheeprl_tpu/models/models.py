@@ -1,0 +1,383 @@
+"""Composable model library (flax.linen).
+
+Functional parity with reference sheeprl/models/models.py — MLP (:16), CNN (:122),
+DeCNN (:205), NatureCNN (:288), LayerNormGRUCell (:331, Hafner GRU: LayerNorm after
+input projection, update-gate bias -1), MultiEncoder (:413), MultiDecoder (:478),
+LayerNormChannelLast (:507), LayerNorm (:521) — re-designed for TPU:
+
+- convs run in NHWC internally (XLA:TPU's preferred layout for the MXU); the public
+  API keeps the reference's CHW tensors, transposes are fused by XLA;
+- precision policy via ``dtype``/``param_dtype`` fields (params fp32, compute bf16 in
+  'bf16-mixed'); LayerNorms compute in fp32 and cast back (dtype-preserving, like the
+  reference's LayerNorm :521-525);
+- activation/normalization selected by name (configs carry strings, not classes).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ModuleType = Any
+Dtype = Any
+
+_ACTIVATIONS: Dict[str, Callable] = {
+    "relu": jax.nn.relu,
+    "tanh": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "elu": jax.nn.elu,
+    "gelu": jax.nn.gelu,
+    "leaky_relu": jax.nn.leaky_relu,
+    "sigmoid": jax.nn.sigmoid,
+    "identity": lambda x: x,
+    "none": lambda x: x,
+}
+
+
+def get_activation(name: Optional[Union[str, Callable]]) -> Callable:
+    if name is None:
+        return lambda x: x
+    if callable(name):
+        return name
+    key = str(name).rsplit(".", 1)[-1].lower()  # accept "torch.nn.SiLU"-style strings
+    if key not in _ACTIVATIONS:
+        raise ValueError(f"Unknown activation '{name}'. Available: {sorted(_ACTIVATIONS)}")
+    return _ACTIVATIONS[key]
+
+
+def _per_layer(spec, n: int) -> Sequence:
+    """Broadcast a possibly-scalar spec to one entry per layer (reference
+    create_layers, sheeprl/utils/model.py:91)."""
+    if isinstance(spec, (list, tuple)):
+        if len(spec) != n:
+            raise ValueError(f"Per-layer spec length {len(spec)} != number of layers {n}")
+        return list(spec)
+    return [spec] * n
+
+
+def orthogonal_init(scale: float = 2**0.5):
+    return nn.initializers.orthogonal(scale)
+
+
+class LayerNorm(nn.Module):
+    """fp32-computing, dtype-preserving LayerNorm (reference models.py:521-525)."""
+
+    eps: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        input_dtype = x.dtype
+        out = nn.LayerNorm(epsilon=self.eps, use_scale=self.use_scale, use_bias=self.use_bias, dtype=jnp.float32)(
+            x.astype(jnp.float32)
+        )
+        return out.astype(input_dtype)
+
+
+class LayerNormChannelLast(nn.Module):
+    """LayerNorm over the channel axis of an NCHW tensor (reference models.py:507-518).
+
+    Internally permutes to channel-last (free on TPU: layout assignment), normalizes,
+    and permutes back, preserving dtype.
+    """
+
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if x.ndim != 4:
+            raise ValueError(f"Input tensor must be 4D (NCHW), received {x.ndim}D instead: {x.shape}")
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        x = LayerNorm(eps=self.eps)(x)
+        return jnp.transpose(x, (0, 3, 1, 2))
+
+
+class MLP(nn.Module):
+    """MLP backbone (reference models.py:16-119).
+
+    Per-layer dropout -> normalization -> activation, with an optional final linear
+    head (``output_dim``) and optional input flattening from ``flatten_dim``.
+    """
+
+    input_dims: Union[int, Sequence[int]]
+    output_dim: Optional[int] = None
+    hidden_sizes: Sequence[int] = ()
+    activation: Union[str, Sequence[str], Callable, None] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
+    dropout_rate: Union[float, Sequence[float], None] = None
+    flatten_dim: Optional[int] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+    kernel_init: Optional[Callable] = None
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @property
+    def out_features(self) -> int:
+        if self.output_dim is not None:
+            return self.output_dim
+        if len(self.hidden_sizes) == 0:
+            raise ValueError("The number of layers should be at least 1.")
+        return self.hidden_sizes[-1]
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        n = len(self.hidden_sizes)
+        if n < 1 and self.output_dim is None:
+            raise ValueError("The number of layers should be at least 1.")
+        if self.flatten_dim is not None:
+            x = jnp.reshape(x, x.shape[: self.flatten_dim] + (-1,))
+        x = x.astype(self.dtype)
+        acts = _per_layer(self.activation, n)
+        norms = _per_layer(self.layer_norm, n)
+        norm_args = _per_layer(self.norm_args, n)
+        drops = _per_layer(self.dropout_rate, n)
+        kernel_init = self.kernel_init or nn.initializers.lecun_normal()
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(
+                size,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=kernel_init,
+                bias_init=self.bias_init,
+            )(x)
+            if drops[i]:
+                x = nn.Dropout(rate=drops[i])(x, deterministic=deterministic)
+            if norms[i]:
+                x = LayerNorm(**(norm_args[i] or {}))(x)
+            x = get_activation(acts[i])(x)
+        if self.output_dim is not None:
+            x = nn.Dense(
+                self.output_dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=kernel_init,
+                bias_init=self.bias_init,
+            )(x)
+        return x
+
+
+class CNN(nn.Module):
+    """Conv stack (reference models.py:122-202). Input NCHW; compute NHWC on the MXU.
+
+    ``layer_args`` carries per-layer ``kernel_size``/``stride``/``padding`` dicts
+    (torch-style ints accepted).
+    """
+
+    input_channels: int
+    hidden_channels: Sequence[int]
+    layer_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
+    activation: Union[str, Sequence[str], Callable, None] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @staticmethod
+    def _conv_kwargs(args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+        args = dict(args or {})
+        k = args.get("kernel_size", 3)
+        s = args.get("stride", 1)
+        p = args.get("padding", 0)
+        kernel = (k, k) if isinstance(k, int) else tuple(k)
+        strides = (s, s) if isinstance(s, int) else tuple(s)
+        if isinstance(p, str):
+            padding = p.upper()
+        elif isinstance(p, int):
+            padding = [(p, p), (p, p)]
+        else:
+            padding = [tuple(pp) if isinstance(pp, (list, tuple)) else (pp, pp) for pp in p]
+        return {"kernel_size": kernel, "strides": strides, "padding": padding, "use_bias": args.get("bias", True)}
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n = len(self.hidden_channels)
+        acts = _per_layer(self.activation, n)
+        norms = _per_layer(self.layer_norm, n)
+        norm_args = _per_layer(self.norm_args, n)
+        largs = _per_layer(self.layer_args, n)
+        x = jnp.transpose(x.astype(self.dtype), (0, 2, 3, 1))  # NCHW -> NHWC
+        for i, ch in enumerate(self.hidden_channels):
+            x = nn.Conv(ch, dtype=self.dtype, param_dtype=self.param_dtype, **self._conv_kwargs(largs[i]))(x)
+            if norms[i]:
+                x = LayerNorm(**(norm_args[i] or {}))(x)  # channel-last already
+            x = get_activation(acts[i])(x)
+        return jnp.transpose(x, (0, 3, 1, 2))  # back to NCHW
+
+
+class DeCNN(nn.Module):
+    """Transposed-conv stack (reference models.py:205-285). Input/output NCHW."""
+
+    input_channels: int
+    hidden_channels: Sequence[int]
+    layer_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
+    activation: Union[str, Sequence[str], Callable, None] = "relu"
+    layer_norm: Union[bool, Sequence[bool]] = False
+    norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @staticmethod
+    def _deconv_kwargs(args: Optional[Dict[str, Any]]) -> Tuple[Dict[str, Any], int]:
+        args = dict(args or {})
+        k = args.get("kernel_size", 3)
+        s = args.get("stride", 1)
+        p = args.get("padding", 0)
+        op = args.get("output_padding", 0)
+        kernel = (k, k) if isinstance(k, int) else tuple(k)
+        strides = (s, s) if isinstance(s, int) else tuple(s)
+        pad = p if isinstance(p, int) else p[0]
+        return (
+            {"kernel_size": kernel, "strides": strides, "use_bias": args.get("bias", True)},
+            (pad, op),
+        )
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        n = len(self.hidden_channels)
+        acts = _per_layer(self.activation, n)
+        norms = _per_layer(self.layer_norm, n)
+        norm_args = _per_layer(self.norm_args, n)
+        largs = _per_layer(self.layer_args, n)
+        x = jnp.transpose(x.astype(self.dtype), (0, 2, 3, 1))
+        for i, ch in enumerate(self.hidden_channels):
+            kwargs, (pad, out_pad) = self._deconv_kwargs(largs[i])
+            # torch ConvTranspose2d semantics: out = (in-1)*s - 2p + k + out_pad.
+            # flax ConvTranspose with padding=[(k-1-p, k-1-p+out_pad)] matches.
+            kh, _ = kwargs["kernel_size"]
+            lo = kh - 1 - pad
+            x = nn.ConvTranspose(
+                ch,
+                padding=[(lo, lo + out_pad), (lo, lo + out_pad)],
+                transpose_kernel=True,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                **kwargs,
+            )(x)
+            if norms[i]:
+                x = LayerNorm(**(norm_args[i] or {}))(x)
+            x = get_activation(acts[i])(x)
+        return jnp.transpose(x, (0, 3, 1, 2))
+
+
+def cnn_forward(module, params, x: jax.Array, input_dim: Sequence[int], output_dim: Sequence[int], **kwargs):
+    """Batch-flattening conv apply (reference sheeprl/utils/model.py:165-223).
+
+    Flattens all leading dims to one batch axis, applies the module, restores them.
+    """
+    batch_shape = x.shape[: -len(input_dim)]
+    flat = jnp.reshape(x, (-1, *input_dim))
+    out = module.apply(params, flat, **kwargs) if params is not None else module(flat)
+    return jnp.reshape(out, (*batch_shape, *output_dim))
+
+
+class NatureCNN(nn.Module):
+    """DQN-Nature encoder + linear head (reference models.py:288-328)."""
+
+    in_channels: int
+    features_dim: Optional[int] = 512
+    screen_size: int = 64
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        backbone = CNN(
+            input_channels=self.in_channels,
+            hidden_channels=[32, 64, 64],
+            layer_args=[
+                {"kernel_size": 8, "stride": 4},
+                {"kernel_size": 4, "stride": 2},
+                {"kernel_size": 3, "stride": 1},
+            ],
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )
+        batch_shape = x.shape[:-3]
+        flat = jnp.reshape(x, (-1, *x.shape[-3:]))
+        feats = backbone(flat)
+        feats = jnp.reshape(feats, (feats.shape[0], -1))
+        if self.features_dim is not None:
+            feats = nn.Dense(self.features_dim, dtype=self.dtype, param_dtype=self.param_dtype)(feats)
+            feats = jax.nn.relu(feats)
+        return jnp.reshape(feats, (*batch_shape, feats.shape[-1]))
+
+
+class LayerNormGRUCell(nn.Module):
+    """Hafner-variant GRU cell (reference models.py:331-410).
+
+    One fused linear over ``concat(h, x)`` -> LayerNorm -> split into
+    (reset, cand, update); ``update`` gate gets a -1 bias so the cell starts biased
+    toward keeping state. The fused projection is a single MXU matmul per step, which
+    is what makes the `lax.scan`-ed RSSM fast on TPU.
+    """
+
+    hidden_size: int
+    bias: bool = True
+    layer_norm: bool = False
+    dtype: Dtype = jnp.float32
+    param_dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
+        fused = nn.Dense(
+            3 * self.hidden_size,
+            use_bias=self.bias,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+        )(jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1))
+        if self.layer_norm:
+            fused = LayerNorm()(fused)
+        reset, cand, update = jnp.split(fused, 3, axis=-1)
+        reset = jax.nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = jax.nn.sigmoid(update - 1)
+        return update * cand + (1 - update) * h.astype(self.dtype)
+
+
+class MultiEncoder(nn.Module):
+    """Fuse cnn+mlp encoders by concatenating features (reference models.py:413-475)."""
+
+    cnn_encoder: Optional[nn.Module]
+    mlp_encoder: Optional[nn.Module]
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.cnn_encoder is None and self.mlp_encoder is None:
+            raise ValueError("There must be at least one encoder, both cnn and mlp encoders are None")
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array], *args, **kwargs) -> jax.Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs, *args, **kwargs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs, *args, **kwargs))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+class MultiDecoder(nn.Module):
+    """Merge cnn+mlp decoder outputs into one obs dict (reference models.py:478-504)."""
+
+    cnn_decoder: Optional[nn.Module]
+    mlp_decoder: Optional[nn.Module]
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.cnn_decoder is None and self.mlp_decoder is None:
+            raise ValueError("There must be an decoder, both cnn and mlp decoders are None")
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(x))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(x))
+        return out

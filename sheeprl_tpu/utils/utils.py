@@ -249,6 +249,20 @@ class Ratio:
         return self
 
 
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Host-side polynomial decay for coefficients (reference utils.py:120-131)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    return (initial - final) * ((1 - current_step / max_decay_steps) ** power) + final
+
+
 def print_config(cfg: Mapping, indent: int = 0) -> None:
     """Pretty-print the resolved config tree (reference: utils.py:208-237, rich tree)."""
     for key in sorted(cfg.keys()):

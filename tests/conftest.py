@@ -8,12 +8,26 @@ Mirrors the reference's CPU-Gloo multi-process tests (tests/test_algos/test_algo
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# The image pre-sets JAX_PLATFORMS=axon (the TPU tunnel) AND its sitecustomize calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter start, so overriding
+# the env var is not enough — the config itself must be re-pointed at cpu before any
+# backend is initialized.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [
+    f
+    for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", f"tests must run on the CPU mesh, got {jax.devices()}"
+assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.device_count()}"
 
 import pytest  # noqa: E402
 
@@ -32,7 +46,6 @@ def _reset_metric_state():
 @pytest.fixture()
 def standard_args():
     return [
-        "exp=dummy",
         "dry_run=True",
         "env=dummy",
         "env.num_envs=2",

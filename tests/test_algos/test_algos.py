@@ -1,0 +1,206 @@
+"""End-to-end algorithm smoke tests through the real CLI.
+
+Mirrors reference tests/test_algos/test_algos.py: every algorithm runs one iteration
+(dry_run) on 2 sync dummy envs with tiny model dims; the `devices` parametrization
+exercises the multi-device DP path on the virtual CPU mesh (conftest forces
+--xla_force_host_platform_device_count=8, the analogue of the reference's LT_DEVICES
+Gloo tests).
+"""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+
+@pytest.fixture(params=[1, 2])
+def devices(request):
+    return request.param
+
+
+def _run(args):
+    run(overrides=args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_ppo(standard_args, env_id, devices, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo",
+        "env=dummy",
+        f"env.id={env_id}",
+        f"fabric.devices={devices}",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "buffer.memmap=False",
+        "env.num_envs=1",
+    ]
+    _run(args)
+
+
+def test_ppo_vector_only(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=1",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+def test_ppo_checkpoint_written(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=1",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "buffer.memmap=False",
+        "env.num_envs=1",
+        "checkpoint.save_last=True",
+    ]
+    _run(args)
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_a2c(standard_args, env_id, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=a2c",
+        "env=dummy",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac(standard_args, devices, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        f"fabric.devices={devices}",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+def test_sac_rejects_discrete(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=1",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "env.num_envs=1",
+    ]
+    with pytest.raises(ValueError, match="continuous action space"):
+        _run(args)
+
+
+def test_droq(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=droq",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=1",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+def test_sac_ae(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac_ae",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=1",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.dense_units=8",
+        "algo.cnn_channels_multiplier=1",
+        "algo.encoder.features_dim=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=1",
+        "env.screen_size=64",
+        "env.frame_stack=1",
+    ]
+    _run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent(standard_args, env_id, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo_recurrent",
+        "env=dummy",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "algo.rollout_steps=8",
+        "algo.per_rank_sequence_length=4",
+        "algo.per_rank_num_batches=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.rnn.lstm.hidden_size=8",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+    ]
+    _run(args)

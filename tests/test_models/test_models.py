@@ -1,0 +1,179 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.models import (
+    CNN,
+    DeCNN,
+    MLP,
+    LayerNorm,
+    LayerNormChannelLast,
+    LayerNormGRUCell,
+    MultiDecoder,
+    MultiEncoder,
+    NatureCNN,
+    get_activation,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_mlp_shapes():
+    m = MLP(input_dims=10, output_dim=5, hidden_sizes=(32, 32), activation="tanh")
+    params = m.init(KEY, jnp.ones((2, 10)))
+    out = m.apply(params, jnp.ones((2, 10)))
+    assert out.shape == (2, 5)
+    assert m.out_features == 5
+
+
+def test_mlp_no_output_head():
+    m = MLP(input_dims=4, hidden_sizes=(16,))
+    params = m.init(KEY, jnp.ones((3, 4)))
+    assert m.apply(params, jnp.ones((3, 4))).shape == (3, 16)
+    assert m.out_features == 16
+
+
+def test_mlp_requires_layers():
+    m = MLP(input_dims=4)
+    with pytest.raises(ValueError):
+        m.init(KEY, jnp.ones((1, 4)))
+
+
+def test_mlp_flatten():
+    m = MLP(input_dims=(2, 3), hidden_sizes=(8,), flatten_dim=1)
+    params = m.init(KEY, jnp.ones((5, 2, 3)))
+    assert m.apply(params, jnp.ones((5, 2, 3))).shape == (5, 8)
+
+
+def test_mlp_layer_norm_dtype_preserved():
+    m = MLP(input_dims=4, hidden_sizes=(8,), layer_norm=True, dtype=jnp.bfloat16)
+    params = m.init(KEY, jnp.ones((2, 4)))
+    out = m.apply(params, jnp.ones((2, 4), dtype=jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("k,s,p", [(3, 1, 0), (4, 2, 1), (8, 4, 0)])
+def test_cnn_shape_matches_torch_formula(k, s, p):
+    m = CNN(input_channels=3, hidden_channels=[8], layer_args={"kernel_size": k, "stride": s, "padding": p})
+    params = m.init(KEY, jnp.ones((1, 3, 64, 64)))
+    out = m.apply(params, jnp.ones((2, 3, 64, 64)))
+    expected = (64 + 2 * p - k) // s + 1
+    assert out.shape == (2, 8, expected, expected)
+
+
+@pytest.mark.parametrize("k,s,p,op", [(4, 2, 1, 0), (5, 2, 0, 0), (6, 2, 1, 0)])
+def test_decnn_shape_matches_torch(k, s, p, op):
+    import torch
+
+    ref = torch.nn.ConvTranspose2d(4, 8, kernel_size=k, stride=s, padding=p, output_padding=op)
+    expected = ref(torch.zeros(1, 4, 8, 8)).shape[-1]
+    m = DeCNN(
+        input_channels=4,
+        hidden_channels=[8],
+        layer_args={"kernel_size": k, "stride": s, "padding": p, "output_padding": op},
+    )
+    params = m.init(KEY, jnp.ones((1, 4, 8, 8)))
+    out = m.apply(params, jnp.ones((2, 4, 8, 8)))
+    assert out.shape == (2, 8, expected, expected)
+
+
+def test_nature_cnn():
+    m = NatureCNN(in_channels=4, features_dim=512, screen_size=64)
+    params = m.init(KEY, jnp.ones((1, 4, 64, 64)))
+    out = m.apply(params, jnp.ones((3, 4, 64, 64)))
+    assert out.shape == (3, 512)
+
+
+def test_layer_norm_gru_cell_math():
+    cell = LayerNormGRUCell(hidden_size=4, layer_norm=False)
+    x = jnp.ones((2, 3))
+    h = jnp.zeros((2, 4))
+    params = cell.init(KEY, x, h)
+    out = cell.apply(params, x, h)
+    assert out.shape == (2, 4)
+    # replicate the gate math manually
+    kernel = params["params"]["Dense_0"]["kernel"]
+    bias = params["params"]["Dense_0"]["bias"]
+    fused = jnp.concatenate([h, x], -1) @ kernel + bias
+    reset, cand, update = jnp.split(fused, 3, -1)
+    reset = jax.nn.sigmoid(reset)
+    cand = jnp.tanh(reset * cand)
+    update = jax.nn.sigmoid(update - 1)  # -1 update-gate bias (Hafner variant)
+    expected = update * cand + (1 - update) * h
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), rtol=1e-5)
+
+
+def test_layer_norm_gru_keeps_state_when_update_closed():
+    cell = LayerNormGRUCell(hidden_size=8, layer_norm=True)
+    x = jnp.zeros((1, 8))
+    h = jax.random.normal(KEY, (1, 8))
+    params = cell.init(KEY, x, h)
+    out = cell.apply(params, x, h)
+    assert out.shape == h.shape
+
+
+def test_layer_norm_channel_last():
+    ln = LayerNormChannelLast()
+    x = jax.random.normal(KEY, (2, 3, 4, 4), dtype=jnp.float32)
+    params = ln.init(KEY, x)
+    out = ln.apply(params, x)
+    assert out.shape == x.shape
+    # normalized over channels: per-pixel mean ~ 0
+    np.testing.assert_allclose(np.asarray(out.mean(axis=1)), 0.0, atol=1e-5)
+    with pytest.raises(ValueError):
+        ln.apply(params, jnp.ones((2, 3, 4)))
+
+
+def test_multi_encoder_concat():
+    class FakeCNN(jnp.ndarray.__class__):
+        pass
+
+    import flax.linen as nn
+
+    class CnnEnc(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            return jnp.ones((obs["rgb"].shape[0], 4))
+
+    class MlpEnc(nn.Module):
+        @nn.compact
+        def __call__(self, obs):
+            return jnp.ones((obs["state"].shape[0], 3))
+
+    enc = MultiEncoder(cnn_encoder=CnnEnc(), mlp_encoder=MlpEnc())
+    obs = {"rgb": jnp.ones((2, 3, 8, 8)), "state": jnp.ones((2, 5))}
+    params = enc.init(KEY, obs)
+    out = enc.apply(params, obs)
+    assert out.shape == (2, 7)
+
+
+def test_multi_encoder_requires_one():
+    with pytest.raises(ValueError):
+        MultiEncoder(cnn_encoder=None, mlp_encoder=None)
+
+
+def test_multi_decoder_merge():
+    import flax.linen as nn
+
+    class CnnDec(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return {"rgb": jnp.ones((x.shape[0], 3, 8, 8))}
+
+    class MlpDec(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return {"state": jnp.ones((x.shape[0], 5))}
+
+    dec = MultiDecoder(cnn_decoder=CnnDec(), mlp_decoder=MlpDec())
+    params = dec.init(KEY, jnp.ones((2, 16)))
+    out = dec.apply(params, jnp.ones((2, 16)))
+    assert set(out.keys()) == {"rgb", "state"}
+
+
+def test_get_activation_accepts_torch_style_names():
+    assert get_activation("torch.nn.SiLU") is get_activation("silu")
+    assert get_activation("Tanh")(jnp.array(0.5)) == jnp.tanh(0.5)
+    with pytest.raises(ValueError):
+        get_activation("not_an_act")

@@ -1,0 +1,155 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.ops.distributions import (
+    Bernoulli,
+    BernoulliSafeMode,
+    Categorical,
+    Independent,
+    MSEDistribution,
+    MultiCategorical,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_normal_log_prob_matches_torch():
+    import torch
+
+    loc, scale = 0.3, 1.7
+    x = 0.9
+    ours = float(Normal(jnp.array(loc), jnp.array(scale)).log_prob(jnp.array(x)))
+    theirs = float(torch.distributions.Normal(loc, scale).log_prob(torch.tensor(x)))
+    assert ours == pytest.approx(theirs, rel=1e-4)
+
+
+def test_normal_entropy_matches_torch():
+    import torch
+
+    ours = float(Normal(jnp.array(0.0), jnp.array(2.5)).entropy())
+    theirs = float(torch.distributions.Normal(0.0, 2.5).entropy())
+    assert ours == pytest.approx(theirs, rel=1e-4)
+
+
+def test_independent_sums_event_dims():
+    d = Independent(Normal(jnp.zeros((2, 3)), jnp.ones((2, 3))), 1)
+    lp = d.log_prob(jnp.zeros((2, 3)))
+    assert lp.shape == (2,)
+
+
+def test_tanh_normal_log_prob_consistency():
+    d = TanhNormal(jnp.array([0.2]), jnp.array([0.5]))
+    a, logp = d.rsample_and_log_prob(KEY)
+    assert jnp.all(jnp.abs(a) <= 1.0)
+    lp2 = d.log_prob(a)
+    np.testing.assert_allclose(np.asarray(logp), np.asarray(lp2), rtol=1e-3, atol=1e-4)
+
+
+def test_truncated_normal_support():
+    d = TruncatedNormal(jnp.array([0.0]), jnp.array([2.0]))
+    s = d.rsample(KEY, (1000,))
+    assert float(s.min()) >= -1.0 and float(s.max()) <= 1.0
+    assert jnp.isneginf(d.log_prob(jnp.array([1.5]))).all()
+
+
+def test_truncated_normal_matches_torchrl_style_entropy_sign():
+    d = TruncatedNormal(jnp.array([0.0]), jnp.array([1.0]))
+    assert jnp.isfinite(d.entropy()).all()
+    assert float(d.mean[0]) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_categorical_matches_torch():
+    import torch
+
+    logits = [0.1, 1.2, -0.7]
+    ours = Categorical(jnp.array(logits))
+    theirs = torch.distributions.Categorical(logits=torch.tensor(logits))
+    assert float(ours.entropy()) == pytest.approx(float(theirs.entropy()), rel=1e-4)
+    assert float(ours.log_prob(jnp.array(1))) == pytest.approx(float(theirs.log_prob(torch.tensor(1))), rel=1e-4)
+
+
+def test_one_hot_categorical():
+    d = OneHotCategorical(logits=jnp.array([[0.0, 2.0, 0.0]]))
+    s = d.sample(KEY)
+    assert s.shape == (1, 3)
+    assert float(s.sum()) == 1.0
+    assert int(jnp.argmax(d.mode)) == 1
+
+
+def test_straight_through_gradient_flows():
+    def f(logits):
+        d = OneHotCategoricalStraightThrough(logits=logits)
+        return (d.rsample(KEY) * jnp.array([1.0, 2.0, 3.0])).sum()
+
+    g = jax.grad(f)(jnp.array([0.5, 0.2, 0.1]))
+    assert np.abs(np.asarray(g)).sum() > 0  # gradients flow through probs
+
+
+def test_multi_categorical():
+    d = MultiCategorical([jnp.array([[0.0, 1.0]]), jnp.array([[1.0, 0.0, 0.0]])])
+    s = d.sample(KEY)
+    assert s.shape == (1, 2)
+    lp = d.log_prob(s.astype(jnp.int32))
+    assert lp.shape == (1,)
+
+
+def test_bernoulli_safe_mode():
+    d = BernoulliSafeMode(logits=jnp.array([2.0, -2.0]))
+    np.testing.assert_array_equal(np.asarray(d.mode), [1.0, 0.0])
+
+
+def test_bernoulli_log_prob_matches_torch():
+    import torch
+
+    ours = float(Bernoulli(jnp.array(0.7)).log_prob(jnp.array(1.0)))
+    theirs = float(torch.distributions.Bernoulli(logits=torch.tensor(0.7)).log_prob(torch.tensor(1.0)))
+    assert ours == pytest.approx(theirs, rel=1e-3)
+
+
+def test_symlog_distribution():
+    mode = jnp.zeros((4, 3))
+    d = SymlogDistribution(mode, dims=1)
+    lp = d.log_prob(jnp.zeros((4, 3)))
+    assert lp.shape == (4,)
+    np.testing.assert_allclose(np.asarray(lp), 0.0)
+    assert float(d.log_prob(jnp.ones((4, 3))).sum()) < 0
+
+
+def test_mse_distribution():
+    d = MSEDistribution(jnp.ones((2, 5)), dims=1)
+    lp = d.log_prob(jnp.zeros((2, 5)))
+    np.testing.assert_allclose(np.asarray(lp), -5.0)
+
+
+def test_two_hot_distribution_mean_and_log_prob():
+    logits = jnp.zeros((2, 255))
+    d = TwoHotEncodingDistribution(logits, dims=1)
+    assert d.mean.shape == (2, 1)
+    np.testing.assert_allclose(np.asarray(d.mean), 0.0, atol=1e-4)
+    lp = d.log_prob(jnp.array([[3.0], [0.0]]))
+    assert lp.shape == (2,)
+    # uniform logits: log_prob of any scalar is log(1/255)
+    np.testing.assert_allclose(np.asarray(lp), np.log(1 / 255), rtol=1e-4)
+
+
+def test_two_hot_distribution_peaked_recovers_value():
+    # construct logits strongly peaked at the two-hot encoding of 5.0
+    from sheeprl_tpu.utils.utils import symlog
+
+    bins = jnp.linspace(-20, 20, 255)
+    target = 5.0
+    idx = int(jnp.argmin(jnp.abs(bins - symlog(jnp.array(target)))))
+    logits = jnp.full((255,), -20.0).at[idx].set(20.0)
+    d = TwoHotEncodingDistribution(logits[None], dims=1)
+    decoded = float(d.mean[0, 0])
+    expected = float(jnp.sign(bins[idx]) * (jnp.exp(jnp.abs(bins[idx])) - 1))
+    assert decoded == pytest.approx(expected, rel=1e-2)
