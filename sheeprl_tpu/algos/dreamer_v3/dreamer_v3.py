@@ -1,0 +1,703 @@
+"""DreamerV3, coupled training (reference sheeprl/algos/dreamer_v3/dreamer_v3.py:48-393).
+
+TPU-first train step: per iteration the buffer is sampled once for all G gradient
+steps ([G, T, B, *] batch) and ONE jitted call `lax.scan`s over G. Each gradient step
+fuses (a) the world-model update — encoder forward batched over [T,B], RSSM dynamic
+unrolled by `lax.scan` over T (the reference loops in Python, dreamer_v3.py:138-151) —
+(b) the actor update with the H-step imagination `lax.scan` differentiated end-to-end,
+and (c) the two-hot critic update with an in-graph conditional target-critic EMA.
+The batch axis is sharded over the `data` mesh axis; XLA inserts the gradient
+all-reduce over ICI (replacing Fabric DDP), and the Moments quantile runs on the
+global batch (replacing the reference's fabric.all_gather, utils.py:57).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from functools import partial
+from typing import Any, Dict, NamedTuple, Sequence
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput, DV3Modules, build_agent
+from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import (
+    MomentsState,
+    compute_lambda_values,
+    init_moments,
+    prepare_obs,
+    test,
+    update_moments,
+)
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.ops.distributions import (
+    BernoulliSafeMode,
+    Independent,
+    MSEDistribution,
+    OneHotCategorical,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+)
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+
+
+class DV3OptStates(NamedTuple):
+    world: Any
+    actor: Any
+    critic: Any
+
+
+def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, actions_dim: Sequence[int]):
+    """Build (init_opt, train) where train is a single jitted scan over G gradient steps."""
+    rssm = modules.rssm
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    kl_dynamic = float(cfg.algo.world_model.kl_dynamic)
+    kl_representation = float(cfg.algo.world_model.kl_representation)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
+    stoch_size = rssm.stoch_state_size
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = list(cfg.algo.mlp_keys.decoder)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    tau = float(cfg.algo.critic.tau)
+    moments_cfg = cfg.algo.actor.moments
+    data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+
+    world_tx = with_clipping(
+        instantiate(dict(cfg.algo.world_model.optimizer))(), cfg.algo.world_model.clip_gradients
+    )
+    actor_tx = with_clipping(instantiate(dict(cfg.algo.actor.optimizer))(), cfg.algo.actor.clip_gradients)
+    critic_tx = with_clipping(instantiate(dict(cfg.algo.critic.optimizer))(), cfg.algo.critic.clip_gradients)
+
+    def init_opt(params) -> DV3OptStates:
+        return DV3OptStates(
+            world=world_tx.init(params["world_model"]),
+            actor=actor_tx.init(params["actor"]),
+            critic=critic_tx.init(params["critic"]),
+        )
+
+    def one_step(carry, inp):
+        params, opt_states, moments_state, counter = carry
+        data, key = inp
+        data = jax.tree_util.tree_map(lambda v: jax.lax.with_sharding_constraint(v, data_sharding), data)
+        k_wm, k_img0, k_img, k_actor = jax.random.split(key, 4)
+
+        # ---- target critic EMA (reference dreamer_v3.py:740-753): tau=1 on first step
+        def do_ema(tc):
+            tau_eff = jnp.where(counter == 0, 1.0, tau)
+            return jax.tree_util.tree_map(
+                lambda p, tp: tau_eff * p + (1.0 - tau_eff) * tp, params["critic"], tc
+            )
+
+        target_critic = jax.lax.cond(
+            counter % target_freq == 0, do_ema, lambda tc: tc, params["target_critic"]
+        )
+
+        # ---- batch prep (in-graph: uint8 pixels stay uint8 until HBM)
+        batch_obs = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k].astype(jnp.float32) for k in mlp_keys})
+        is_first = data["is_first"].astype(jnp.float32).at[0].set(1.0)
+        actions = data["actions"].astype(jnp.float32)
+        batch_actions = jnp.concatenate([jnp.zeros_like(actions[:1]), actions[:-1]], axis=0)
+        rewards = data["rewards"].astype(jnp.float32)
+        continues_targets = 1.0 - data["terminated"].astype(jnp.float32)
+
+        # ---- world-model update (Eq. 4)
+        def world_loss_fn(wm_params):
+            embedded = modules.encoder.apply(wm_params["encoder"], batch_obs)
+            recurrent_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
+                wm_params, embedded, batch_actions, is_first, k_wm
+            )
+            latent_states = jnp.concatenate(
+                [posteriors.reshape(*posteriors.shape[:-2], -1), recurrent_states], axis=-1
+            )
+            reconstructed = modules.observation_model.apply(wm_params["observation_model"], latent_states)
+            po_log_probs = {
+                k: MSEDistribution(reconstructed[k], dims=reconstructed[k].ndim - 2).log_prob(batch_obs[k])
+                for k in cnn_keys_dec
+            }
+            po_log_probs.update(
+                {
+                    k: SymlogDistribution(reconstructed[k], dims=reconstructed[k].ndim - 2).log_prob(batch_obs[k])
+                    for k in mlp_keys_dec
+                }
+            )
+            pr = TwoHotEncodingDistribution(
+                modules.reward_model.apply(wm_params["reward_model"], latent_states), dims=1
+            )
+            pc = Independent(
+                BernoulliSafeMode(logits=modules.continue_model.apply(wm_params["continue_model"], latent_states)),
+                1,
+            )
+            loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                po_log_probs,
+                pr.log_prob(rewards),
+                priors_logits,
+                posteriors_logits,
+                kl_dynamic,
+                kl_representation,
+                kl_free_nats,
+                kl_regularizer,
+                pc.log_prob(continues_targets),
+                continue_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrent_states": recurrent_states,
+                "priors_logits": priors_logits,
+                "posteriors_logits": posteriors_logits,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return loss, aux
+
+        (world_loss, aux), world_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(params["world_model"])
+        world_grad_norm = optax_global_norm(world_grads)
+        world_updates, world_opt = world_tx.update(world_grads, opt_states.world, params["world_model"])
+        new_wm = apply_updates(params["world_model"], world_updates)
+
+        # ---- behaviour learning: imagination with the freshly-updated world model
+        posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S, D]
+        recurrent_states = jax.lax.stop_gradient(aux["recurrent_states"])  # [T, B, R]
+        start_prior = posteriors.reshape(1, -1, stoch_size)[0]  # [T*B, S*D]
+        start_recurrent = recurrent_states.reshape(1, -1, recurrent_states.shape[-1])[0]
+        true_continue = continues_targets.reshape(-1, 1)  # [T*B, 1]
+
+        def imagine(actor_params, key0, keys):
+            """H+1-step differentiable imagination -> (trajectories, actions, entropies)."""
+            latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
+            out0 = ActorOutput(modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(latent0)))
+            actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)
+
+            def step(carry, k):
+                prior_flat, rec_state, act = carry
+                k_img_step, k_act_step = jax.random.split(k)
+                prior, rec_state = rssm.imagination_step(new_wm, prior_flat, rec_state, act, k_img_step)
+                prior_flat = prior.reshape(prior_flat.shape)
+                latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
+                out = ActorOutput(
+                    modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(latent))
+                )
+                new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
+                return (prior_flat, rec_state, new_act), (latent, new_act)
+
+            _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
+            trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
+            im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
+            return trajectories, im_actions
+
+        img_keys = jax.random.split(k_img, horizon)
+
+        def actor_loss_fn(actor_params):
+            trajectories, im_actions = imagine(actor_params, k_img0, img_keys)
+            predicted_values = TwoHotEncodingDistribution(
+                modules.critic.apply(params["critic"], trajectories), dims=1
+            ).mean
+            predicted_rewards = TwoHotEncodingDistribution(
+                modules.reward_model.apply(new_wm["reward_model"], trajectories), dims=1
+            ).mean
+            continues = Independent(
+                BernoulliSafeMode(logits=modules.continue_model.apply(new_wm["continue_model"], trajectories)), 1
+            ).base.mode
+            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+            lambda_values = compute_lambda_values(
+                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda=lmbda
+            )
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+
+            offset, invscale, new_moments = update_moments(
+                moments_state,
+                lambda_values,
+                decay=float(moments_cfg.decay),
+                max_=float(moments_cfg.max),
+                percentile_low=float(moments_cfg.percentile.low),
+                percentile_high=float(moments_cfg.percentile.high),
+            )
+            baseline = predicted_values[:-1]
+            normed_lambda = (lambda_values - offset) / invscale
+            normed_baseline = (baseline - offset) / invscale
+            advantage = normed_lambda - normed_baseline
+            policies = ActorOutput(
+                modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(trajectories))
+            )
+            if is_continuous:
+                objective = advantage
+            else:
+                splits = np.cumsum(np.asarray(actions_dim))[:-1]
+                action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+                log_probs = sum(
+                    d.log_prob(a) for d, a in zip(policies.dists, action_parts)
+                )  # [H+1, TB]
+                objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
+            try:
+                entropy = ent_coef * policies.entropy()
+            except NotImplementedError:
+                entropy = jnp.zeros(trajectories.shape[:-1], dtype=jnp.float32)
+            policy_loss = -jnp.mean(
+                jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1])
+            )
+            aux_a = {
+                "trajectories": trajectories,
+                "lambda_values": lambda_values,
+                "discount": discount,
+                "moments": new_moments,
+            }
+            return policy_loss, aux_a
+
+        (policy_loss, aux_a), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        actor_grad_norm = optax_global_norm(actor_grads)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states.actor, params["actor"])
+        new_actor = apply_updates(params["actor"], actor_updates)
+
+        # ---- critic update (Eq. 10) on the pre-update-actor trajectories
+        trajectories = jax.lax.stop_gradient(aux_a["trajectories"])
+        lambda_values = jax.lax.stop_gradient(aux_a["lambda_values"])
+        discount = aux_a["discount"]
+
+        def critic_loss_fn(critic_params):
+            qv = TwoHotEncodingDistribution(modules.critic.apply(critic_params, trajectories[:-1]), dims=1)
+            predicted_target_values = TwoHotEncodingDistribution(
+                modules.critic.apply(target_critic, trajectories[:-1]), dims=1
+            ).mean
+            value_loss = -qv.log_prob(lambda_values) - qv.log_prob(
+                jax.lax.stop_gradient(predicted_target_values)
+            )
+            return jnp.mean(value_loss * discount[:-1][..., 0])
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        critic_grad_norm = optax_global_norm(critic_grads)
+        critic_updates, critic_opt = critic_tx.update(critic_grads, opt_states.critic, params["critic"])
+        new_critic = apply_updates(params["critic"], critic_updates)
+
+        post_ent = Independent(OneHotCategorical(logits=aux["posteriors_logits"]), 1).entropy().mean()
+        prior_ent = Independent(OneHotCategorical(logits=aux["priors_logits"]), 1).entropy().mean()
+        new_params = {
+            "world_model": new_wm,
+            "actor": new_actor,
+            "critic": new_critic,
+            "target_critic": target_critic,
+        }
+        metrics = jnp.stack(
+            [
+                world_loss,
+                value_loss,
+                policy_loss,
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                post_ent,
+                prior_ent,
+                world_grad_norm,
+                actor_grad_norm,
+                critic_grad_norm,
+            ]
+        )
+        return (new_params, DV3OptStates(world_opt, actor_opt, critic_opt), aux_a["moments"], counter + 1), metrics
+
+    def train(params, opt_states, moments_state, counter, batches, key):
+        g = next(iter(batches.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states, moments_state, counter), metrics = jax.lax.scan(
+            one_step, (params, opt_states, moments_state, counter), (batches, keys)
+        )
+        m = metrics.mean(axis=0)
+        named = {
+            "Loss/world_model_loss": m[0],
+            "Loss/value_loss": m[1],
+            "Loss/policy_loss": m[2],
+            "Loss/observation_loss": m[3],
+            "Loss/reward_loss": m[4],
+            "Loss/state_loss": m[5],
+            "Loss/continue_loss": m[6],
+            "State/kl": m[7],
+            "State/post_entropy": m[8],
+            "State/prior_entropy": m[9],
+            "Grads/world_model": m[10],
+            "Grads/actor": m[11],
+            "Grads/critic": m[12],
+        }
+        return params, opt_states, moments_state, counter, named
+
+    return init_opt, jax.jit(train, donate_argnums=(0, 1, 2))
+
+
+def optax_global_norm(tree) -> jax.Array:
+    import optax
+
+    return optax.global_norm(tree)
+
+
+def apply_updates(params, updates):
+    import optax
+
+    return optax.apply_updates(params, updates)
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    world_size = runtime.world_size
+    rank = runtime.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    # These arguments cannot be changed (reference dreamer_v3.py:400-403)
+    cfg.env.frame_stack = -1
+    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
+        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    envs = vectorized_env(
+        [
+            partial(
+                RestartOnException,
+                make_env(
+                    cfg,
+                    cfg.seed + rank * cfg.env.num_envs + i,
+                    rank * cfg.env.num_envs,
+                    log_dir if runtime.is_global_zero else None,
+                    "train",
+                    vector_env_idx=i,
+                ),
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if (
+        len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
+        and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
+    ):
+        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
+    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The CNN keys of the decoder must be contained in the encoder ones. "
+            f"Those keys are decoded without being encoded: {list(set(cfg.algo.cnn_keys.decoder))}"
+        )
+    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The MLP keys of the decoder must be contained in the encoder ones. "
+            f"Those keys are decoded without being encoded: {list(set(cfg.algo.mlp_keys.decoder))}"
+        )
+    if cfg.metric.log_level > 0:
+        runtime.print("Encoder CNN keys:", cfg.algo.cnn_keys.encoder)
+        runtime.print("Encoder MLP keys:", cfg.algo.mlp_keys.encoder)
+        runtime.print("Decoder CNN keys:", cfg.algo.cnn_keys.decoder)
+        runtime.print("Decoder MLP keys:", cfg.algo.mlp_keys.decoder)
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    modules, params, player = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+        state["target_critic"] if state else None,
+    )
+
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    moments_state = init_moments()
+    if state and "moments" in state:
+        moments_state = MomentsState(*[jnp.asarray(v) for v in state["moments"]])
+    counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=cfg.env.num_envs,
+        obs_keys=tuple(obs_keys),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    player.init_states()
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act.reshape(-1)]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+                rng, act_key = jax.random.split(rng)
+                actions_list = player.get_actions(jax_obs, act_key, mask=mask)
+                actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+                if is_continuous:
+                    real_actions = actions
+                else:
+                    real_actions = np.stack(
+                        [np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1
+                    )
+
+            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
+        if "restart_on_exception" in infos:
+            for i, agent_roe in enumerate(infos["restart_on_exception"]):
+                if agent_roe and not dones[i]:
+                    last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
+                    rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["terminated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
+                        rb.buffer[i]["truncated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["is_first"][last_inserted_idx]
+                    )
+                    step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                if aggregator:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        # Save the real next observation (terminal obs for autoreset envs)
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+        finals = final_observations(infos, obs_keys)
+        if finals:
+            for idx, final_obs in finals.items():
+                for k, v in final_obs.items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        obs = next_obs
+
+        rewards = np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["rewards"] = clip_rewards_fn(rewards)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+
+            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
+            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
+            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
+            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+            player.init_states(dones_idxes)
+
+        # ---- training phase
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time", SumMetric()):
+                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_states, moments_state, counter, train_metrics = train_fn(
+                        params, opt_states, moments_state, counter, batches, train_key
+                    )
+                    jax.block_until_ready(params["actor"])
+                    player.wm_params = params["world_model"]
+                    player.actor_params = params["actor"]
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step += world_size * per_rank_gradient_steps
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+
+        # ---- logging
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if logger and policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger and timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        # ---- checkpoint
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": jax.device_get(params["world_model"]),
+                "actor": jax.device_get(params["actor"]),
+                "critic": jax.device_get(params["critic"]),
+                "target_critic": jax.device_get(params["target_critic"]),
+                "opt_states": jax.device_get(opt_states),
+                "moments": tuple(np.asarray(v) for v in moments_state),
+                "counter": int(counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, runtime, cfg, log_dir, greedy=False)
+    if logger:
+        logger.finalize()

@@ -103,6 +103,9 @@ class MLP(nn.Module):
 
     Per-layer dropout -> normalization -> activation, with an optional final linear
     head (``output_dim``) and optional input flattening from ``flatten_dim``.
+    ``use_bias`` applies to the hidden layers only (like the reference's
+    ``layer_args``); the output head always has a bias, matching the reference's
+    plain ``nn.Linear`` head.
     """
 
     input_dims: Union[int, Sequence[int]]
@@ -113,6 +116,7 @@ class MLP(nn.Module):
     norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
     dropout_rate: Union[float, Sequence[float], None] = None
     flatten_dim: Optional[int] = None
+    use_bias: Union[bool, Sequence[bool]] = True
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     kernel_init: Optional[Callable] = None
@@ -138,10 +142,12 @@ class MLP(nn.Module):
         norms = _per_layer(self.layer_norm, n)
         norm_args = _per_layer(self.norm_args, n)
         drops = _per_layer(self.dropout_rate, n)
+        biases = _per_layer(self.use_bias, n)
         kernel_init = self.kernel_init or nn.initializers.lecun_normal()
         for i, size in enumerate(self.hidden_sizes):
             x = nn.Dense(
                 size,
+                use_bias=biases[i],
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
                 kernel_init=kernel_init,
@@ -178,6 +184,7 @@ class CNN(nn.Module):
     norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    kernel_init: Optional[Callable] = None
 
     @staticmethod
     def _conv_kwargs(args: Optional[Dict[str, Any]]) -> Dict[str, Any]:
@@ -204,7 +211,13 @@ class CNN(nn.Module):
         largs = _per_layer(self.layer_args, n)
         x = jnp.transpose(x.astype(self.dtype), (0, 2, 3, 1))  # NCHW -> NHWC
         for i, ch in enumerate(self.hidden_channels):
-            x = nn.Conv(ch, dtype=self.dtype, param_dtype=self.param_dtype, **self._conv_kwargs(largs[i]))(x)
+            x = nn.Conv(
+                ch,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=self.kernel_init or nn.linear.default_kernel_init,
+                **self._conv_kwargs(largs[i]),
+            )(x)
             if norms[i]:
                 x = LayerNorm(**(norm_args[i] or {}))(x)  # channel-last already
             x = get_activation(acts[i])(x)
@@ -222,6 +235,7 @@ class DeCNN(nn.Module):
     norm_args: Optional[Union[Dict[str, Any], Sequence[Dict[str, Any]]]] = None
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    kernel_init: Optional[Union[Callable, Sequence[Optional[Callable]]]] = None
 
     @staticmethod
     def _deconv_kwargs(args: Optional[Dict[str, Any]]) -> Tuple[Dict[str, Any], int]:
@@ -252,12 +266,16 @@ class DeCNN(nn.Module):
             # flax ConvTranspose with padding=[(k-1-p, k-1-p+out_pad)] matches.
             kh, _ = kwargs["kernel_size"]
             lo = kh - 1 - pad
+            ki = self.kernel_init
+            if isinstance(ki, (list, tuple)):
+                ki = ki[i]
             x = nn.ConvTranspose(
                 ch,
                 padding=[(lo, lo + out_pad), (lo, lo + out_pad)],
                 transpose_kernel=True,
                 dtype=self.dtype,
                 param_dtype=self.param_dtype,
+                kernel_init=ki or nn.linear.default_kernel_init,
                 **kwargs,
             )(x)
             if norms[i]:
@@ -323,6 +341,7 @@ class LayerNormGRUCell(nn.Module):
     layer_norm: bool = False
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
+    kernel_init: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
@@ -331,6 +350,7 @@ class LayerNormGRUCell(nn.Module):
             use_bias=self.bias,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
+            kernel_init=self.kernel_init or nn.linear.default_kernel_init,
         )(jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1))
         if self.layer_norm:
             fused = LayerNorm()(fused)
