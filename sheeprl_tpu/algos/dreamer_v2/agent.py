@@ -1,0 +1,885 @@
+"""DreamerV2 agent: encoders/decoders, RSSM, actor, player (flax + lax.scan).
+
+Parity targets (reference sheeprl/algos/dreamer_v2/agent.py): CNNEncoder (:39),
+MLPEncoder (:93), CNNDecoder (:143), MLPDecoder (:218), RecurrentModel (:274),
+RSSM (:331), Actor (:455), MinedojoActor (:626), WorldModel (:776), PlayerDV2 (:804),
+build_agent (:916), xavier init (dreamer_v2/utils.py:init_weights).
+
+TPU-first design decisions (shared with the DV3 port):
+- The RSSM is composed of small flax modules driven by pure scan functions; the
+  T-step dynamic unroll compiles to ONE `lax.scan` (the reference loops in Python,
+  dreamer_v2.py:144-157).
+- Params are plain dict pytrees so world model / actor / critic are optax leaves.
+- The player's policy step is one jitted pure function over explicit
+  (recurrent, stochastic, action) state.
+
+Differences from DV3 kept for parity with DV2's semantics: ELU activations, no
+unimix, zero (non-learnable) initial states, gaussian observation/reward heads
+(Normal(mean, 1)), KL balancing with a single alpha, truncated-normal continuous
+actor, and epsilon-greedy/gaussian exploration noise on top of the policy.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import MLP, CNN, DeCNN, LayerNormGRUCell
+from sheeprl_tpu.ops.distributions import (
+    Independent,
+    Normal,
+    OneHotCategorical,
+    OneHotCategoricalStraightThrough,
+    TanhNormal,
+    TruncatedNormal,
+)
+
+# Reference init_weights (dreamer_v2/utils.py:64-81): xavier-normal on every
+# conv/linear weight, zero biases.
+xavier_normal_init = nn.initializers.glorot_normal()
+
+
+def compute_stochastic_state(
+    logits: jax.Array, discrete: int, key: Optional[jax.Array] = None, sample: bool = True
+) -> jax.Array:
+    """Straight-through sample (or mode) of the categorical stochastic state.
+
+    Reference: sheeprl/algos/dreamer_v2/utils.py:44-61. Input ``[..., stoch*discrete]``,
+    output ``[..., stoch, discrete]``.
+    """
+    logits = logits.reshape(*logits.shape[:-1], -1, discrete)
+    dist = OneHotCategoricalStraightThrough(logits=logits)
+    if sample:
+        return dist.rsample(key)
+    return dist.mode
+
+
+class CNNEncoderDV2(nn.Module):
+    """4-stage stride-2 kernel-4 VALID-padding image encoder (reference agent.py:39-91).
+
+    64x64 -> 31 -> 14 -> 6 -> 2 spatial; output flattened.
+    """
+
+    keys: Sequence[str]
+    input_channels: Sequence[int]
+    image_size: Tuple[int, int]
+    channels_multiplier: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def spatial_dims(self) -> Tuple[int, int]:
+        h, w = self.image_size
+        for _ in range(4):
+            h = (h - 4) // 2 + 1
+            w = (w - 4) // 2 + 1
+        return h, w
+
+    @property
+    def output_dim(self) -> int:
+        h, w = self.spatial_dims
+        return 8 * self.channels_multiplier * h * w
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-3)
+        batch_shape = x.shape[:-3]
+        x = x.reshape(-1, *x.shape[-3:])
+        x = CNN(
+            input_channels=sum(self.input_channels),
+            hidden_channels=[m * self.channels_multiplier for m in (1, 2, 4, 8)],
+            layer_args={"kernel_size": 4, "stride": 2, "padding": 0, "bias": not self.layer_norm},
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+        x = x.reshape(x.shape[0], -1)
+        return x.reshape(*batch_shape, x.shape[-1])
+
+
+class MLPEncoderDV2(nn.Module):
+    """Vector encoder, raw inputs (no symlog; reference agent.py:93-141)."""
+
+    keys: Sequence[str]
+    input_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @property
+    def output_dim(self) -> int:
+        return self.dense_units
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        x = jnp.concatenate([obs[k] for k in self.keys], axis=-1)
+        return MLP(
+            input_dims=sum(self.input_dims),
+            output_dim=None,
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            use_bias=not self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+
+
+class MultiEncoderDV2(nn.Module):
+    cnn_encoder: Optional[CNNEncoderDV2]
+    mlp_encoder: Optional[MLPEncoderDV2]
+
+    @property
+    def output_dim(self) -> int:
+        out = 0
+        if self.cnn_encoder is not None:
+            out += self.cnn_encoder.output_dim
+        if self.mlp_encoder is not None:
+            out += self.mlp_encoder.output_dim
+        return out
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        outs = []
+        if self.cnn_encoder is not None:
+            outs.append(self.cnn_encoder(obs))
+        if self.mlp_encoder is not None:
+            outs.append(self.mlp_encoder(obs))
+        return outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=-1)
+
+
+class CNNDecoderDV2(nn.Module):
+    """Latent -> Linear -> (C,1,1) -> 4 transposed convs (k 5,5,6,6, stride 2) ->
+    image dict (reference agent.py:143-216)."""
+
+    keys: Sequence[str]
+    output_channels: Sequence[int]
+    channels_multiplier: int
+    cnn_encoder_output_dim: int
+    image_size: Tuple[int, int]
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        batch_shape = latent_states.shape[:-1]
+        x = latent_states.reshape(-1, latent_states.shape[-1])
+        x = nn.Dense(
+            self.cnn_encoder_output_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+        out_ch = sum(self.output_channels)
+        x = x.reshape(-1, self.cnn_encoder_output_dim, 1, 1)
+        x = DeCNN(
+            input_channels=self.cnn_encoder_output_dim,
+            hidden_channels=[m * self.channels_multiplier for m in (4, 2, 1)] + [out_ch],
+            layer_args=[
+                {"kernel_size": 5, "stride": 2},
+                {"kernel_size": 5, "stride": 2},
+                {"kernel_size": 6, "stride": 2},
+                {"kernel_size": 6, "stride": 2},
+            ],
+            activation=[self.activation] * 3 + [None],
+            layer_norm=[self.layer_norm] * 3 + [False],
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+        x = x.reshape(*batch_shape, out_ch, *self.image_size)
+        out: Dict[str, jax.Array] = {}
+        start = 0
+        for k, ch in zip(self.keys, self.output_channels):
+            out[k] = x[..., start : start + ch, :, :]
+            start += ch
+        return out
+
+
+class MLPDecoderDV2(nn.Module):
+    """Latent -> MLP -> per-key linear heads (reference agent.py:218-272)."""
+
+    keys: Sequence[str]
+    output_dims: Sequence[int]
+    mlp_layers: int = 4
+    dense_units: int = 400
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        x = MLP(
+            input_dims=latent_states.shape[-1],
+            output_dim=None,
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            use_bias=not self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(latent_states)
+        return {
+            k: nn.Dense(
+                dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=xavier_normal_init,
+                name=f"head_{k}",
+            )(x)
+            for k, dim in zip(self.keys, self.output_dims)
+        }
+
+
+class MultiDecoderDV2(nn.Module):
+    cnn_decoder: Optional[CNNDecoderDV2]
+    mlp_decoder: Optional[MLPDecoderDV2]
+
+    @nn.compact
+    def __call__(self, latent_states: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_decoder is not None:
+            out.update(self.cnn_decoder(latent_states))
+        if self.mlp_decoder is not None:
+            out.update(self.mlp_decoder(latent_states))
+        return out
+
+
+class RecurrentModelDV2(nn.Module):
+    """MLP projection + LayerNorm GRU with bias (reference agent.py:274-329).
+
+    The GRU always layer-norms its fused projection (the reference hard-codes
+    ``layer_norm_cls=nn.LayerNorm`` in the cell); ``layer_norm`` toggles only the
+    input-MLP norm.
+    """
+
+    input_size: int
+    recurrent_state_size: int
+    dense_units: int
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            input_dims=self.input_size,
+            output_dim=None,
+            hidden_sizes=[self.dense_units],
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            use_bias=not self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+        return LayerNormGRUCell(
+            hidden_size=self.recurrent_state_size,
+            bias=True,
+            layer_norm=True,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(feat, recurrent_state)
+
+
+class MLPWithHeadDV2(nn.Module):
+    """MLP trunk + linear head (representation/transition/reward/continue/critic)."""
+
+    input_dim: int
+    hidden_sizes: Sequence[int]
+    output_dim: int
+    activation: str = "elu"
+    layer_norm: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if len(self.hidden_sizes) > 0:
+            x = MLP(
+                input_dims=self.input_dim,
+                output_dim=None,
+                hidden_sizes=self.hidden_sizes,
+                activation=self.activation,
+                layer_norm=self.layer_norm,
+                use_bias=not self.layer_norm,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=xavier_normal_init,
+            )(x)
+        return nn.Dense(
+            self.output_dim,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+            name="head",
+        )(x)
+
+
+class RSSMDV2:
+    """Pure-functional DV2 RSSM (reference agent.py:331-453).
+
+    No unimix, no learnable initial state: on ``is_first`` the carried state is
+    zeroed (reference dynamic(), agent.py:398-401).
+    """
+
+    def __init__(
+        self,
+        recurrent_model: RecurrentModelDV2,
+        representation_model: MLPWithHeadDV2,
+        transition_model: MLPWithHeadDV2,
+        stochastic_size: int,
+        discrete_size: int = 32,
+    ):
+        self.recurrent_model = recurrent_model
+        self.representation_model = representation_model
+        self.transition_model = transition_model
+        self.stochastic_size = stochastic_size
+        self.discrete_size = discrete_size
+
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size * self.discrete_size
+
+    def _transition(self, wm_params, recurrent_out, key=None, sample=True):
+        logits = self.transition_model.apply(wm_params["transition_model"], recurrent_out)
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample)
+
+    def _representation(self, wm_params, recurrent_state, embedded_obs, key=None, sample=True):
+        logits = self.representation_model.apply(
+            wm_params["representation_model"], jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        return logits, compute_stochastic_state(logits, self.discrete_size, key, sample=sample)
+
+    def _recurrent(self, wm_params, stoch_flat, action, recurrent_state):
+        x = jnp.concatenate([stoch_flat, action], axis=-1)
+        return self.recurrent_model.apply(wm_params["recurrent_model"], x, recurrent_state)
+
+    def dynamic_step(self, wm_params, posterior_flat, recurrent_state, action, embedded_obs, is_first, key):
+        """One step of dynamic learning (reference agent.py:363-404)."""
+        k_prior, k_post = jax.random.split(key)
+        action = (1 - is_first) * action
+        posterior_flat = (1 - is_first) * posterior_flat
+        recurrent_state = (1 - is_first) * recurrent_state
+        recurrent_state = self._recurrent(wm_params, posterior_flat, action, recurrent_state)
+        prior_logits, prior = self._transition(wm_params, recurrent_state, k_prior)
+        posterior_logits, posterior = self._representation(wm_params, recurrent_state, embedded_obs, k_post)
+        return recurrent_state, posterior, prior, posterior_logits, prior_logits
+
+    def dynamic_scan(self, wm_params, embedded_obs, actions, is_first, key):
+        """lax.scan over T (reference loops in Python, dreamer_v2.py:144-157)."""
+        T, B = embedded_obs.shape[0], embedded_obs.shape[1]
+        keys = jax.random.split(key, T)
+        init_rec = jnp.zeros((B, self.recurrent_model.recurrent_state_size), dtype=embedded_obs.dtype)
+        init_post = jnp.zeros((B, self.stoch_state_size), dtype=embedded_obs.dtype)
+
+        def step(carry, xs):
+            recurrent_state, posterior_flat = carry
+            action, embedded, is_f, k = xs
+            recurrent_state, posterior, _, post_logits, prior_logits = self.dynamic_step(
+                wm_params, posterior_flat, recurrent_state, action, embedded, is_f, k
+            )
+            new_carry = (recurrent_state, posterior.reshape(*posterior.shape[:-2], -1))
+            return new_carry, (recurrent_state, posterior, post_logits, prior_logits)
+
+        _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
+            step, (init_rec, init_post), (actions, embedded_obs, is_first, keys)
+        )
+        return recurrent_states, posteriors, priors_logits, posteriors_logits
+
+    def imagination_step(self, wm_params, prior_flat, recurrent_state, actions, key):
+        """One-step latent imagination (reference agent.py:434-453)."""
+        recurrent_state = self._recurrent(wm_params, prior_flat, actions, recurrent_state)
+        _, imagined_prior = self._transition(wm_params, recurrent_state, key)
+        return imagined_prior.reshape(*prior_flat.shape), recurrent_state
+
+
+class ActorDV2(nn.Module):
+    """DV2 actor trunk + heads (reference agent.py:455-543)."""
+
+    latent_state_size: int
+    actions_dim: Sequence[int]
+    is_continuous: bool
+    distribution: str = "auto"
+    init_std: float = 0.0
+    min_std: float = 0.1
+    dense_units: int = 400
+    mlp_layers: int = 4
+    layer_norm: bool = False
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    def resolved_distribution(self) -> str:
+        dist = self.distribution.lower()
+        if dist not in ("auto", "normal", "tanh_normal", "discrete", "trunc_normal"):
+            raise ValueError(
+                "The distribution must be on of: `auto`, `discrete`, `normal`, `tanh_normal` and `trunc_normal`. "
+                f"Found: {dist}"
+            )
+        if dist == "discrete" and self.is_continuous:
+            raise ValueError("You have choose a discrete distribution but `is_continuous` is true")
+        if dist == "auto":
+            dist = "trunc_normal" if self.is_continuous else "discrete"
+        return dist
+
+    @nn.compact
+    def __call__(self, state: jax.Array) -> List[jax.Array]:
+        x = MLP(
+            input_dims=self.latent_state_size,
+            output_dim=None,
+            hidden_sizes=[self.dense_units] * self.mlp_layers,
+            activation=self.activation,
+            layer_norm=self.layer_norm,
+            use_bias=not self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(state)
+        if self.is_continuous:
+            return [
+                nn.Dense(
+                    int(np.sum(self.actions_dim)) * 2,
+                    dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    kernel_init=xavier_normal_init,
+                    name="head_0",
+                )(x)
+            ]
+        return [
+            nn.Dense(
+                dim,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                kernel_init=xavier_normal_init,
+                name=f"head_{i}",
+            )(x)
+            for i, dim in enumerate(self.actions_dim)
+        ]
+
+
+class ActorOutputDV2:
+    """Distribution wrapper over the DV2 actor's raw outputs (reference agent.py:550-603)."""
+
+    def __init__(self, actor: ActorDV2, pre_dist: List[jax.Array]):
+        self.actor = actor
+        self.dist_type = actor.resolved_distribution()
+        self.pre_dist = pre_dist
+        if actor.is_continuous:
+            mean, std = jnp.split(pre_dist[0], 2, axis=-1)
+            if self.dist_type == "tanh_normal":
+                mean = 5 * jnp.tanh(mean / 5)
+                std = jax.nn.softplus(std + actor.init_std) + actor.min_std
+                self.dists = [Independent(TanhNormal(mean, std), 1)]
+            elif self.dist_type == "normal":
+                self.dists = [Independent(Normal(mean, std), 1)]
+            else:  # trunc_normal
+                std = 2 * jax.nn.sigmoid((std + actor.init_std) / 2) + actor.min_std
+                self.dists = [Independent(TruncatedNormal(jnp.tanh(mean), std, -1.0, 1.0), 1)]
+        else:
+            self.dists = [OneHotCategoricalStraightThrough(logits=logits) for logits in pre_dist]
+
+    def sample_actions(self, key: jax.Array, greedy: bool = False) -> List[jax.Array]:
+        if self.actor.is_continuous:
+            if greedy:
+                # Reference draws 100 samples and keeps the max-log-prob one
+                # (agent.py:587-590); the distribution mean is the deterministic
+                # equivalent for the unimodal trunc-normal.
+                return [self.dists[0].mode]
+            return [self.dists[0].rsample(key)]
+        keys = jax.random.split(key, len(self.dists))
+        if greedy:
+            return [d.mode for d in self.dists]
+        return [d.rsample(k) for d, k in zip(self.dists, keys)]
+
+    def log_prob(self, actions: List[jax.Array]) -> jax.Array:
+        return sum(d.log_prob(a) for d, a in zip(self.dists, actions))
+
+    def entropy(self) -> jax.Array:
+        return sum(d.entropy() for d in self.dists)
+
+
+def expl_amount_schedule(amount: float, decay: float, minimum: float, step: int) -> float:
+    """Exponential half-life decay of the exploration amount.
+
+    Reference Actor._get_expl_amount (agent.py:544-548); implemented with the
+    intended half-life semantics ``amount * 0.5**(step/decay)``.
+    """
+    if decay:
+        amount = amount * 0.5 ** (float(step) / float(decay))
+    return max(amount, minimum)
+
+
+def add_exploration_noise(
+    actions: List[jax.Array],
+    expl_amount: jax.Array,
+    is_continuous: bool,
+    actions_dim: Sequence[int],
+    key: jax.Array,
+) -> List[jax.Array]:
+    """Gaussian (continuous) / epsilon-random (discrete) exploration noise.
+
+    Reference Actor.add_exploration_noise (agent.py:605-623). ``expl_amount`` is a
+    traced scalar so the decay schedule does not trigger recompiles; amount 0 is a
+    no-op by construction.
+    """
+    if is_continuous:
+        cat = jnp.concatenate(actions, axis=-1)
+        noisy = jnp.clip(cat + expl_amount * jax.random.normal(key, cat.shape), -1, 1)
+        return [noisy]
+    out = []
+    for i, act in enumerate(actions):
+        k_sample, k_mask, key = jax.random.split(key, 3)
+        random_act = OneHotCategorical(logits=jnp.zeros_like(act)).sample(k_sample)
+        mask = jax.random.uniform(k_mask, act.shape[:1]) < expl_amount
+        out.append(jnp.where(mask[..., None], random_act, act))
+    return out
+
+
+class PlayerDV2:
+    """Stateful host-side rollout policy over a single jitted step (reference agent.py:804-914)."""
+
+    def __init__(
+        self,
+        encoder: MultiEncoderDV2,
+        rssm: RSSMDV2,
+        actor: ActorDV2,
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        discrete_size: int = 32,
+        actor_type: Optional[str] = None,
+    ):
+        self.encoder = encoder
+        self.rssm = rssm
+        self.actor = actor
+        self.actions_dim = tuple(actions_dim)
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.recurrent_state_size = recurrent_state_size
+        self.discrete_size = discrete_size
+        self.actor_type = actor_type
+        self.expl_amount = 0.0
+        self.wm_params: Any = None
+        self.actor_params: Any = None
+        self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+
+    def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False):
+        recurrent_state, stochastic_state, actions = state
+        k_rep, k_act, k_expl = jax.random.split(key, 3)
+        embedded = self.encoder.apply(wm_params["encoder"], obs)
+        recurrent_state = self.rssm._recurrent(wm_params, stochastic_state, actions, recurrent_state)
+        _, stoch = self.rssm._representation(wm_params, recurrent_state, embedded, k_rep)
+        stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
+        latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
+        out = ActorOutputDV2(self.actor, self.actor.apply(actor_params, latent))
+        actions_list = out.sample_actions(k_act, greedy=greedy)
+        actions_list = add_exploration_noise(
+            actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
+        )
+        actions = jnp.concatenate(actions_list, axis=-1)
+        return tuple(actions_list), (recurrent_state, stochastic_state, actions)
+
+    def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
+        if reset_envs is None or len(reset_envs) == 0:
+            self.state = (
+                jnp.zeros((1, self.num_envs, self.recurrent_state_size), dtype=jnp.float32),
+                jnp.zeros((1, self.num_envs, self.stochastic_size * self.discrete_size), dtype=jnp.float32),
+                jnp.zeros((1, self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32),
+            )
+        else:
+            recurrent_state, stochastic_state, actions = self.state
+            reset = np.zeros((self.num_envs,), dtype=bool)
+            reset[np.asarray(reset_envs)] = True
+            mask = jnp.asarray(reset)[None, :, None]
+            self.state = (
+                jnp.where(mask, 0.0, recurrent_state),
+                jnp.where(mask, 0.0, stochastic_state),
+                jnp.where(mask, 0.0, actions),
+            )
+
+    def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
+        del mask
+        actions_list, self.state = self._step(
+            self.wm_params,
+            self.actor_params,
+            self.state,
+            obs,
+            key,
+            jnp.float32(self.expl_amount),
+            greedy=greedy,
+        )
+        return actions_list
+
+
+class DV2Modules(NamedTuple):
+    """Static module definitions shared by the train step and the player."""
+
+    encoder: MultiEncoderDV2
+    rssm: RSSMDV2
+    observation_model: MultiDecoderDV2
+    reward_model: MLPWithHeadDV2
+    continue_model: Optional[MLPWithHeadDV2]
+    actor: ActorDV2
+    critic: MLPWithHeadDV2
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV2Modules, Dict[str, Any], PlayerDV2]:
+    """Build module defs + init params (reference agent.py:916-1163).
+
+    Returns (modules, params, player); params has keys ``world_model``, ``actor``,
+    ``critic``, ``target_critic``.
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+
+    recurrent_state_size = int(world_model_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(world_model_cfg.stochastic_size) * int(world_model_cfg.discrete_size)
+    latent_state_size = stochastic_size + recurrent_state_size
+    compute_dtype = runtime.compute_dtype
+    param_dtype = jnp.float32
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_encoder = (
+        CNNEncoderDV2(
+            keys=cnn_keys,
+            input_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys],
+            image_size=tuple(obs_space[cnn_keys[0]].shape[-2:]),
+            channels_multiplier=int(world_model_cfg.encoder.cnn_channels_multiplier),
+            layer_norm=bool(world_model_cfg.encoder.layer_norm),
+            activation=world_model_cfg.encoder.cnn_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(cnn_keys) > 0
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoderDV2(
+            keys=mlp_keys,
+            input_dims=[int(obs_space[k].shape[0]) for k in mlp_keys],
+            mlp_layers=int(world_model_cfg.encoder.mlp_layers),
+            dense_units=int(world_model_cfg.encoder.dense_units),
+            layer_norm=bool(world_model_cfg.encoder.layer_norm),
+            activation=world_model_cfg.encoder.dense_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(mlp_keys) > 0
+        else None
+    )
+    encoder = MultiEncoderDV2(cnn_encoder, mlp_encoder)
+
+    recurrent_model = RecurrentModelDV2(
+        input_size=int(sum(actions_dim) + stochastic_size),
+        recurrent_state_size=recurrent_state_size,
+        dense_units=int(world_model_cfg.recurrent_model.dense_units),
+        layer_norm=bool(world_model_cfg.recurrent_model.layer_norm),
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    repr_input = recurrent_state_size + encoder.output_dim
+    representation_model = MLPWithHeadDV2(
+        input_dim=repr_input,
+        hidden_sizes=[int(world_model_cfg.representation_model.hidden_size)],
+        output_dim=stochastic_size,
+        activation=world_model_cfg.representation_model.dense_act,
+        layer_norm=bool(world_model_cfg.representation_model.layer_norm),
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    transition_model = MLPWithHeadDV2(
+        input_dim=recurrent_state_size,
+        hidden_sizes=[int(world_model_cfg.transition_model.hidden_size)],
+        output_dim=stochastic_size,
+        activation=world_model_cfg.transition_model.dense_act,
+        layer_norm=bool(world_model_cfg.transition_model.layer_norm),
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    rssm = RSSMDV2(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        stochastic_size=int(world_model_cfg.stochastic_size),
+        discrete_size=int(world_model_cfg.discrete_size),
+    )
+
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = list(cfg.algo.mlp_keys.decoder)
+    cnn_decoder = (
+        CNNDecoderDV2(
+            keys=cnn_keys_dec,
+            output_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys_dec],
+            channels_multiplier=int(world_model_cfg.observation_model.cnn_channels_multiplier),
+            cnn_encoder_output_dim=cnn_encoder.output_dim,
+            image_size=tuple(obs_space[cnn_keys_dec[0]].shape[-2:]),
+            layer_norm=bool(world_model_cfg.observation_model.layer_norm),
+            activation=world_model_cfg.observation_model.cnn_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(cnn_keys_dec) > 0
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoderDV2(
+            keys=mlp_keys_dec,
+            output_dims=[int(obs_space[k].shape[0]) for k in mlp_keys_dec],
+            mlp_layers=int(world_model_cfg.observation_model.mlp_layers),
+            dense_units=int(world_model_cfg.observation_model.dense_units),
+            layer_norm=bool(world_model_cfg.observation_model.layer_norm),
+            activation=world_model_cfg.observation_model.dense_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(mlp_keys_dec) > 0
+        else None
+    )
+    observation_model = MultiDecoderDV2(cnn_decoder, mlp_decoder)
+
+    reward_model = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(world_model_cfg.reward_model.dense_units)] * int(world_model_cfg.reward_model.mlp_layers),
+        output_dim=1,
+        activation=world_model_cfg.reward_model.dense_act,
+        layer_norm=bool(world_model_cfg.reward_model.layer_norm),
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    continue_model = (
+        MLPWithHeadDV2(
+            input_dim=latent_state_size,
+            hidden_sizes=[int(world_model_cfg.discount_model.dense_units)]
+            * int(world_model_cfg.discount_model.mlp_layers),
+            output_dim=1,
+            activation=world_model_cfg.discount_model.dense_act,
+            layer_norm=bool(world_model_cfg.discount_model.layer_norm),
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if world_model_cfg.use_continues
+        else None
+    )
+
+    actor = ActorDV2(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=bool(actor_cfg.layer_norm),
+        activation=actor_cfg.dense_act,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    critic = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(critic_cfg.dense_units)] * int(critic_cfg.mlp_layers),
+        output_dim=1,
+        activation=critic_cfg.dense_act,
+        layer_norm=bool(critic_cfg.layer_norm),
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+
+    # ---- init params
+    key = jax.random.PRNGKey(cfg.seed)
+    keys = jax.random.split(key, 10)
+    dummy_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, int(np.prod(obs_space[k].shape[:-2])), *obs_space[k].shape[-2:]))
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, int(obs_space[k].shape[0])))
+    wm_params: Dict[str, Any] = {}
+    wm_params["encoder"] = encoder.init(keys[0], dummy_obs)
+    wm_params["recurrent_model"] = recurrent_model.init(
+        keys[1], jnp.zeros((1, int(sum(actions_dim)) + stochastic_size)), jnp.zeros((1, recurrent_state_size))
+    )
+    wm_params["representation_model"] = representation_model.init(keys[2], jnp.zeros((1, repr_input)))
+    wm_params["transition_model"] = transition_model.init(keys[3], jnp.zeros((1, recurrent_state_size)))
+    wm_params["observation_model"] = observation_model.init(keys[4], jnp.zeros((1, latent_state_size)))
+    wm_params["reward_model"] = reward_model.init(keys[5], jnp.zeros((1, latent_state_size)))
+    if continue_model is not None:
+        wm_params["continue_model"] = continue_model.init(keys[6], jnp.zeros((1, latent_state_size)))
+    actor_params = actor.init(keys[7], jnp.zeros((1, latent_state_size)))
+    critic_params = critic.init(keys[8], jnp.zeros((1, latent_state_size)))
+
+    if world_model_state:
+        wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    if actor_state:
+        actor_params = jax.tree_util.tree_map(jnp.asarray, actor_state)
+    if critic_state:
+        critic_params = jax.tree_util.tree_map(jnp.asarray, critic_state)
+    target_critic_params = (
+        jax.tree_util.tree_map(jnp.asarray, target_critic_state)
+        if target_critic_state
+        else copy.deepcopy(critic_params)
+    )
+
+    modules = DV2Modules(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model=observation_model,
+        reward_model=reward_model,
+        continue_model=continue_model,
+        actor=actor,
+        critic=critic,
+    )
+    params = {
+        "world_model": wm_params,
+        "actor": actor_params,
+        "critic": critic_params,
+        "target_critic": target_critic_params,
+    }
+
+    player = PlayerDV2(
+        encoder=encoder,
+        rssm=rssm,
+        actor=actor,
+        actions_dim=actions_dim,
+        num_envs=cfg.env.num_envs,
+        stochastic_size=int(world_model_cfg.stochastic_size),
+        recurrent_state_size=recurrent_state_size,
+        discrete_size=int(world_model_cfg.discrete_size),
+    )
+    player.expl_amount = float(actor_cfg.get("expl_amount", 0.0))
+    player.wm_params = wm_params
+    player.actor_params = actor_params
+    return modules, params, player

@@ -1,0 +1,59 @@
+"""DreamerV2 world-model loss (reference sheeprl/algos/dreamer_v2/loss.py:9-89).
+
+KL balancing with a single alpha (Eq. 2 of the DV2 paper) plus gaussian
+observation/reward log-likelihoods and an optional Bernoulli continue term.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def categorical_kl(p_logits: jax.Array, q_logits: jax.Array) -> jax.Array:
+    """KL(p || q) for factorized categoricals ``[..., stoch, discrete]`` -> ``[...]``."""
+    p_log = jax.nn.log_softmax(p_logits, axis=-1)
+    q_log = jax.nn.log_softmax(q_logits, axis=-1)
+    p = jnp.exp(p_log)
+    return jnp.sum(p * (p_log - q_log), axis=(-2, -1))
+
+
+def reconstruction_loss(
+    po_log_probs: Dict[str, jax.Array],
+    pr_log_prob: jax.Array,
+    priors_logits: jax.Array,
+    posteriors_logits: jax.Array,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_free_avg: bool = True,
+    kl_regularizer: float = 1.0,
+    pc_log_prob: Optional[jax.Array] = None,
+    discount_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Total DV2 world-model loss.
+
+    Args take precomputed per-element log-probs (each ``[T, B]``); the logits are
+    ``[T, B, stoch, discrete]``. Returns
+    (loss, kl, state_loss, reward_loss, observation_loss, continue_loss).
+    """
+    observation_loss = -sum(lp.mean() for lp in po_log_probs.values())
+    reward_loss = -pr_log_prob.mean()
+    # KL balancing (reference loss.py:62-84): lhs trains the prior toward the
+    # (stopped) posterior, rhs regularizes the posterior toward the (stopped) prior.
+    lhs = kl = categorical_kl(jax.lax.stop_gradient(posteriors_logits), priors_logits)
+    rhs = categorical_kl(posteriors_logits, jax.lax.stop_gradient(priors_logits))
+    if kl_free_avg:
+        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+    else:
+        loss_lhs = jnp.maximum(lhs, kl_free_nats).mean()
+        loss_rhs = jnp.maximum(rhs, kl_free_nats).mean()
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    if pc_log_prob is not None:
+        continue_loss = discount_scale_factor * -pc_log_prob.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    loss = kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl.mean(), kl_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,133 @@
+"""DreamerV2 utilities (reference sheeprl/algos/dreamer_v2/utils.py).
+
+`compute_lambda_values` follows the DV2 formulation (:85-103): explicit bootstrap
+value appended, reverse `lax.scan` instead of a Python loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    bootstrap: Optional[jax.Array] = None,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """TD(lambda) targets with explicit bootstrap (reference utils.py:85-103).
+
+    Inputs ``[H, B, 1]``; ``bootstrap`` is ``[1, B, 1]`` (defaults to zeros);
+    output ``[H, B, 1]``.
+    """
+    if bootstrap is None:
+        bootstrap = jnp.zeros_like(values[-1:])
+    next_values = jnp.concatenate([values[1:], bootstrap], axis=0)
+    inputs = rewards + continues * next_values * (1 - lmbda)
+
+    def body(carry, xs):
+        inp_t, cont_t = xs
+        val = inp_t + cont_t * lmbda * carry
+        return val, val
+
+    _, out = jax.lax.scan(body, bootstrap[0], (inputs[::-1], continues[::-1]))
+    return out[::-1]
+
+
+def prepare_obs(
+    runtime, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
+) -> Dict[str, jax.Array]:
+    """Host obs -> device arrays shaped [1, num_envs, ...] (reference utils.py:106-120)."""
+    out = {}
+    for k, v in obs.items():
+        arr = np.asarray(v, dtype=np.float32)
+        if k in cnn_keys:
+            arr = arr.reshape(1, num_envs, -1, *arr.shape[-2:]) / 255.0 - 0.5
+        else:
+            arr = arr.reshape(1, num_envs, -1)
+        out[k] = jnp.asarray(arr)
+    return out
+
+
+def test(player, runtime, cfg, log_dir: str, test_name: str = "", greedy: bool = True) -> None:
+    """Play one episode on a fresh env (reference utils.py:123-168)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test" + (f"_{test_name}" if test_name else ""))()
+    done = False
+    cumulative_rew = 0.0
+    obs = env.reset(seed=cfg.seed)[0]
+    player.num_envs = 1
+    player.init_states()
+    key = jax.random.PRNGKey(cfg.seed)
+    while not done:
+        key, step_key = jax.random.split(key)
+        jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
+        actions_list = player.get_actions(jax_obs, step_key, greedy=greedy)
+        if player.actor.is_continuous:
+            real_actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+        else:
+            real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
+        obs, reward, terminated, truncated, _ = env.step(real_actions.reshape(env.action_space.shape))
+        done = bool(terminated) or bool(truncated) or cfg.dry_run
+        cumulative_rew += float(reward)
+    runtime.print("Test - Reward:", cumulative_rew)
+    if cfg.metric.log_level > 0 and getattr(runtime, "logger", None) is not None:
+        runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    env.close()
+
+
+def log_models_from_checkpoint(runtime, env, cfg, state) -> Dict[str, Any]:
+    """Register DV2 models from a checkpoint into the local model registry
+    (reference dreamer_v1/utils.py:log_models pattern)."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v2.agent import build_agent
+    from sheeprl_tpu.utils.model_manager import log_model
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    _, params, _ = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        env.observation_space,
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+        state["target_critic"],
+    )
+    info = {}
+    for name in ("world_model", "actor", "critic", "target_critic"):
+        info[name] = log_model(runtime, cfg, name, params[name])
+    return info
