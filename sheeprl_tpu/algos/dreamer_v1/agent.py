@@ -1,0 +1,468 @@
+"""DreamerV1 agent: gaussian-stochastic RSSM + DV2-shared encoders/actor.
+
+Parity targets (reference sheeprl/algos/dreamer_v1/agent.py): RecurrentModel (:31,
+Linear+ELU -> plain GRU), RSSM (:64, gaussian stochastic state), WorldModel (:192),
+PlayerDV1 (:219), build_agent (:329). The encoders/decoders and the actor are the
+DV2 classes (reference imports them, agent.py:16-19), with layer_norm disabled.
+
+TPU-first: the T-step dynamic unroll and the H-step imagination both compile to
+single `lax.scan`s; the stochastic state is a reparameterized Normal sample
+(softplus std + min_std, reference dreamer_v1/utils.py:80-107).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorDV2,
+    ActorOutputDV2,
+    CNNDecoderDV2,
+    CNNEncoderDV2,
+    MLPDecoderDV2,
+    MLPEncoderDV2,
+    MLPWithHeadDV2,
+    MultiDecoderDV2,
+    MultiEncoderDV2,
+    add_exploration_noise,
+    xavier_normal_init,
+)
+from sheeprl_tpu.models.models import MLP
+
+
+def compute_stochastic_state(
+    state_information: jax.Array, key: Optional[jax.Array] = None, min_std: float = 0.1, sample: bool = True
+) -> Tuple[Tuple[jax.Array, jax.Array], jax.Array]:
+    """Split (mean, raw_std), apply softplus + min_std, and rsample a Normal.
+
+    Reference: sheeprl/algos/dreamer_v1/utils.py:80-107. Returns ((mean, std), state).
+    """
+    mean, std = jnp.split(state_information, 2, axis=-1)
+    std = jax.nn.softplus(std) + min_std
+    if sample:
+        state = mean + std * jax.random.normal(key, mean.shape, dtype=mean.dtype)
+    else:
+        state = mean
+    return (mean, std), state
+
+
+class RecurrentModelDV1(nn.Module):
+    """Linear + activation projection feeding a *standard* GRU cell
+    (reference agent.py:31-61; torch nn.GRU semantics, not the Hafner LayerNorm GRU)."""
+
+    input_size: int
+    recurrent_state_size: int
+    activation: str = "elu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
+        feat = MLP(
+            input_dims=self.input_size,
+            output_dim=None,
+            hidden_sizes=[self.recurrent_state_size],
+            activation=self.activation,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(x)
+        new_state, _ = nn.GRUCell(
+            features=self.recurrent_state_size,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            kernel_init=xavier_normal_init,
+        )(recurrent_state.astype(self.dtype), feat)
+        return new_state
+
+
+class RSSMDV1:
+    """Pure-functional gaussian RSSM (reference agent.py:64-190).
+
+    representation/transition output ``2*stochastic_size`` (mean, raw_std); no
+    is_first resets (DV1 predates them).
+    """
+
+    def __init__(
+        self,
+        recurrent_model: RecurrentModelDV1,
+        representation_model: MLPWithHeadDV2,
+        transition_model: MLPWithHeadDV2,
+        stochastic_size: int,
+        min_std: float = 0.1,
+    ):
+        self.recurrent_model = recurrent_model
+        self.representation_model = representation_model
+        self.transition_model = transition_model
+        self.stochastic_size = stochastic_size
+        self.min_std = min_std
+
+    @property
+    def stoch_state_size(self) -> int:
+        return self.stochastic_size
+
+    def _transition(self, wm_params, recurrent_out, key=None, sample=True):
+        info = self.transition_model.apply(wm_params["transition_model"], recurrent_out)
+        return compute_stochastic_state(info, key, self.min_std, sample=sample)
+
+    def _representation(self, wm_params, recurrent_state, embedded_obs, key=None, sample=True):
+        info = self.representation_model.apply(
+            wm_params["representation_model"], jnp.concatenate([recurrent_state, embedded_obs], axis=-1)
+        )
+        return compute_stochastic_state(info, key, self.min_std, sample=sample)
+
+    def _recurrent(self, wm_params, stoch, action, recurrent_state):
+        x = jnp.concatenate([stoch, action], axis=-1)
+        return self.recurrent_model.apply(wm_params["recurrent_model"], x, recurrent_state)
+
+    def dynamic_step(self, wm_params, posterior, recurrent_state, action, embedded_obs, key):
+        """One step of dynamic learning (reference agent.py:97-134)."""
+        k_prior, k_post = jax.random.split(key)
+        recurrent_state = self._recurrent(wm_params, posterior, action, recurrent_state)
+        prior_mean_std, prior = self._transition(wm_params, recurrent_state, k_prior)
+        posterior_mean_std, posterior = self._representation(wm_params, recurrent_state, embedded_obs, k_post)
+        return recurrent_state, posterior, prior, posterior_mean_std, prior_mean_std
+
+    def dynamic_scan(self, wm_params, embedded_obs, actions, key):
+        """lax.scan over T (the reference loops in Python, dreamer_v1.py:144-158)."""
+        T, B = embedded_obs.shape[0], embedded_obs.shape[1]
+        keys = jax.random.split(key, T)
+        init_rec = jnp.zeros((B, self.recurrent_model.recurrent_state_size), dtype=embedded_obs.dtype)
+        init_post = jnp.zeros((B, self.stochastic_size), dtype=embedded_obs.dtype)
+
+        def step(carry, xs):
+            recurrent_state, posterior = carry
+            action, embedded, k = xs
+            recurrent_state, posterior, _, post_ms, prior_ms = self.dynamic_step(
+                wm_params, posterior, recurrent_state, action, embedded, k
+            )
+            return (recurrent_state, posterior), (recurrent_state, posterior, post_ms, prior_ms)
+
+        _, (recurrent_states, posteriors, post_ms, prior_ms) = jax.lax.scan(
+            step, (init_rec, init_post), (actions, embedded_obs, keys)
+        )
+        return recurrent_states, posteriors, post_ms, prior_ms
+
+    def imagination_step(self, wm_params, stochastic_state, recurrent_state, actions, key):
+        """One-step latent imagination (reference agent.py:170-190)."""
+        recurrent_state = self._recurrent(wm_params, stochastic_state, actions, recurrent_state)
+        _, imagined_prior = self._transition(wm_params, recurrent_state, key)
+        return imagined_prior, recurrent_state
+
+
+class PlayerDV1:
+    """Stateful host-side rollout policy (reference agent.py:219-327); exploration
+    noise is applied in-graph via a traced expl_amount scalar."""
+
+    def __init__(
+        self,
+        encoder: MultiEncoderDV2,
+        rssm: RSSMDV1,
+        actor: ActorDV2,
+        actions_dim: Sequence[int],
+        num_envs: int,
+        stochastic_size: int,
+        recurrent_state_size: int,
+        actor_type: Optional[str] = None,
+    ):
+        self.encoder = encoder
+        self.rssm = rssm
+        self.actor = actor
+        self.actions_dim = tuple(actions_dim)
+        self.num_envs = num_envs
+        self.stochastic_size = stochastic_size
+        self.recurrent_state_size = recurrent_state_size
+        self.actor_type = actor_type
+        self.expl_amount = 0.0
+        self.wm_params: Any = None
+        self.actor_params: Any = None
+        self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+
+    def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False):
+        recurrent_state, stochastic_state, actions = state
+        k_rep, k_act, k_expl = jax.random.split(key, 3)
+        embedded = self.encoder.apply(wm_params["encoder"], obs)
+        recurrent_state = self.rssm._recurrent(wm_params, stochastic_state, actions, recurrent_state)
+        _, stochastic_state = self.rssm._representation(wm_params, recurrent_state, embedded, k_rep)
+        latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
+        out = ActorOutputDV2(self.actor, self.actor.apply(actor_params, latent))
+        actions_list = out.sample_actions(k_act, greedy=greedy)
+        if not greedy:  # exploration noise is a training-only behavior (reference get_actions adds none)
+            actions_list = add_exploration_noise(
+                actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
+            )
+        actions = jnp.concatenate(actions_list, axis=-1)
+        return tuple(actions_list), (recurrent_state, stochastic_state, actions)
+
+    def init_states(self, reset_envs: Optional[Sequence[int]] = None) -> None:
+        if reset_envs is None or len(reset_envs) == 0:
+            self.state = (
+                jnp.zeros((1, self.num_envs, self.recurrent_state_size), dtype=jnp.float32),
+                jnp.zeros((1, self.num_envs, self.stochastic_size), dtype=jnp.float32),
+                jnp.zeros((1, self.num_envs, int(np.sum(self.actions_dim))), dtype=jnp.float32),
+            )
+        else:
+            recurrent_state, stochastic_state, actions = self.state
+            reset = np.zeros((self.num_envs,), dtype=bool)
+            reset[np.asarray(reset_envs)] = True
+            mask = jnp.asarray(reset)[None, :, None]
+            self.state = (
+                jnp.where(mask, 0.0, recurrent_state),
+                jnp.where(mask, 0.0, stochastic_state),
+                jnp.where(mask, 0.0, actions),
+            )
+
+    def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
+        del mask
+        actions_list, self.state = self._step(
+            self.wm_params,
+            self.actor_params,
+            self.state,
+            obs,
+            key,
+            jnp.float32(self.expl_amount),
+            greedy=greedy,
+        )
+        return actions_list
+
+    # expl noise is folded into get_actions via self.expl_amount; kept for API parity
+    get_exploration_actions = get_actions
+
+
+class DV1Modules(NamedTuple):
+    encoder: MultiEncoderDV2
+    rssm: RSSMDV1
+    observation_model: MultiDecoderDV2
+    reward_model: MLPWithHeadDV2
+    continue_model: Optional[MLPWithHeadDV2]
+    actor: ActorDV2
+    critic: MLPWithHeadDV2
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV1Modules, Dict[str, Any], PlayerDV1]:
+    """Build module defs + init params (reference agent.py:329-559)."""
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+
+    recurrent_state_size = int(world_model_cfg.recurrent_model.recurrent_state_size)
+    stochastic_size = int(world_model_cfg.stochastic_size)
+    latent_state_size = stochastic_size + recurrent_state_size
+    compute_dtype = runtime.compute_dtype
+    param_dtype = jnp.float32
+
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_encoder = (
+        CNNEncoderDV2(
+            keys=cnn_keys,
+            input_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys],
+            image_size=tuple(obs_space[cnn_keys[0]].shape[-2:]),
+            channels_multiplier=int(world_model_cfg.encoder.cnn_channels_multiplier),
+            layer_norm=False,
+            activation=world_model_cfg.encoder.cnn_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(cnn_keys) > 0
+        else None
+    )
+    mlp_encoder = (
+        MLPEncoderDV2(
+            keys=mlp_keys,
+            input_dims=[int(obs_space[k].shape[0]) for k in mlp_keys],
+            mlp_layers=int(world_model_cfg.encoder.mlp_layers),
+            dense_units=int(world_model_cfg.encoder.dense_units),
+            layer_norm=False,
+            activation=world_model_cfg.encoder.dense_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(mlp_keys) > 0
+        else None
+    )
+    encoder = MultiEncoderDV2(cnn_encoder, mlp_encoder)
+
+    recurrent_model = RecurrentModelDV1(
+        input_size=int(sum(actions_dim) + stochastic_size),
+        recurrent_state_size=recurrent_state_size,
+        activation=world_model_cfg.recurrent_model.dense_act,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    repr_input = recurrent_state_size + encoder.output_dim
+    representation_model = MLPWithHeadDV2(
+        input_dim=repr_input,
+        hidden_sizes=[int(world_model_cfg.representation_model.hidden_size)],
+        output_dim=stochastic_size * 2,
+        activation=world_model_cfg.representation_model.dense_act,
+        layer_norm=False,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    transition_model = MLPWithHeadDV2(
+        input_dim=recurrent_state_size,
+        hidden_sizes=[int(world_model_cfg.transition_model.hidden_size)],
+        output_dim=stochastic_size * 2,
+        activation=world_model_cfg.transition_model.dense_act,
+        layer_norm=False,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    rssm = RSSMDV1(
+        recurrent_model=recurrent_model,
+        representation_model=representation_model,
+        transition_model=transition_model,
+        stochastic_size=stochastic_size,
+        min_std=float(world_model_cfg.min_std),
+    )
+
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = list(cfg.algo.mlp_keys.decoder)
+    cnn_decoder = (
+        CNNDecoderDV2(
+            keys=cnn_keys_dec,
+            output_channels=[int(np.prod(obs_space[k].shape[:-2])) for k in cnn_keys_dec],
+            channels_multiplier=int(world_model_cfg.observation_model.cnn_channels_multiplier),
+            cnn_encoder_output_dim=cnn_encoder.output_dim,
+            image_size=tuple(obs_space[cnn_keys_dec[0]].shape[-2:]),
+            layer_norm=False,
+            activation=world_model_cfg.observation_model.cnn_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(cnn_keys_dec) > 0
+        else None
+    )
+    mlp_decoder = (
+        MLPDecoderDV2(
+            keys=mlp_keys_dec,
+            output_dims=[int(obs_space[k].shape[0]) for k in mlp_keys_dec],
+            mlp_layers=int(world_model_cfg.observation_model.mlp_layers),
+            dense_units=int(world_model_cfg.observation_model.dense_units),
+            layer_norm=False,
+            activation=world_model_cfg.observation_model.dense_act,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if len(mlp_keys_dec) > 0
+        else None
+    )
+    observation_model = MultiDecoderDV2(cnn_decoder, mlp_decoder)
+
+    reward_model = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(world_model_cfg.reward_model.dense_units)] * int(world_model_cfg.reward_model.mlp_layers),
+        output_dim=1,
+        activation=world_model_cfg.reward_model.dense_act,
+        layer_norm=False,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    continue_model = (
+        MLPWithHeadDV2(
+            input_dim=latent_state_size,
+            hidden_sizes=[int(world_model_cfg.discount_model.dense_units)]
+            * int(world_model_cfg.discount_model.mlp_layers),
+            output_dim=1,
+            activation=world_model_cfg.discount_model.dense_act,
+            layer_norm=False,
+            dtype=compute_dtype,
+            param_dtype=param_dtype,
+        )
+        if world_model_cfg.use_continues
+        else None
+    )
+
+    actor = ActorDV2(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=False,
+        activation=actor_cfg.dense_act,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+    critic = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(critic_cfg.dense_units)] * int(critic_cfg.mlp_layers),
+        output_dim=1,
+        activation=critic_cfg.dense_act,
+        layer_norm=False,
+        dtype=compute_dtype,
+        param_dtype=param_dtype,
+    )
+
+    key = jax.random.PRNGKey(cfg.seed)
+    keys = jax.random.split(key, 10)
+    dummy_obs: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, int(np.prod(obs_space[k].shape[:-2])), *obs_space[k].shape[-2:]))
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, int(obs_space[k].shape[0])))
+    wm_params: Dict[str, Any] = {}
+    wm_params["encoder"] = encoder.init(keys[0], dummy_obs)
+    wm_params["recurrent_model"] = recurrent_model.init(
+        keys[1], jnp.zeros((1, int(sum(actions_dim)) + stochastic_size)), jnp.zeros((1, recurrent_state_size))
+    )
+    wm_params["representation_model"] = representation_model.init(keys[2], jnp.zeros((1, repr_input)))
+    wm_params["transition_model"] = transition_model.init(keys[3], jnp.zeros((1, recurrent_state_size)))
+    wm_params["observation_model"] = observation_model.init(keys[4], jnp.zeros((1, latent_state_size)))
+    wm_params["reward_model"] = reward_model.init(keys[5], jnp.zeros((1, latent_state_size)))
+    if continue_model is not None:
+        wm_params["continue_model"] = continue_model.init(keys[6], jnp.zeros((1, latent_state_size)))
+    actor_params = actor.init(keys[7], jnp.zeros((1, latent_state_size)))
+    critic_params = critic.init(keys[8], jnp.zeros((1, latent_state_size)))
+
+    if world_model_state:
+        wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
+    if actor_state:
+        actor_params = jax.tree_util.tree_map(jnp.asarray, actor_state)
+    if critic_state:
+        critic_params = jax.tree_util.tree_map(jnp.asarray, critic_state)
+
+    modules = DV1Modules(
+        encoder=encoder,
+        rssm=rssm,
+        observation_model=observation_model,
+        reward_model=reward_model,
+        continue_model=continue_model,
+        actor=actor,
+        critic=critic,
+    )
+    params = {"world_model": wm_params, "actor": actor_params, "critic": critic_params}
+
+    player = PlayerDV1(
+        encoder=encoder,
+        rssm=rssm,
+        actor=actor,
+        actions_dim=actions_dim,
+        num_envs=cfg.env.num_envs,
+        stochastic_size=stochastic_size,
+        recurrent_state_size=recurrent_state_size,
+    )
+    player.expl_amount = float(actor_cfg.get("expl_amount", 0.0))
+    player.wm_params = wm_params
+    player.actor_params = actor_params
+    return modules, params, player

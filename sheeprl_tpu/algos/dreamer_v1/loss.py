@@ -1,0 +1,60 @@
+"""DreamerV1 losses (reference sheeprl/algos/dreamer_v1/loss.py).
+
+Gaussian KL with free nats, gaussian observation/reward heads, optional Bernoulli
+continue head, plus the actor/critic objectives (Eq. 7/8/10 of the paper).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def gaussian_kl(p_mean, p_std, q_mean, q_std) -> jax.Array:
+    """KL(N(p) || N(q)) summed over the last (event) dim."""
+    var_ratio = (p_std / q_std) ** 2
+    t1 = ((p_mean - q_mean) / q_std) ** 2
+    return 0.5 * jnp.sum(var_ratio + t1 - 1.0 - jnp.log(var_ratio), axis=-1)
+
+
+def actor_loss(discounted_lambda_values: jax.Array) -> jax.Array:
+    """Eq. 7 (reference loss.py:27-39): maximize the discounted lambda returns."""
+    return -jnp.mean(discounted_lambda_values)
+
+
+def critic_loss(qv_log_prob: jax.Array, discount: jax.Array) -> jax.Array:
+    """Eq. 8 (reference loss.py:9-24): discounted value log-likelihood."""
+    return -jnp.mean(discount * qv_log_prob)
+
+
+def reconstruction_loss(
+    qo_log_probs: Dict[str, jax.Array],
+    qr_log_prob: jax.Array,
+    posteriors_mean: jax.Array,
+    posteriors_std: jax.Array,
+    priors_mean: jax.Array,
+    priors_std: jax.Array,
+    kl_free_nats: float = 3.0,
+    kl_regularizer: float = 1.0,
+    qc_log_prob: Optional[jax.Array] = None,
+    continue_scale_factor: float = 10.0,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Eq. 10 world-model loss (reference loss.py:42-99).
+
+    Returns (loss, kl, state_loss, reward_loss, observation_loss, continue_loss).
+    The continue term is the *negative* log-likelihood (the reference has a sign
+    slip at loss.py:94, `continue_scale_factor * qc.log_prob(...)`, which would
+    reward mispredicting terminals; the intended objective is implemented here).
+    """
+    observation_loss = -sum(lp.mean() for lp in qo_log_probs.values())
+    reward_loss = -qr_log_prob.mean()
+    kl = gaussian_kl(posteriors_mean, posteriors_std, priors_mean, priors_std).mean()
+    state_loss = jnp.maximum(kl, kl_free_nats)
+    if qc_log_prob is not None:
+        continue_loss = continue_scale_factor * -qc_log_prob.mean()
+    else:
+        continue_loss = jnp.zeros_like(reward_loss)
+    loss = kl_regularizer * state_loss + observation_loss + reward_loss + continue_loss
+    return loss, kl, state_loss, reward_loss, observation_loss, continue_loss

@@ -1,0 +1,95 @@
+"""DreamerV1 utilities (reference sheeprl/algos/dreamer_v1/utils.py).
+
+`compute_lambda_values` follows the DV1 recursion (:42-77): horizon-1 targets with
+the mixed (1-lambda) value bootstrap, as a reverse `lax.scan`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount",
+    "Grads/world_model",
+    "Grads/actor",
+    "Grads/critic",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
+
+
+def compute_lambda_values(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    last_values: jax.Array,
+    horizon: int = 15,
+    lmbda: float = 0.95,
+) -> jax.Array:
+    """DV1 lambda targets (reference utils.py:42-77).
+
+    Inputs ``[H, B, 1]``; output ``[H-1, B, 1]``. For step < H-2 the next value is
+    ``values[step+1] * (1 - lmbda)``; the last step bootstraps with ``last_values``.
+    """
+    next_values = jnp.concatenate([values[1 : horizon - 1] * (1 - lmbda), last_values[None]], axis=0)
+    deltas = rewards[: horizon - 1] + next_values * continues[: horizon - 1]
+
+    def body(carry, xs):
+        delta_t, cont_t = xs
+        val = delta_t + cont_t * lmbda * carry
+        return val, val
+
+    _, out = jax.lax.scan(
+        body, jnp.zeros_like(last_values), (deltas[::-1], continues[: horizon - 1][::-1])
+    )
+    return out[::-1]
+
+
+# The rollout/test helpers are identical to DV2's (the reference likewise reuses
+# DV2's test from DV1); import instead of duplicating.
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test  # noqa: E402, F401
+
+
+def log_models_from_checkpoint(runtime, env, cfg, state) -> Dict[str, Any]:
+    """Register DV1 models from a checkpoint (reference utils.py:110-160)."""
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.dreamer_v1.agent import build_agent
+    from sheeprl_tpu.utils.model_manager import log_model
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    _, params, _ = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        env.observation_space,
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+    )
+    info = {}
+    for name in ("world_model", "actor", "critic"):
+        info[name] = log_model(runtime, cfg, name, params[name])
+    return info

@@ -546,7 +546,8 @@ def add_exploration_noise(
     if is_continuous:
         cat = jnp.concatenate(actions, axis=-1)
         noisy = jnp.clip(cat + expl_amount * jax.random.normal(key, cat.shape), -1, 1)
-        return [noisy]
+        # only clip when noise is actually added (reference guards with expl_amount > 0)
+        return [jnp.where(expl_amount > 0.0, noisy, cat)]
     out = []
     for i, act in enumerate(actions):
         k_sample, k_mask, key = jax.random.split(key, 3)
@@ -595,9 +596,10 @@ class PlayerDV2:
         latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
         out = ActorOutputDV2(self.actor, self.actor.apply(actor_params, latent))
         actions_list = out.sample_actions(k_act, greedy=greedy)
-        actions_list = add_exploration_noise(
-            actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
-        )
+        if not greedy:  # exploration noise is a training-only behavior (reference get_actions adds none)
+            actions_list = add_exploration_noise(
+                actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
+            )
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 

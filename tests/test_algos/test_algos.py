@@ -207,6 +207,36 @@ def test_ppo_recurrent(standard_args, env_id, tmp_path, monkeypatch):
 
 
 @pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_dreamer_v1(standard_args, env_id, devices, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=dreamer_v1",
+        "env=dummy",
+        f"env.id={env_id}",
+        f"fabric.devices={devices}",
+        "algo.per_rank_pretrain_steps=1",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "buffer.size=16",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.stochastic_size=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "env.num_envs=1",
+    ]
+    _run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
 def test_dreamer_v2(standard_args, env_id, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
