@@ -1,0 +1,215 @@
+"""Plan2Explore (DV1) agent: DV1 world model + task/exploration actor-critic pairs
+plus an ensemble of next-embedding predictors.
+
+Parity target: reference sheeprl/algos/p2e_dv1/agent.py:26-155 (build_agent returning
+world model, ensembles, actor_task, critic_task, actor_exploration,
+critic_exploration, player).
+
+TPU-first design choice: the reference keeps the ensemble as an ``nn.ModuleList`` of
+N independent MLPs evaluated in a Python loop (agent.py:126-143,
+p2e_dv1_exploration.py:169-174). Here the ensemble is ONE module definition with
+*stacked* parameters ``[N, ...]`` built by ``jax.vmap`` over N PRNG streams; the
+forward pass is a single vmapped call, so all N members run as one batched matmul
+set on the MXU instead of N small sequential kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v1.agent import (
+    DV1Modules,
+    PlayerDV1,
+    RSSMDV1,
+    build_agent as dv1_build_agent,
+)
+from sheeprl_tpu.algos.dreamer_v2.agent import ActorDV2, MLPWithHeadDV2, MultiDecoderDV2, MultiEncoderDV2
+from sheeprl_tpu.models.models import MLP
+
+# Exposed for config-driven class selection (the reference aliases DV2's Actor the
+# same way, p2e_dv1/agent.py:22-23).
+Actor = ActorDV2
+
+
+class Ensembles:
+    """Vmapped ensemble of next-obs-embedding predictors (one-step models).
+
+    ``init`` stacks N parameter pytrees (leaves get a leading ``[N]`` axis, each
+    member seeded from its own PRNG fold — the analogue of the reference's
+    per-member ``seed_everything(cfg.seed + i)``, agent.py:128-130); ``apply`` maps
+    the same input through every member in one vmapped (MXU-batched) call,
+    returning ``[N, *batch, output_dim]``.
+    """
+
+    def __init__(
+        self,
+        n: int,
+        input_dim: int,
+        output_dim: int,
+        mlp_layers: int,
+        dense_units: int,
+        activation: str,
+        dtype: Any = jnp.float32,
+        param_dtype: Any = jnp.float32,
+    ):
+        self.n = int(n)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.mlp = MLP(
+            input_dims=int(input_dim),
+            output_dim=int(output_dim),
+            hidden_sizes=[int(dense_units)] * int(mlp_layers),
+            activation=activation,
+            dtype=dtype,
+            param_dtype=param_dtype,
+        )
+
+    def init(self, key: jax.Array, dummy_input: jax.Array):
+        keys = jax.random.split(key, self.n)
+        return jax.vmap(lambda k: self.mlp.init(k, dummy_input))(keys)
+
+    def apply(self, stacked_params, x: jax.Array) -> jax.Array:
+        return jax.vmap(lambda p: self.mlp.apply(p, x))(stacked_params)
+
+
+class P2EDV1Modules(NamedTuple):
+    encoder: MultiEncoderDV2
+    rssm: RSSMDV1
+    observation_model: MultiDecoderDV2
+    reward_model: MLPWithHeadDV2
+    continue_model: Optional[MLPWithHeadDV2]
+    ensembles: Ensembles
+    actor_task: ActorDV2
+    critic_task: MLPWithHeadDV2
+    actor_exploration: ActorDV2
+    critic_exploration: MLPWithHeadDV2
+
+    def as_dv1(self, task: bool) -> DV1Modules:
+        """View as a DV1Modules using the task or exploration behaviour pair."""
+        return DV1Modules(
+            encoder=self.encoder,
+            rssm=self.rssm,
+            observation_model=self.observation_model,
+            reward_model=self.reward_model,
+            continue_model=self.continue_model,
+            actor=self.actor_task if task else self.actor_exploration,
+            critic=self.critic_task if task else self.critic_exploration,
+        )
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[P2EDV1Modules, Dict[str, Any], PlayerDV1]:
+    """Build P2E-DV1 modules + params (reference p2e_dv1/agent.py:26-155).
+
+    ``params`` keys: world_model, ensembles, actor_task, critic_task,
+    actor_exploration, critic_exploration.
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    latent_state_size = int(world_model_cfg.stochastic_size) + int(
+        world_model_cfg.recurrent_model.recurrent_state_size
+    )
+    compute_dtype = runtime.compute_dtype
+
+    dv1_modules, dv1_params, player = dv1_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_exploration_state,
+        critic_exploration_state,
+    )
+    player.actor_type = cfg.algo.player.actor_type
+
+    actor_task = ActorDV2(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=False,
+        activation=actor_cfg.dense_act,
+        dtype=compute_dtype,
+    )
+    critic_task = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(critic_cfg.dense_units)] * int(critic_cfg.mlp_layers),
+        output_dim=1,
+        activation=critic_cfg.dense_act,
+        layer_norm=False,
+        dtype=compute_dtype,
+    )
+    ensembles = Ensembles(
+        n=int(cfg.algo.ensembles.n),
+        input_dim=int(sum(actions_dim)) + latent_state_size,
+        output_dim=dv1_modules.encoder.output_dim,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=cfg.algo.ensembles.dense_act,
+        dtype=compute_dtype,
+    )
+
+    key = jax.random.PRNGKey(cfg.seed + 1)  # distinct stream from the DV1 init
+    k_actor, k_critic, k_ens = jax.random.split(key, 3)
+    dummy_latent = jnp.zeros((1, latent_state_size))
+    actor_task_params = actor_task.init(k_actor, dummy_latent)
+    critic_task_params = critic_task.init(k_critic, dummy_latent)
+    ensembles_params = ensembles.init(k_ens, jnp.zeros((1, ensembles.input_dim)))
+
+    if actor_task_state:
+        actor_task_params = jax.tree_util.tree_map(jnp.asarray, actor_task_state)
+    if critic_task_state:
+        critic_task_params = jax.tree_util.tree_map(jnp.asarray, critic_task_state)
+    if ensembles_state:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+
+    modules = P2EDV1Modules(
+        encoder=dv1_modules.encoder,
+        rssm=dv1_modules.rssm,
+        observation_model=dv1_modules.observation_model,
+        reward_model=dv1_modules.reward_model,
+        continue_model=dv1_modules.continue_model,
+        ensembles=ensembles,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        actor_exploration=dv1_modules.actor,
+        critic_exploration=dv1_modules.critic,
+    )
+    params = {
+        "world_model": dv1_params["world_model"],
+        "ensembles": ensembles_params,
+        "actor_task": actor_task_params,
+        "critic_task": critic_task_params,
+        "actor_exploration": dv1_params["actor"],
+        "critic_exploration": dv1_params["critic"],
+    }
+
+    # Point the player at the requested behaviour policy (reference agent.py:146-153).
+    if cfg.algo.player.actor_type == "task":
+        player.actor = actor_task
+        player.actor_params = actor_task_params
+    else:
+        player.actor_params = params["actor_exploration"]
+    return modules, params, player

@@ -145,6 +145,44 @@ def run_algorithm(cfg: dotdict) -> None:
     task = importlib.import_module(f"{module}.{entry['name']}")
     command = getattr(task, entry["entrypoint"])
 
+    # Exploration -> finetuning handoff (reference cli.py:117-148): load the
+    # exploration run's sidecar config and pin the env settings to it.
+    kwargs: Dict[str, Any] = {}
+    if "finetuning" in entry["name"]:
+        import yaml
+
+        ckpt_path = cfg.checkpoint.get("exploration_ckpt_path")
+        if not ckpt_path:
+            raise ValueError(
+                "You must specify checkpoint.exploration_ckpt_path to finetune an exploration checkpoint"
+            )
+        ckpt_path = os.path.abspath(ckpt_path)
+        expl_cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
+        if not os.path.isfile(expl_cfg_path):
+            raise RuntimeError(f"The config file of the exploration checkpoint does not exist: {expl_cfg_path}")
+        with open(expl_cfg_path) as f:
+            exploration_cfg = dotdict(yaml.safe_load(f))
+        if exploration_cfg.env.id != cfg.env.id:
+            raise ValueError(
+                "This experiment is run with a different environment from the one of the exploration "
+                f"you want to finetune. Got '{cfg.env.id}', but the environment used during exploration "
+                f"was {exploration_cfg.env.id}."
+            )
+        kwargs["exploration_cfg"] = exploration_cfg
+        cfg.checkpoint.exploration_ckpt_path = ckpt_path
+        for env_key in (
+            "frame_stack",
+            "screen_size",
+            "action_repeat",
+            "grayscale",
+            "clip_rewards",
+            "frame_stack_dilation",
+            "max_episode_steps",
+            "reward_as_observation",
+        ):
+            if env_key in exploration_cfg.env:
+                cfg.env[env_key] = exploration_cfg.env[env_key]
+
     utils = importlib.import_module(f"{module}.utils")
     # Prune metric keys the algorithm does not produce (reference cli.py:151-165)
     keys_to_remove = []
@@ -167,7 +205,7 @@ def run_algorithm(cfg: dotdict) -> None:
     _apply_global_flags(cfg)
     if runtime.is_global_zero:
         print_config(cfg)
-    command(runtime, cfg)
+    command(runtime, cfg, **kwargs)
 
 
 def eval_algorithm(cfg: dotdict) -> None:

@@ -358,3 +358,56 @@ def test_dreamer_v3_decoupled_rssm(standard_args, tmp_path, monkeypatch):
         "env.num_envs=1",
     ]
     _run(args)
+
+
+_P2E_DV1_TINY = [
+    "env=dummy",
+    "algo.per_rank_pretrain_steps=1",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=2",
+    "buffer.size=16",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.ensembles.n=2",
+    "algo.ensembles.dense_units=8",
+    "algo.ensembles.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "buffer.memmap=False",
+    "env.num_envs=1",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv1(standard_args, env_id, tmp_path, monkeypatch):
+    """Exploration phase then finetuning from its checkpoint (reference
+    tests/test_algos/test_algos.py p2e flow)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=p2e_dv1_exploration",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "checkpoint.save_last=True",
+    ] + _P2E_DV1_TINY
+    _run(args)
+
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+    args = standard_args + [
+        "exp=p2e_dv1_finetuning",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[0]}",
+    ] + _P2E_DV1_TINY
+    _run(args)
