@@ -54,6 +54,7 @@ class Ensembles:
         mlp_layers: int,
         dense_units: int,
         activation: str,
+        layer_norm: bool = False,
         dtype: Any = jnp.float32,
         param_dtype: Any = jnp.float32,
     ):
@@ -65,6 +66,7 @@ class Ensembles:
             output_dim=int(output_dim),
             hidden_sizes=[int(dense_units)] * int(mlp_layers),
             activation=activation,
+            layer_norm=bool(layer_norm),
             dtype=dtype,
             param_dtype=param_dtype,
         )

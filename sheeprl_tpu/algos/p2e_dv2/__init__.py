@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.p2e_dv2 import p2e_dv2_exploration, p2e_dv2_finetuning  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv2 import evaluate  # noqa: F401  (must import after the algorithms register)

@@ -1,0 +1,188 @@
+"""Plan2Explore (DV2) agent: DV2 world model + task/exploration actor-critic pairs
+(each with a hard-updated target critic) plus an ensemble of next-stochastic-state
+predictors.
+
+Parity target: reference sheeprl/algos/p2e_dv2/agent.py:27-221 (build_agent returning
+world model, ensembles, actor_task, critic_task, target_critic_task,
+actor_exploration, critic_exploration, target_critic_exploration, player).
+
+TPU-first design: the ensemble is ONE module with vmapped stacked params (see
+p2e_dv1.agent.Ensembles) — all N members run as one batched matmul set on the MXU
+instead of the reference's Python loop over an ``nn.ModuleList``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v2.agent import (
+    ActorDV2,
+    DV2Modules,
+    MLPWithHeadDV2,
+    MultiDecoderDV2,
+    MultiEncoderDV2,
+    PlayerDV2,
+    RSSMDV2,
+    build_agent as dv2_build_agent,
+)
+from sheeprl_tpu.algos.p2e_dv1.agent import Ensembles
+
+# Exposed for config-driven class selection (reference p2e_dv2/agent.py:23-24).
+Actor = ActorDV2
+
+
+class P2EDV2Modules(NamedTuple):
+    encoder: MultiEncoderDV2
+    rssm: RSSMDV2
+    observation_model: MultiDecoderDV2
+    reward_model: MLPWithHeadDV2
+    continue_model: Optional[MLPWithHeadDV2]
+    ensembles: Ensembles
+    actor_task: ActorDV2
+    critic_task: MLPWithHeadDV2
+    actor_exploration: ActorDV2
+    critic_exploration: MLPWithHeadDV2
+
+    def as_dv2(self, task: bool) -> DV2Modules:
+        """View as a DV2Modules using the task or exploration behaviour pair."""
+        return DV2Modules(
+            encoder=self.encoder,
+            rssm=self.rssm,
+            observation_model=self.observation_model,
+            reward_model=self.reward_model,
+            continue_model=self.continue_model,
+            actor=self.actor_task if task else self.actor_exploration,
+            critic=self.critic_task if task else self.critic_exploration,
+        )
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critic_exploration_state: Optional[Dict[str, Any]] = None,
+    target_critic_exploration_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[P2EDV2Modules, Dict[str, Any], PlayerDV2]:
+    """Build P2E-DV2 modules + params (reference p2e_dv2/agent.py:27-221).
+
+    ``params`` keys: world_model, ensembles, actor_task, critic_task,
+    target_critic_task, actor_exploration, critic_exploration,
+    target_critic_exploration.
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    stochastic_size = int(world_model_cfg.stochastic_size) * int(world_model_cfg.discrete_size)
+    latent_state_size = stochastic_size + int(world_model_cfg.recurrent_model.recurrent_state_size)
+    compute_dtype = runtime.compute_dtype
+
+    dv2_modules, dv2_params, player = dv2_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_exploration_state,
+        critic_exploration_state,
+        target_critic_exploration_state,
+    )
+    player.actor_type = cfg.algo.player.actor_type
+
+    actor_task = ActorDV2(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=bool(actor_cfg.layer_norm),
+        activation=actor_cfg.dense_act,
+        dtype=compute_dtype,
+    )
+    critic_task = MLPWithHeadDV2(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(critic_cfg.dense_units)] * int(critic_cfg.mlp_layers),
+        output_dim=1,
+        activation=critic_cfg.dense_act,
+        layer_norm=bool(critic_cfg.layer_norm),
+        dtype=compute_dtype,
+    )
+    # The ensembles predict the NEXT stochastic state from (posterior, recurrent,
+    # action) — unlike DV1 where they predict the next obs embedding (reference
+    # p2e_dv2/agent.py:180-198, p2e_dv2_exploration.py:197-211).
+    ensembles = Ensembles(
+        n=int(cfg.algo.ensembles.n),
+        input_dim=int(sum(actions_dim)) + latent_state_size,
+        output_dim=stochastic_size,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=cfg.algo.ensembles.dense_act,
+        layer_norm=bool(cfg.algo.ensembles.get("layer_norm", False)),
+        dtype=compute_dtype,
+    )
+
+    key = jax.random.PRNGKey(cfg.seed + 1)  # distinct stream from the DV2 init
+    k_actor, k_critic, k_ens = jax.random.split(key, 3)
+    dummy_latent = jnp.zeros((1, latent_state_size))
+    actor_task_params = actor_task.init(k_actor, dummy_latent)
+    critic_task_params = critic_task.init(k_critic, dummy_latent)
+    ensembles_params = ensembles.init(k_ens, jnp.zeros((1, ensembles.input_dim)))
+
+    if actor_task_state:
+        actor_task_params = jax.tree_util.tree_map(jnp.asarray, actor_task_state)
+    if critic_task_state:
+        critic_task_params = jax.tree_util.tree_map(jnp.asarray, critic_task_state)
+    if ensembles_state:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+    target_critic_task_params = (
+        jax.tree_util.tree_map(jnp.asarray, target_critic_task_state)
+        if target_critic_task_state
+        else copy.deepcopy(critic_task_params)
+    )
+
+    modules = P2EDV2Modules(
+        encoder=dv2_modules.encoder,
+        rssm=dv2_modules.rssm,
+        observation_model=dv2_modules.observation_model,
+        reward_model=dv2_modules.reward_model,
+        continue_model=dv2_modules.continue_model,
+        ensembles=ensembles,
+        actor_task=actor_task,
+        critic_task=critic_task,
+        actor_exploration=dv2_modules.actor,
+        critic_exploration=dv2_modules.critic,
+    )
+    params = {
+        "world_model": dv2_params["world_model"],
+        "ensembles": ensembles_params,
+        "actor_task": actor_task_params,
+        "critic_task": critic_task_params,
+        "target_critic_task": target_critic_task_params,
+        "actor_exploration": dv2_params["actor"],
+        "critic_exploration": dv2_params["critic"],
+        "target_critic_exploration": dv2_params["target_critic"],
+    }
+
+    # Point the player at the requested behaviour policy (reference agent.py:208-218).
+    if cfg.algo.player.actor_type == "task":
+        player.actor = actor_task
+        player.actor_params = actor_task_params
+    else:
+        player.actor_params = params["actor_exploration"]
+    return modules, params, player

@@ -1,0 +1,773 @@
+"""Plan2Explore (DV2) — exploration phase (reference
+sheeprl/algos/p2e_dv2/p2e_dv2_exploration.py:37-967).
+
+One jitted train call per iteration `lax.scan`s over the G gradient steps; each step
+fuses (1) the DV2 world-model update with the reward/continue heads trained on
+DETACHED latents, (2) the ensemble update (next-stochastic-state log-likelihood),
+(3) the exploration actor/critic on the intrinsic reward = ensemble prediction
+variance, (4) the zero-shot task actor/critic on the learned reward model. Both
+behaviour pairs use hard-updated target critics (in-graph `lax.cond` on the step
+counter replaces the reference's host-side parameter copy, exploration.py:826-838).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, NamedTuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.dreamer_v2.agent import ActorOutputDV2, expl_amount_schedule
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.agent import P2EDV2Modules, build_agent
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+class P2EDV2OptStates(NamedTuple):
+    world: Any
+    ensembles: Any
+    actor_task: Any
+    critic_task: Any
+    actor_exploration: Any
+    critic_exploration: Any
+
+
+METRIC_ORDER = [
+    "Loss/world_model_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Loss/ensemble_loss",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Loss/policy_loss_exploration",
+    "Loss/value_loss_exploration",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Grads/world_model",
+    "Grads/ensemble",
+    "Grads/actor_exploration",
+    "Grads/critic_exploration",
+    "Grads/actor_task",
+    "Grads/critic_task",
+]
+
+
+def make_train_fn(modules: P2EDV2Modules, cfg, runtime, is_continuous: bool, actions_dim):
+    """Build (init_opt, train): jitted G-step scan over the five P2E updates."""
+    rssm = modules.rssm
+    ensembles = modules.ensembles
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    kl_balancing_alpha = float(cfg.algo.world_model.kl_balancing_alpha)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_free_avg = bool(cfg.algo.world_model.kl_free_avg)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    discount_scale_factor = float(cfg.algo.world_model.discount_scale_factor)
+    use_continues = bool(cfg.algo.world_model.use_continues) and modules.continue_model is not None
+    intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
+    stoch_size = rssm.stoch_state_size
+    recurrent_size = rssm.recurrent_model.recurrent_state_size
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = list(cfg.algo.mlp_keys.decoder)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+
+    world_tx = with_clipping(
+        instantiate(dict(cfg.algo.world_model.optimizer))(), cfg.algo.world_model.clip_gradients
+    )
+    ens_tx = with_clipping(instantiate(dict(cfg.algo.ensembles.optimizer))(), cfg.algo.ensembles.clip_gradients)
+    actor_tx = with_clipping(instantiate(dict(cfg.algo.actor.optimizer))(), cfg.algo.actor.clip_gradients)
+    critic_tx = with_clipping(instantiate(dict(cfg.algo.critic.optimizer))(), cfg.algo.critic.clip_gradients)
+
+    def init_opt(params) -> P2EDV2OptStates:
+        return P2EDV2OptStates(
+            world=world_tx.init(params["world_model"]),
+            ensembles=ens_tx.init(params["ensembles"]),
+            actor_task=actor_tx.init(params["actor_task"]),
+            critic_task=critic_tx.init(params["critic_task"]),
+            actor_exploration=actor_tx.init(params["actor_exploration"]),
+            critic_exploration=critic_tx.init(params["critic_exploration"]),
+        )
+
+    def behaviour_update(
+        actor_mod, critic_mod, wm_params, actor_params, critic_params, target_critic_params,
+        actor_opt, critic_opt, start_prior, start_recurrent, true_continue, key, rewards_fn,
+    ):
+        """Shared imagination + actor/critic update with a target-critic baseline;
+        rewards_fn maps (trajectories, imagined_actions) -> [H+1, TB, 1] rewards
+        (reference p2e_dv2_exploration.py:223-331 exploration / :334-436 task)."""
+        img_keys = jax.random.split(key, horizon)
+
+        def imagine(actor_p, keys):
+            latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
+
+            def step(carry, k):
+                prior_flat, rec_state = carry
+                k_act, k_img = jax.random.split(k)
+                latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
+                out = ActorOutputDV2(actor_mod, actor_mod.apply(actor_p, jax.lax.stop_gradient(latent)))
+                act = jnp.concatenate(out.sample_actions(k_act), axis=-1)
+                prior, rec_state = rssm.imagination_step(wm_params, prior_flat, rec_state, act, k_img)
+                prior_flat = prior.reshape(prior_flat.shape)
+                new_latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
+                return (prior_flat, rec_state), (new_latent, act)
+
+            _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent), keys)
+            trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
+            im_actions = jnp.concatenate([jnp.zeros_like(acts[:1]), acts], axis=0)
+            return trajectories, im_actions
+
+        def actor_loss_fn(actor_p):
+            trajectories, im_actions = imagine(actor_p, img_keys)
+            predicted_target_values = critic_mod.apply(target_critic_params, trajectories)
+            rewards = rewards_fn(trajectories, im_actions)
+            if use_continues:
+                continues = jax.nn.sigmoid(modules.continue_model.apply(wm_params["continue_model"], trajectories))
+                continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+            else:
+                continues = jnp.ones_like(rewards) * gamma
+            lambda_values = compute_lambda_values(
+                rewards[:-1],
+                predicted_target_values[:-1],
+                continues[:-1],
+                bootstrap=predicted_target_values[-1:],
+                lmbda=lmbda,
+            )
+            discount = jax.lax.stop_gradient(
+                jnp.cumprod(jnp.concatenate([jnp.ones_like(continues[:1]), continues[:-1]], axis=0), axis=0)
+            )
+            policies = ActorOutputDV2(
+                actor_mod, actor_mod.apply(actor_p, jax.lax.stop_gradient(trajectories[:-2]))
+            )
+            if is_continuous:
+                # Dynamics backprop through the imagined rollout (reference :287,:386)
+                objective = lambda_values[1:]
+            else:
+                baseline = predicted_target_values
+                advantage = jax.lax.stop_gradient(lambda_values[1:] - baseline[:-2])
+                splits = np.cumsum(np.asarray(actions_dim))[:-1]
+                action_parts = jnp.split(jax.lax.stop_gradient(im_actions[1:-1]), splits, axis=-1)
+                log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))
+                objective = log_probs[..., None] * advantage
+            try:
+                entropy = ent_coef * policies.entropy()
+            except NotImplementedError:
+                entropy = jnp.zeros(objective.shape[:-1], dtype=jnp.float32)
+            p_loss = -jnp.mean(discount[:-2] * (objective + entropy[..., None]))
+            aux = {
+                "trajectories": trajectories,
+                "lambda_values": lambda_values,
+                "discount": discount,
+                "rewards": rewards,
+                "predicted_values": predicted_target_values,
+            }
+            return p_loss, aux
+
+        (p_loss, aux), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(actor_params)
+        actor_grad_norm = optax.global_norm(actor_grads)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, actor_opt, actor_params)
+        new_actor = optax.apply_updates(actor_params, actor_updates)
+
+        trajectories = jax.lax.stop_gradient(aux["trajectories"])
+        lambda_values = jax.lax.stop_gradient(aux["lambda_values"])
+        discount = aux["discount"]
+
+        def critic_loss_fn(critic_p):
+            qv = Independent(
+                Normal(critic_mod.apply(critic_p, trajectories[:-1]), jnp.ones_like(lambda_values)), 1
+            )
+            return -jnp.mean(discount[:-1, ..., 0] * qv.log_prob(lambda_values))
+
+        v_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(critic_params)
+        critic_grad_norm = optax.global_norm(critic_grads)
+        critic_updates, critic_opt = critic_tx.update(critic_grads, critic_opt, critic_params)
+        new_critic = optax.apply_updates(critic_params, critic_updates)
+        return new_actor, new_critic, actor_opt, critic_opt, p_loss, v_loss, actor_grad_norm, critic_grad_norm, aux
+
+    def one_step(carry, inp):
+        params, opt_states, counter = carry
+        data, key = inp
+        data = jax.tree_util.tree_map(lambda v: jax.lax.with_sharding_constraint(v, data_sharding), data)
+        k_wm, k_expl, k_task = jax.random.split(key, 3)
+
+        # ---- hard target-critic copies (reference p2e_dv2_exploration.py:826-838)
+        target_critic_task = jax.lax.cond(
+            counter % target_freq == 0,
+            lambda: jax.tree_util.tree_map(lambda p: p, params["critic_task"]),
+            lambda: params["target_critic_task"],
+        )
+        target_critic_exploration = jax.lax.cond(
+            counter % target_freq == 0,
+            lambda: jax.tree_util.tree_map(lambda p: p, params["critic_exploration"]),
+            lambda: params["target_critic_exploration"],
+        )
+
+        batch_obs = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k].astype(jnp.float32) for k in mlp_keys})
+        is_first = data["is_first"].astype(jnp.float32).at[0].set(1.0)
+        actions = data["actions"].astype(jnp.float32)
+        rewards = data["rewards"].astype(jnp.float32)
+        terminated = data["terminated"].astype(jnp.float32)
+
+        # ---- (1) world-model update; reward/continue heads on DETACHED latents so
+        # task-reward gradients cannot shape the exploration-phase world model
+        # (reference p2e_dv2_exploration.py:154-161)
+        def world_loss_fn(wm_params):
+            embedded = modules.encoder.apply(wm_params["encoder"], batch_obs)
+            recurrent_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
+                wm_params, embedded, actions, is_first, k_wm
+            )
+            latent_states = jnp.concatenate(
+                [posteriors.reshape(*posteriors.shape[:-2], -1), recurrent_states], axis=-1
+            )
+            reconstructed = modules.observation_model.apply(wm_params["observation_model"], latent_states)
+            po_log_probs = {
+                k: Independent(Normal(reconstructed[k], jnp.ones_like(reconstructed[k])), reconstructed[k].ndim - 2)
+                .log_prob(batch_obs[k])
+                for k in cnn_keys_dec + mlp_keys_dec
+            }
+            detached_latents = jax.lax.stop_gradient(latent_states)
+            pr_log_prob = Independent(
+                Normal(
+                    modules.reward_model.apply(wm_params["reward_model"], detached_latents),
+                    jnp.ones_like(rewards),
+                ),
+                1,
+            ).log_prob(rewards)
+            pc_log_prob = None
+            if use_continues:
+                pc_log_prob = Independent(
+                    Bernoulli(logits=modules.continue_model.apply(wm_params["continue_model"], detached_latents)), 1
+                ).log_prob((1.0 - terminated) * gamma)
+            loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                po_log_probs,
+                pr_log_prob,
+                priors_logits.reshape(*priors_logits.shape[:-1], -1, rssm.discrete_size),
+                posteriors_logits.reshape(*posteriors_logits.shape[:-1], -1, rssm.discrete_size),
+                kl_balancing_alpha,
+                kl_free_nats,
+                kl_free_avg,
+                kl_regularizer,
+                pc_log_prob,
+                discount_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrent_states": recurrent_states,
+                "priors_logits": priors_logits,
+                "posteriors_logits": posteriors_logits,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return loss, aux
+
+        (world_loss, aux), world_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(params["world_model"])
+        world_grad_norm = optax.global_norm(world_grads)
+        world_updates, world_opt = world_tx.update(world_grads, opt_states.world, params["world_model"])
+        new_wm = optax.apply_updates(params["world_model"], world_updates)
+
+        posteriors = jax.lax.stop_gradient(aux["posteriors"])
+        recurrent_states = jax.lax.stop_gradient(aux["recurrent_states"])
+        posteriors_flat = posteriors.reshape(*posteriors.shape[:-2], -1)
+
+        # ---- (2) ensemble update: predict posterior[t+1] from (post, h, action)[t]
+        # (reference p2e_dv2_exploration.py:196-220)
+        ens_input = jnp.concatenate([posteriors_flat, recurrent_states, actions], axis=-1)
+
+        def ensemble_loss_fn(ens_params):
+            out = ensembles.apply(ens_params, ens_input)[:, :-1]  # [N, T-1, B, S*D]
+            log_prob = Independent(Normal(out, jnp.ones_like(out)), 1).log_prob(posteriors_flat[None, 1:])
+            return -(log_prob.mean(axis=(1, 2)).sum())
+
+        ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(params["ensembles"])
+        ens_grad_norm = optax.global_norm(ens_grads)
+        ens_updates, ens_opt = ens_tx.update(ens_grads, opt_states.ensembles, params["ensembles"])
+        new_ens = optax.apply_updates(params["ensembles"], ens_updates)
+
+        start_prior = posteriors_flat.reshape(1, -1, stoch_size)[0]
+        start_recurrent = recurrent_states.reshape(1, -1, recurrent_size)[0]
+        true_continue = (1.0 - terminated).reshape(-1, 1) * gamma
+
+        # ---- (3) exploration behaviour on the intrinsic (disagreement) reward
+        def intrinsic_rewards(trajectories, imagined_actions):
+            ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, imagined_actions], axis=-1))
+            preds = ensembles.apply(new_ens, ens_in)  # [N, H+1, TB, S*D]
+            return preds.var(axis=0).mean(axis=-1, keepdims=True) * intrinsic_reward_multiplier
+
+        (
+            new_actor_expl, new_critic_expl, actor_expl_opt, critic_expl_opt,
+            policy_loss_expl, value_loss_expl, actor_expl_gn, critic_expl_gn, aux_expl,
+        ) = behaviour_update(
+            modules.actor_exploration, modules.critic_exploration,
+            new_wm, params["actor_exploration"], params["critic_exploration"], target_critic_exploration,
+            opt_states.actor_exploration, opt_states.critic_exploration,
+            start_prior, start_recurrent, true_continue, k_expl, intrinsic_rewards,
+        )
+
+        # ---- (4) task behaviour (zero-shot) on the learned reward model
+        def task_rewards(trajectories, imagined_actions):
+            del imagined_actions
+            return modules.reward_model.apply(new_wm["reward_model"], trajectories)
+
+        (
+            new_actor_task, new_critic_task, actor_task_opt, critic_task_opt,
+            policy_loss_task, value_loss_task, actor_task_gn, critic_task_gn, _,
+        ) = behaviour_update(
+            modules.actor_task, modules.critic_task,
+            new_wm, params["actor_task"], params["critic_task"], target_critic_task,
+            opt_states.actor_task, opt_states.critic_task,
+            start_prior, start_recurrent, true_continue, k_task, task_rewards,
+        )
+
+        post_ent = (
+            Independent(
+                OneHotCategorical(
+                    logits=aux["posteriors_logits"].reshape(
+                        *aux["posteriors_logits"].shape[:-1], -1, rssm.discrete_size
+                    )
+                ),
+                1,
+            )
+            .entropy()
+            .mean()
+        )
+        prior_ent = (
+            Independent(
+                OneHotCategorical(
+                    logits=aux["priors_logits"].reshape(*aux["priors_logits"].shape[:-1], -1, rssm.discrete_size)
+                ),
+                1,
+            )
+            .entropy()
+            .mean()
+        )
+        new_params = {
+            "world_model": new_wm,
+            "ensembles": new_ens,
+            "actor_task": new_actor_task,
+            "critic_task": new_critic_task,
+            "target_critic_task": target_critic_task,
+            "actor_exploration": new_actor_expl,
+            "critic_exploration": new_critic_expl,
+            "target_critic_exploration": target_critic_exploration,
+        }
+        new_opt = P2EDV2OptStates(
+            world=world_opt, ensembles=ens_opt,
+            actor_task=actor_task_opt, critic_task=critic_task_opt,
+            actor_exploration=actor_expl_opt, critic_exploration=critic_expl_opt,
+        )
+        metrics = jnp.stack(
+            [
+                world_loss,
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                post_ent,
+                prior_ent,
+                ens_loss,
+                aux_expl["rewards"].mean(),
+                aux_expl["predicted_values"].mean(),
+                aux_expl["lambda_values"].mean(),
+                policy_loss_expl,
+                value_loss_expl,
+                policy_loss_task,
+                value_loss_task,
+                world_grad_norm,
+                ens_grad_norm,
+                actor_expl_gn,
+                critic_expl_gn,
+                actor_task_gn,
+                critic_task_gn,
+            ]
+        )
+        return (new_params, new_opt, counter + 1), metrics
+
+    def train(params, opt_states, counter, batches, key):
+        g = next(iter(batches.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states, counter), metrics = jax.lax.scan(
+            one_step, (params, opt_states, counter), (batches, keys)
+        )
+        m = metrics.mean(axis=0)
+        return params, opt_states, counter, {name: m[i] for i, name in enumerate(METRIC_ORDER)}
+
+    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    world_size = runtime.world_size
+    rank = runtime.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    # These arguments cannot be changed (reference p2e_dv2_exploration.py:488-491)
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
+    cfg.algo.player.actor_type = "exploration"
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if runtime.is_global_zero else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if (
+        len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
+        and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
+    ):
+        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    modules, params, player = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"] if state else None,
+        state["ensembles"] if state else None,
+        state["actor_task"] if state else None,
+        state["critic_task"] if state else None,
+        state["target_critic_task"] if state else None,
+        state["actor_exploration"] if state else None,
+        state["critic_exploration"] if state else None,
+        state["target_critic_exploration"] if state else None,
+    )
+
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
+    buffer_type = str(cfg.buffer.type).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            prioritize_ends=cfg.buffer.prioritize_ends,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
+    else:
+        raise ValueError(
+            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+        )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
+    if cfg.dry_run:
+        step_data["truncated"] = step_data["truncated"] + 1
+        step_data["terminated"] = step_data["terminated"] + 1
+    step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))))
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    player.init_states()
+
+    base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
+    expl_decay = float(cfg.algo.actor.get("expl_decay", 0.0))
+    expl_min = float(cfg.algo.actor.get("expl_min", 0.0))
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            if iter_num <= learning_starts and state is None:
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act.reshape(-1)]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                rng, act_key = jax.random.split(rng)
+                player.expl_amount = expl_amount_schedule(base_expl_amount, expl_decay, expl_min, policy_step)
+                actions_list = player.get_actions(jax_obs, act_key)
+                actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+                if is_continuous:
+                    real_actions = actions
+                else:
+                    real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
+
+            step_data["is_first"] = np.logical_or(step_data["terminated"], step_data["truncated"]).astype(
+                np.float32
+            )
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                if aggregator:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+        finals = final_observations(infos, obs_keys)
+        if finals:
+            for idx, final_obs in finals.items():
+                for k, v in final_obs.items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = real_next_obs[k][np.newaxis]
+        obs = next_obs
+
+        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        if cfg.dry_run and buffer_type == "episode":
+            step_data["terminated"] = np.ones_like(step_data["terminated"])
+        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+        step_data["rewards"] = clip_rewards_fn(
+            np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        )
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (np.asarray(next_obs[k])[dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            for d in dones_idxes:
+                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
+                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
+            player.init_states(dones_idxes)
+
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time", SumMetric()):
+                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_states, counter, train_metrics = train_fn(
+                        params, opt_states, counter, batches, train_key
+                    )
+                    jax.block_until_ready(params["actor_exploration"])
+                    player.wm_params = params["world_model"]
+                    player.actor_params = params["actor_exploration"]
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step += world_size * per_rank_gradient_steps
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+                    if "Params/exploration_amount_exploration" in aggregator:
+                        aggregator.update("Params/exploration_amount_exploration", player.expl_amount)
+                    if "Params/exploration_amount_task" in aggregator:
+                        aggregator.update("Params/exploration_amount_task", player.expl_amount)
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if logger and policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger and timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": jax.device_get(params["world_model"]),
+                "ensembles": jax.device_get(params["ensembles"]),
+                "actor_task": jax.device_get(params["actor_task"]),
+                "critic_task": jax.device_get(params["critic_task"]),
+                "target_critic_task": jax.device_get(params["target_critic_task"]),
+                "actor_exploration": jax.device_get(params["actor_exploration"]),
+                "critic_exploration": jax.device_get(params["critic_exploration"]),
+                "target_critic_exploration": jax.device_get(params["target_critic_exploration"]),
+                "opt_states": jax.device_get(opt_states),
+                "counter": int(counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    # Zero-shot evaluation runs with the TASK policy (reference :960-963).
+    if runtime.is_global_zero and cfg.algo.run_test:
+        player.actor = modules.actor_task
+        player.actor_params = params["actor_task"]
+        player.actor_type = "task"
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

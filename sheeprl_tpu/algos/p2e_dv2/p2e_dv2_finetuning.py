@@ -1,0 +1,403 @@
+"""Plan2Explore (DV2) — finetuning phase (reference
+sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py:35-509).
+
+Loads the exploration checkpoint, pins the model hyper-parameters to the
+exploration run's, and finetunes the TASK actor-critic (+ target critic, + world
+model) with the plain DreamerV2 train step on real rewards. The player rolls out
+with the exploration policy until training starts, then switches to the task policy.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v2.agent import expl_amount_schedule
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import DV2OptStates, make_train_fn
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv2.agent import build_agent
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.utils.checkpoint import load_state
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
+    world_size = runtime.world_size
+    rank = runtime.global_rank
+
+    ckpt_path = pathlib.Path(cfg.checkpoint.exploration_ckpt_path)
+    resumed = cfg.checkpoint.resume_from is not None
+    state = load_state(cfg.checkpoint.resume_from if resumed else str(ckpt_path))
+
+    # All the models must be equal to the ones of the exploration phase
+    # (reference p2e_dv2_finetuning.py:52-75).
+    cfg.algo.gamma = exploration_cfg.algo.gamma
+    cfg.algo.lmbda = exploration_cfg.algo.lmbda
+    cfg.algo.horizon = exploration_cfg.algo.horizon
+    cfg.algo.layer_norm = exploration_cfg.algo.layer_norm
+    cfg.algo.dense_units = exploration_cfg.algo.dense_units
+    cfg.algo.mlp_layers = exploration_cfg.algo.mlp_layers
+    cfg.algo.dense_act = exploration_cfg.algo.dense_act
+    cfg.algo.cnn_act = exploration_cfg.algo.cnn_act
+    cfg.algo.world_model = exploration_cfg.algo.world_model
+    cfg.algo.actor = exploration_cfg.algo.actor
+    cfg.algo.critic = exploration_cfg.algo.critic
+    cfg.env.clip_rewards = exploration_cfg.env.clip_rewards
+    if cfg.buffer.load_from_exploration and exploration_cfg.buffer.checkpoint:
+        cfg.env.num_envs = exploration_cfg.env.num_envs
+    cfg.algo.cnn_keys = exploration_cfg.algo.cnn_keys
+    cfg.algo.mlp_keys = exploration_cfg.algo.mlp_keys
+
+    # These arguments cannot be changed
+    cfg.env.screen_size = 64
+    cfg.env.frame_stack = 1
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    envs = vectorized_env(
+        [
+            make_env(
+                cfg,
+                cfg.seed + rank * cfg.env.num_envs + i,
+                rank * cfg.env.num_envs,
+                log_dir if runtime.is_global_zero else None,
+                "train",
+                vector_env_idx=i,
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if (
+        len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
+        and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
+    ):
+        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    modules, params, player = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        None,
+        state["actor_task"],
+        state["critic_task"],
+        state["target_critic_task"],
+        state["actor_exploration"],
+        None,
+        None,
+    )
+
+    # Finetune the TASK behaviour with the plain DV2 step on real rewards.
+    dv2_modules = modules.as_dv2(task=True)
+    init_opt, train_fn = make_train_fn(dv2_modules, cfg, runtime, is_continuous, actions_dim)
+    fine_params = {
+        "world_model": params["world_model"],
+        "actor": params["actor_task"],
+        "critic": params["critic_task"],
+        "target_critic": params["target_critic_task"],
+    }
+    opt_states = init_opt(fine_params)
+    if resumed:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    elif "opt_states" in state:
+        # Carry over the world/actor_task/critic_task optimizer moments from the
+        # exploration phase (reference p2e_dv2_finetuning.py:171-177).
+        expl_opt = state["opt_states"]
+        get = expl_opt.get if isinstance(expl_opt, dict) else lambda name, d=None: getattr(expl_opt, name, d)
+        world, actor, critic = get("world"), get("actor_task"), get("critic_task")
+        opt_states = DV2OptStates(
+            world=jax.tree_util.tree_map(jnp.asarray, world) if world is not None else opt_states.world,
+            actor=jax.tree_util.tree_map(jnp.asarray, actor) if actor is not None else opt_states.actor,
+            critic=jax.tree_util.tree_map(jnp.asarray, critic) if critic is not None else opt_states.critic,
+        )
+    counter = jnp.int32(state["counter"]) if resumed and "counter" in state else jnp.int32(0)
+    fine_params = runtime.replicate(fine_params)
+    opt_states = runtime.replicate(opt_states)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
+    buffer_type = str(cfg.buffer.type).lower()
+    if buffer_type == "sequential":
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+    elif buffer_type == "episode":
+        rb = EpisodeBuffer(
+            buffer_size,
+            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            prioritize_ends=cfg.buffer.prioritize_ends,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        )
+    else:
+        raise ValueError(
+            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
+        )
+    if "rb" in state and (resumed or (cfg.buffer.load_from_exploration and exploration_cfg.buffer.checkpoint)):
+        rb.load_state_dict(state["rb"])
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if resumed else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if resumed else 0
+    last_log = state["last_log"] if resumed else 0
+    last_checkpoint = state["last_checkpoint"] if resumed else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if resumed:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if resumed:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
+    if cfg.dry_run:
+        step_data["truncated"] = step_data["truncated"] + 1
+        step_data["terminated"] = step_data["terminated"] + 1
+    step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))))
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    player.init_states()
+
+    base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
+    expl_decay = float(cfg.algo.actor.get("expl_decay", 0.0))
+    expl_min = float(cfg.algo.actor.get("expl_min", 0.0))
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+            rng, act_key = jax.random.split(rng)
+            player.expl_amount = expl_amount_schedule(base_expl_amount, expl_decay, expl_min, policy_step)
+            actions_list = player.get_actions(jax_obs, act_key)
+            actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+            if is_continuous:
+                real_actions = actions
+            else:
+                real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
+
+            step_data["is_first"] = np.logical_or(step_data["terminated"], step_data["truncated"]).astype(
+                np.float32
+            )
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+            if cfg.dry_run and buffer_type == "episode":
+                dones = np.ones_like(dones)
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                if aggregator:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+        finals = final_observations(infos, obs_keys)
+        if finals:
+            for idx, final_obs in finals.items():
+                for k, v in final_obs.items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = real_next_obs[k][np.newaxis]
+        obs = next_obs
+
+        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        if cfg.dry_run and buffer_type == "episode":
+            step_data["terminated"] = np.ones_like(step_data["terminated"])
+        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+        step_data["rewards"] = clip_rewards_fn(
+            np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        )
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (np.asarray(next_obs[k])[dones_idxes])[np.newaxis]
+            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
+            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
+            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            for d in dones_idxes:
+                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
+                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
+            player.init_states(dones_idxes)
+
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                # Switch the player to the task policy once training starts
+                # (reference p2e_dv2_finetuning.py:350-357).
+                if player.actor_type != "task":
+                    player.actor_type = "task"
+                    player.actor = modules.actor_task
+                    player.actor_params = fine_params["actor"]
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time", SumMetric()):
+                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
+                    rng, train_key = jax.random.split(rng)
+                    fine_params, opt_states, counter, train_metrics = train_fn(
+                        fine_params, opt_states, counter, batches, train_key
+                    )
+                    jax.block_until_ready(fine_params["actor"])
+                    player.wm_params = fine_params["world_model"]
+                    player.actor_params = fine_params["actor"]
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step += world_size * per_rank_gradient_steps
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+                    if "Params/exploration_amount_task" in aggregator:
+                        aggregator.update("Params/exploration_amount_task", player.expl_amount)
+                    if "Params/exploration_amount_exploration" in aggregator:
+                        aggregator.update("Params/exploration_amount_exploration", player.expl_amount)
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if logger and policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger and timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": jax.device_get(fine_params["world_model"]),
+                "actor_task": jax.device_get(fine_params["actor"]),
+                "critic_task": jax.device_get(fine_params["critic"]),
+                "target_critic_task": jax.device_get(fine_params["target_critic"]),
+                "actor_exploration": jax.device_get(params["actor_exploration"]),
+                "opt_states": jax.device_get(opt_states),
+                "counter": int(counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path_out = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path_out,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        player.actor = modules.actor_task
+        player.actor_params = fine_params["actor"]
+        player.actor_type = "task"
+        test(player, runtime, cfg, log_dir)
+    if logger:
+        logger.finalize()

@@ -1,0 +1,90 @@
+"""P2E-DV2 utilities (reference sheeprl/algos/p2e_dv2/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v2.utils import AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV2
+from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_exploration",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Params/exploration_amount_task",
+    "Params/exploration_amount_exploration",
+    "Rewards/intrinsic",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/world_model",
+    "Grads/ensemble",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/critic_exploration",
+}.union(AGGREGATOR_KEYS_DV2)
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critic_exploration",
+    "target_critic_exploration",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+}
+
+
+def log_models_from_checkpoint(runtime, env, cfg, state) -> Dict[str, Any]:
+    """Register P2E-DV2 models from a checkpoint (reference utils.py:60-121).
+
+    Exploration checkpoints carry all eight models; finetuning checkpoints carry the
+    task triple + world model + exploration actor.
+    """
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.p2e_dv2.agent import build_agent
+    from sheeprl_tpu.utils.model_manager import log_model
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    exploration = "exploration" in cfg.algo.name
+    _, params, _ = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        env.observation_space,
+        state["world_model"],
+        state["ensembles"] if exploration else None,
+        state["actor_task"],
+        state["critic_task"],
+        state["target_critic_task"],
+        state["actor_exploration"] if "actor_exploration" in state else None,
+        state["critic_exploration"] if exploration else None,
+        state["target_critic_exploration"] if exploration else None,
+    )
+    info = {}
+    names = ["world_model", "actor_task", "critic_task", "target_critic_task"]
+    if exploration:
+        names += ["ensembles", "actor_exploration", "critic_exploration", "target_critic_exploration"]
+    for name in names:
+        info[name] = log_model(runtime, cfg, name, params[name])
+    return info

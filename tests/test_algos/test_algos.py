@@ -411,3 +411,36 @@ def test_p2e_dv1(standard_args, env_id, tmp_path, monkeypatch):
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV1_TINY
     _run(args)
+
+
+_P2E_DV2_TINY = _P2E_DV1_TINY + [
+    "algo.world_model.discrete_size=4",
+    "algo.critic.per_rank_target_network_update_freq=2",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv2(standard_args, env_id, tmp_path, monkeypatch):
+    """Exploration phase then finetuning from its checkpoint (reference
+    tests/test_algos/test_algos.py p2e flow)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=p2e_dv2_exploration",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "checkpoint.save_last=True",
+    ] + _P2E_DV2_TINY
+    _run(args)
+
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+    args = standard_args + [
+        "exp=p2e_dv2_finetuning",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[0]}",
+    ] + _P2E_DV2_TINY
+    _run(args)
