@@ -596,7 +596,7 @@ def main(runtime, cfg: Dict[str, Any]):
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric()):
-            if iter_num <= learning_starts and state is None:
+            if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():
                 real_actions = actions = np.array(envs.action_space.sample())
                 if not is_continuous:
                     actions = np.concatenate(
@@ -768,6 +768,6 @@ def main(runtime, cfg: Dict[str, Any]):
         player.actor = modules.actor_task
         player.actor_params = params["actor_task"]
         player.actor_type = "task"
-        test(player, runtime, cfg, log_dir)
+        test(player, runtime, cfg, log_dir, "zero-shot")
     if logger:
         logger.finalize()

@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.p2e_dv3 import p2e_dv3_exploration, p2e_dv3_finetuning  # noqa: F401
+from sheeprl_tpu.algos.p2e_dv3 import evaluate  # noqa: F401  (must import after the algorithms register)

@@ -1,0 +1,229 @@
+"""Plan2Explore (DV3) agent: DV3 world model + task actor-critic pair (with EMA
+target critic) + exploration actor with a config-declared *set* of weighted
+exploration critics (each a two-hot head with its own EMA target), plus an
+ensemble of next-stochastic-state predictors.
+
+Parity target: reference sheeprl/algos/p2e_dv3/agent.py:27-223 (build_agent
+returning world model, ensembles, actor_task, critic_task, target_critic_task,
+actor_exploration, critics_exploration dict, player).
+
+TPU-first design: the ensemble is ONE module with vmapped stacked params (see
+p2e_dv1.agent.Ensembles) — all N members run as one batched matmul set on the MXU
+instead of the reference's Python loop over an ``nn.ModuleList``. The exploration
+critics are kept as parallel param dicts keyed like the reference's
+``cfg.algo.critics_exploration`` mapping so checkpoints keep the same shape
+(``critics_exploration -> {key: {module, target_module}}``).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor as DV3Actor,
+    DV3Modules,
+    MLPWithHead,
+    MultiDecoderDV3,
+    MultiEncoderDV3,
+    PlayerDV3,
+    RSSM,
+    build_agent as dv3_build_agent,
+)
+from sheeprl_tpu.algos.dreamer_v3.agent import _ln_enabled
+from sheeprl_tpu.algos.p2e_dv1.agent import Ensembles
+
+# Exposed for config-driven class selection (reference p2e_dv3/agent.py:23-24).
+Actor = DV3Actor
+
+
+class P2EDV3Modules(NamedTuple):
+    encoder: MultiEncoderDV3
+    rssm: RSSM
+    observation_model: MultiDecoderDV3
+    reward_model: MLPWithHead
+    continue_model: MLPWithHead
+    ensembles: Ensembles
+    actor_task: DV3Actor
+    critic_task: MLPWithHead
+    actor_exploration: DV3Actor
+    critic_exploration: MLPWithHead  # shared module definition for every exploration critic
+    critics_exploration: Dict[str, Dict[str, Any]]  # {key: {weight, reward_type}}
+
+    def as_dv3(self, task: bool) -> DV3Modules:
+        """View as a DV3Modules using the task behaviour pair.
+
+        Only ``task=True`` is representable: the exploration behaviour has a
+        *set* of critics, which does not fit ``DV3Modules.critic``.
+        """
+        if not task:
+            raise ValueError(
+                "P2EDV3Modules.as_dv3(task=False) is unsupported: the exploration "
+                "behaviour uses multiple weighted critics (cfg.algo.critics_exploration)"
+            )
+        return DV3Modules(
+            encoder=self.encoder,
+            rssm=self.rssm,
+            observation_model=self.observation_model,
+            reward_model=self.reward_model,
+            continue_model=self.continue_model,
+            actor=self.actor_task,
+            critic=self.critic_task,
+        )
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    ensembles_state: Optional[Any] = None,
+    actor_task_state: Optional[Dict[str, Any]] = None,
+    critic_task_state: Optional[Dict[str, Any]] = None,
+    target_critic_task_state: Optional[Dict[str, Any]] = None,
+    actor_exploration_state: Optional[Dict[str, Any]] = None,
+    critics_exploration_state: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> Tuple[P2EDV3Modules, Dict[str, Any], PlayerDV3]:
+    """Build P2E-DV3 modules + params (reference p2e_dv3/agent.py:27-223).
+
+    ``params`` keys: world_model, ensembles, actor_task, critic_task,
+    target_critic_task, actor_exploration, critics_exploration (a dict
+    ``{key: {"module": params, "target_module": params}}`` mirroring the
+    reference checkpoint layout).
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    critic_cfg = cfg.algo.critic
+    stochastic_size = int(world_model_cfg.stochastic_size) * int(world_model_cfg.discrete_size)
+    latent_state_size = stochastic_size + int(world_model_cfg.recurrent_model.recurrent_state_size)
+    compute_dtype = runtime.compute_dtype
+
+    # Task models are exactly DV3's (reference p2e_dv3/agent.py:95-105)
+    dv3_modules, dv3_params, player = dv3_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        actor_task_state,
+        critic_task_state,
+        target_critic_task_state,
+    )
+    player.actor_type = cfg.algo.player.actor_type
+
+    actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
+    actor_exploration = Actor(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        max_std=float(actor_cfg.get("max_std", 1.0)),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=actor_ln,
+        layer_norm_eps=actor_eps,
+        activation=actor_cfg.dense_act,
+        unimix=float(cfg.algo.unimix),
+        action_clip=float(actor_cfg.get("action_clip", 1.0)),
+        dtype=compute_dtype,
+    )
+
+    # Exploration critics: one two-hot head per enabled entry of
+    # cfg.algo.critics_exploration (reference p2e_dv3/agent.py:119-154). All of
+    # them share the same module *definition*; parameters are per-key.
+    critic_ln, critic_eps = _ln_enabled(critic_cfg.get("layer_norm"))
+    critic_exploration = MLPWithHead(
+        input_dim=latent_state_size,
+        hidden_sizes=[int(critic_cfg.dense_units)] * int(critic_cfg.mlp_layers),
+        output_dim=int(critic_cfg.bins),
+        activation=critic_cfg.dense_act,
+        layer_norm=critic_ln,
+        layer_norm_eps=critic_eps,
+        head_init_scale=0.0 if cfg.algo.hafner_initialization else -1.0,
+        dtype=compute_dtype,
+    )
+    critics_spec: Dict[str, Dict[str, Any]] = {}
+    intrinsic_critics = 0
+    for k, v in cfg.algo.critics_exploration.items():
+        if float(v.weight) > 0:
+            if v.reward_type == "intrinsic":
+                intrinsic_critics += 1
+            elif v.reward_type != "task":
+                raise ValueError(
+                    f"Unknown exploration-critic reward_type '{v.reward_type}' for '{k}': "
+                    "must be 'intrinsic' or 'task'"
+                )
+            critics_spec[k] = {"weight": float(v.weight), "reward_type": str(v.reward_type)}
+    if intrinsic_critics == 0:
+        raise RuntimeError("You must specify at least one intrinsic critic (`reward_type='intrinsic'`)")
+
+    # The ensembles predict the NEXT stochastic state from (posterior, recurrent,
+    # action) with an MSE head (reference p2e_dv3/agent.py:175-205,
+    # p2e_dv3_exploration.py:205-227).
+    ens_ln, _ = _ln_enabled(cfg.algo.ensembles.get("layer_norm"))
+    ensembles = Ensembles(
+        n=int(cfg.algo.ensembles.n),
+        input_dim=int(sum(actions_dim)) + latent_state_size,
+        output_dim=stochastic_size,
+        mlp_layers=int(cfg.algo.ensembles.mlp_layers),
+        dense_units=int(cfg.algo.ensembles.dense_units),
+        activation=cfg.algo.ensembles.dense_act,
+        layer_norm=ens_ln,
+        dtype=compute_dtype,
+    )
+
+    key = jax.random.PRNGKey(cfg.seed + 1)  # distinct stream from the DV3 init
+    k_actor, k_ens, k_crit = jax.random.split(key, 3)
+    dummy_latent = jnp.zeros((1, latent_state_size))
+    actor_exploration_params = actor_exploration.init(k_actor, dummy_latent)
+    ensembles_params = ensembles.init(k_ens, jnp.zeros((1, ensembles.input_dim)))
+    critics_exploration_params: Dict[str, Dict[str, Any]] = {}
+    for i, k in enumerate(critics_spec):
+        ck = jax.random.fold_in(k_crit, i)
+        cp = critic_exploration.init(ck, dummy_latent)
+        critics_exploration_params[k] = {"module": cp, "target_module": copy.deepcopy(cp)}
+
+    if actor_exploration_state:
+        actor_exploration_params = jax.tree_util.tree_map(jnp.asarray, actor_exploration_state)
+    if ensembles_state:
+        ensembles_params = jax.tree_util.tree_map(jnp.asarray, ensembles_state)
+    if critics_exploration_state:
+        critics_exploration_params = jax.tree_util.tree_map(jnp.asarray, dict(critics_exploration_state))
+
+    modules = P2EDV3Modules(
+        encoder=dv3_modules.encoder,
+        rssm=dv3_modules.rssm,
+        observation_model=dv3_modules.observation_model,
+        reward_model=dv3_modules.reward_model,
+        continue_model=dv3_modules.continue_model,
+        ensembles=ensembles,
+        actor_task=dv3_modules.actor,
+        critic_task=dv3_modules.critic,
+        actor_exploration=actor_exploration,
+        critic_exploration=critic_exploration,
+        critics_exploration=critics_spec,
+    )
+    params = {
+        "world_model": dv3_params["world_model"],
+        "ensembles": ensembles_params,
+        "actor_task": dv3_params["actor"],
+        "critic_task": dv3_params["critic"],
+        "target_critic_task": dv3_params["target_critic"],
+        "actor_exploration": actor_exploration_params,
+        "critics_exploration": critics_exploration_params,
+    }
+
+    # Point the player at the requested behaviour policy (reference agent.py:207-216).
+    if cfg.algo.player.actor_type == "exploration":
+        player.actor = actor_exploration
+        player.actor_params = actor_exploration_params
+    return modules, params, player

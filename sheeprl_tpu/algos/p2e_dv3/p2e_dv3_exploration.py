@@ -1,0 +1,899 @@
+"""Plan2Explore (DV3) — exploration phase (reference
+sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:41-1059).
+
+One jitted train call per iteration `lax.scan`s over the G gradient steps; each step
+fuses (1) the DV3 world-model update — with the reward/continue heads trained on
+DETACHED latents so task-reward gradients cannot shape the exploration-phase world
+model (reference :154-161) — (2) the ensemble update (next-stochastic-state MSE
+log-likelihood, reference :205-227), (3) the exploration actor with a *weighted set*
+of two-hot exploration critics (intrinsic = ensemble-disagreement reward, task =
+learned reward model; advantages normalized per-critic by its own Moments state and
+weight-averaged, reference :259-305), (4) one two-hot critic update per exploration
+critic with its EMA target regularizer (:344-369), and (5) the zero-shot task
+actor/critic exactly as in DreamerV3 (:375-487). All EMA target updates run in-graph
+via `lax.cond` on the step counter (replacing the reference's host-side copies,
+:917-930).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict, NamedTuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput
+from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import (
+    MomentsState,
+    compute_lambda_values,
+    init_moments,
+    prepare_obs,
+    test,
+    update_moments,
+)
+from sheeprl_tpu.algos.p2e_dv3.agent import P2EDV3Modules, build_agent
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.ops.distributions import (
+    BernoulliSafeMode,
+    Independent,
+    MSEDistribution,
+    OneHotCategorical,
+    SymlogDistribution,
+    TwoHotEncodingDistribution,
+)
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+from functools import partial
+
+
+class P2EDV3OptStates(NamedTuple):
+    world: Any
+    ensembles: Any
+    actor_task: Any
+    critic_task: Any
+    actor_exploration: Any
+    critics_exploration: Dict[str, Any]
+
+
+def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, actions_dim):
+    """Build (init_opt, train): jitted G-step scan over the five P2E-DV3 updates.
+
+    The moments argument/return is a dict ``{"task": MomentsState, <critic_key>:
+    MomentsState, ...}`` — the per-critic percentile normalizers of the reference's
+    ``moments_exploration``/``moments_task`` (p2e_dv3_exploration.py:660-675).
+    """
+    rssm = modules.rssm
+    ensembles = modules.ensembles
+    critics_spec = modules.critics_exploration  # {key: {weight, reward_type}} — static
+    critic_keys = list(critics_spec.keys())
+    weights_sum = sum(c["weight"] for c in critics_spec.values())
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    kl_dynamic = float(cfg.algo.world_model.kl_dynamic)
+    kl_representation = float(cfg.algo.world_model.kl_representation)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
+    intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
+    stoch_size = rssm.stoch_state_size
+    cnn_keys = list(cfg.algo.cnn_keys.encoder)
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    mlp_keys_dec = list(cfg.algo.mlp_keys.decoder)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    tau = float(cfg.algo.critic.tau)
+    moments_cfg = cfg.algo.actor.moments
+    data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+
+    world_tx = with_clipping(
+        instantiate(dict(cfg.algo.world_model.optimizer))(), cfg.algo.world_model.clip_gradients
+    )
+    ens_tx = with_clipping(instantiate(dict(cfg.algo.ensembles.optimizer))(), cfg.algo.ensembles.clip_gradients)
+    actor_tx = with_clipping(instantiate(dict(cfg.algo.actor.optimizer))(), cfg.algo.actor.clip_gradients)
+    critic_tx = with_clipping(instantiate(dict(cfg.algo.critic.optimizer))(), cfg.algo.critic.clip_gradients)
+
+    def init_opt(params) -> P2EDV3OptStates:
+        return P2EDV3OptStates(
+            world=world_tx.init(params["world_model"]),
+            ensembles=ens_tx.init(params["ensembles"]),
+            actor_task=actor_tx.init(params["actor_task"]),
+            critic_task=critic_tx.init(params["critic_task"]),
+            actor_exploration=actor_tx.init(params["actor_exploration"]),
+            critics_exploration={
+                k: critic_tx.init(params["critics_exploration"][k]["module"]) for k in critic_keys
+            },
+        )
+
+    def init_moments_dict() -> Dict[str, MomentsState]:
+        return {"task": init_moments(), **{k: init_moments() for k in critic_keys}}
+
+    def ema(new_p, old_p, tau_eff):
+        return jax.tree_util.tree_map(lambda p, tp: tau_eff * p + (1.0 - tau_eff) * tp, new_p, old_p)
+
+    def norm_moments(key_name, moments, lambda_values):
+        return update_moments(
+            moments[key_name],
+            lambda_values,
+            decay=float(moments_cfg.decay),
+            max_=float(moments_cfg.max),
+            percentile_low=float(moments_cfg.percentile.low),
+            percentile_high=float(moments_cfg.percentile.high),
+        )
+
+    def imagine(actor_mod, actor_params, wm_params, start_prior, start_recurrent, key0, keys):
+        """H+1-step differentiable imagination (reference :259-283): actions come
+        from the actor on the (detached) latent, then one RSSM imagination step."""
+        latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
+        out0 = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent0)))
+        actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)
+
+        def step(carry, k):
+            prior_flat, rec_state, act = carry
+            k_img_step, k_act_step = jax.random.split(k)
+            prior, rec_state = rssm.imagination_step(wm_params, prior_flat, rec_state, act, k_img_step)
+            prior_flat = prior.reshape(prior_flat.shape)
+            latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
+            out = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent)))
+            new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
+            return (prior_flat, rec_state, new_act), (latent, new_act)
+
+        _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
+        trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
+        im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
+        return trajectories, im_actions
+
+    def actor_objective(actor_mod, actor_params, trajectories, im_actions, advantage):
+        policies = ActorOutput(
+            actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(trajectories))
+        )
+        if is_continuous:
+            objective = advantage
+        else:
+            splits = np.cumsum(np.asarray(actions_dim))[:-1]
+            action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+            log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))  # [H+1, TB]
+            objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
+        try:
+            entropy = ent_coef * policies.entropy()
+        except NotImplementedError:
+            entropy = jnp.zeros(trajectories.shape[:-1], dtype=jnp.float32)
+        return objective, entropy
+
+    def twohot_critic_loss(critic_mod, critic_params, target_params, trajectories, lambda_values, discount):
+        """Two-hot critic regression onto λ-targets + EMA-target regularizer
+        (reference :344-369 per exploration critic, :460-476 task)."""
+        qv = TwoHotEncodingDistribution(critic_mod.apply(critic_params, trajectories[:-1]), dims=1)
+        predicted_target_values = TwoHotEncodingDistribution(
+            critic_mod.apply(target_params, trajectories[:-1]), dims=1
+        ).mean
+        value_loss = -qv.log_prob(lambda_values) - qv.log_prob(jax.lax.stop_gradient(predicted_target_values))
+        return jnp.mean(value_loss * discount[:-1][..., 0])
+
+    def one_step(carry, inp):
+        params, opt_states, moments, counter = carry
+        data, key = inp
+        data = jax.tree_util.tree_map(lambda v: jax.lax.with_sharding_constraint(v, data_sharding), data)
+        k_wm, k_expl0, k_expl, k_task0, k_task = jax.random.split(key, 5)
+
+        # ---- EMA target critics (reference :917-930): tau=1 on the first step
+        def do_ema(targets):
+            tau_eff = jnp.where(counter == 0, 1.0, tau)
+            new_task = ema(params["critic_task"], targets[0], tau_eff)
+            new_expl = {
+                k: ema(params["critics_exploration"][k]["module"], targets[1][k], tau_eff)
+                for k in critic_keys
+            }
+            return (new_task, new_expl)
+
+        old_targets = (
+            params["target_critic_task"],
+            {k: params["critics_exploration"][k]["target_module"] for k in critic_keys},
+        )
+        target_critic_task, target_critics_expl = jax.lax.cond(
+            counter % target_freq == 0, do_ema, lambda t: t, old_targets
+        )
+
+        batch_obs = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
+        batch_obs.update({k: data[k].astype(jnp.float32) for k in mlp_keys})
+        is_first = data["is_first"].astype(jnp.float32).at[0].set(1.0)
+        actions = data["actions"].astype(jnp.float32)
+        batch_actions = jnp.concatenate([jnp.zeros_like(actions[:1]), actions[:-1]], axis=0)
+        rewards = data["rewards"].astype(jnp.float32)
+        continues_targets = 1.0 - data["terminated"].astype(jnp.float32)
+
+        # ---- (1) world-model update; reward/continue heads on DETACHED latents
+        # (reference :154-161)
+        def world_loss_fn(wm_params):
+            embedded = modules.encoder.apply(wm_params["encoder"], batch_obs)
+            recurrent_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
+                wm_params, embedded, batch_actions, is_first, k_wm
+            )
+            latent_states = jnp.concatenate(
+                [posteriors.reshape(*posteriors.shape[:-2], -1), recurrent_states], axis=-1
+            )
+            reconstructed = modules.observation_model.apply(wm_params["observation_model"], latent_states)
+            po_log_probs = {
+                k: MSEDistribution(reconstructed[k], dims=reconstructed[k].ndim - 2).log_prob(batch_obs[k])
+                for k in cnn_keys_dec
+            }
+            po_log_probs.update(
+                {
+                    k: SymlogDistribution(reconstructed[k], dims=reconstructed[k].ndim - 2).log_prob(batch_obs[k])
+                    for k in mlp_keys_dec
+                }
+            )
+            detached_latents = jax.lax.stop_gradient(latent_states)
+            pr = TwoHotEncodingDistribution(
+                modules.reward_model.apply(wm_params["reward_model"], detached_latents), dims=1
+            )
+            pc = Independent(
+                BernoulliSafeMode(
+                    logits=modules.continue_model.apply(wm_params["continue_model"], detached_latents)
+                ),
+                1,
+            )
+            loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
+                po_log_probs,
+                pr.log_prob(rewards),
+                priors_logits,
+                posteriors_logits,
+                kl_dynamic,
+                kl_representation,
+                kl_free_nats,
+                kl_regularizer,
+                pc.log_prob(continues_targets),
+                continue_scale_factor,
+            )
+            aux = {
+                "posteriors": posteriors,
+                "recurrent_states": recurrent_states,
+                "priors_logits": priors_logits,
+                "posteriors_logits": posteriors_logits,
+                "kl": kl,
+                "state_loss": state_loss,
+                "reward_loss": reward_loss,
+                "observation_loss": observation_loss,
+                "continue_loss": continue_loss,
+            }
+            return loss, aux
+
+        (world_loss, aux), world_grads = jax.value_and_grad(world_loss_fn, has_aux=True)(params["world_model"])
+        world_grad_norm = optax.global_norm(world_grads)
+        world_updates, world_opt = world_tx.update(world_grads, opt_states.world, params["world_model"])
+        new_wm = optax.apply_updates(params["world_model"], world_updates)
+
+        posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S, D]
+        recurrent_states = jax.lax.stop_gradient(aux["recurrent_states"])  # [T, B, R]
+        posteriors_flat = posteriors.reshape(*posteriors.shape[:-2], -1)
+
+        # ---- (2) ensemble update: predict posterior[t+1] from (post, h, action)[t]
+        # with an MSE head (reference :205-227); raw (unshifted) actions as input.
+        ens_input = jnp.concatenate([posteriors_flat, recurrent_states, actions], axis=-1)
+
+        def ensemble_loss_fn(ens_params):
+            out = ensembles.apply(ens_params, ens_input)  # [N, T, B, S*D]
+            if out.shape[1] < 2:
+                # T == 1: there is no next-state target, and a mean over the empty
+                # [:, :-1] slice would be NaN and poison every downstream param.
+                return 0.0 * jnp.sum(out)
+            out = out[:, :-1]  # [N, T-1, B, S*D]
+            target = jnp.broadcast_to(posteriors_flat[None, 1:], out.shape)
+            log_prob = MSEDistribution(out, dims=1).log_prob(target)
+            return -(log_prob.mean(axis=(1, 2)).sum())
+
+        ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(params["ensembles"])
+        ens_grad_norm = optax.global_norm(ens_grads)
+        ens_updates, ens_opt = ens_tx.update(ens_grads, opt_states.ensembles, params["ensembles"])
+        new_ens = optax.apply_updates(params["ensembles"], ens_updates)
+
+        start_prior = posteriors_flat.reshape(1, -1, stoch_size)[0]  # [T*B, S*D]
+        start_recurrent = recurrent_states.reshape(1, -1, recurrent_states.shape[-1])[0]
+        true_continue = continues_targets.reshape(-1, 1)  # [T*B, 1]
+        expl_keys = jax.random.split(k_expl, horizon)
+        task_keys = jax.random.split(k_task, horizon)
+
+        # ---- (3) exploration actor on the weighted multi-critic advantage
+        # (reference :259-333)
+        def actor_expl_loss_fn(actor_params):
+            trajectories, im_actions = imagine(
+                modules.actor_exploration, actor_params, new_wm, start_prior, start_recurrent, k_expl0, expl_keys
+            )
+            continues = Independent(
+                BernoulliSafeMode(logits=modules.continue_model.apply(new_wm["continue_model"], trajectories)), 1
+            ).base.mode
+            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+
+            # Intrinsic (disagreement) reward is shared by every intrinsic critic
+            ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, im_actions], axis=-1))
+            ens_preds = ensembles.apply(new_ens, ens_in)  # [N, H+1, TB, S*D]
+            intrinsic_reward = (
+                ens_preds.var(axis=0).mean(axis=-1, keepdims=True) * intrinsic_reward_multiplier
+            )
+            extrinsic_reward = TwoHotEncodingDistribution(
+                modules.reward_model.apply(new_wm["reward_model"], trajectories), dims=1
+            ).mean
+
+            advantage = 0.0
+            new_moments = {}
+            per_critic = {}
+            for k in critic_keys:
+                spec = critics_spec[k]
+                predicted_values = TwoHotEncodingDistribution(
+                    modules.critic_exploration.apply(params["critics_exploration"][k]["module"], trajectories),
+                    dims=1,
+                ).mean
+                reward = intrinsic_reward if spec["reward_type"] == "intrinsic" else extrinsic_reward
+                lambda_values = compute_lambda_values(
+                    reward[1:], predicted_values[1:], continues[1:] * gamma, lmbda=lmbda
+                )
+                offset, invscale, new_moments[k] = norm_moments(k, moments, lambda_values)
+                normed_lambda = (lambda_values - offset) / invscale
+                normed_baseline = (predicted_values[:-1] - offset) / invscale
+                advantage = advantage + (normed_lambda - normed_baseline) * (spec["weight"] / weights_sum)
+                per_critic[k] = {
+                    "lambda_values": lambda_values,
+                    "predicted_values": predicted_values,
+                }
+
+            objective, entropy = actor_objective(
+                modules.actor_exploration, actor_params, trajectories, im_actions, advantage
+            )
+            p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
+            aux_e = {
+                "trajectories": trajectories,
+                "discount": discount,
+                "moments": new_moments,
+                "per_critic": per_critic,
+                "intrinsic_reward": intrinsic_reward,
+            }
+            return p_loss, aux_e
+
+        (policy_loss_expl, aux_e), actor_expl_grads = jax.value_and_grad(actor_expl_loss_fn, has_aux=True)(
+            params["actor_exploration"]
+        )
+        actor_expl_gn = optax.global_norm(actor_expl_grads)
+        actor_expl_updates, actor_expl_opt = actor_tx.update(
+            actor_expl_grads, opt_states.actor_exploration, params["actor_exploration"]
+        )
+        new_actor_expl = optax.apply_updates(params["actor_exploration"], actor_expl_updates)
+
+        # ---- (4) per-key exploration critic updates on the detached trajectories
+        expl_traj = jax.lax.stop_gradient(aux_e["trajectories"])
+        expl_discount = aux_e["discount"]
+        new_critics_expl: Dict[str, Dict[str, Any]] = {}
+        new_critics_opt: Dict[str, Any] = {}
+        value_losses_expl = {}
+        critic_expl_gns = {}
+        for k in critic_keys:
+            lam_k = jax.lax.stop_gradient(aux_e["per_critic"][k]["lambda_values"])
+            loss_fn = partial(
+                twohot_critic_loss,
+                modules.critic_exploration,
+                target_params=target_critics_expl[k],
+                trajectories=expl_traj,
+                lambda_values=lam_k,
+                discount=expl_discount,
+            )
+            v_loss, c_grads = jax.value_and_grad(lambda p: loss_fn(p))(params["critics_exploration"][k]["module"])
+            critic_expl_gns[k] = optax.global_norm(c_grads)
+            c_updates, c_opt = critic_tx.update(
+                c_grads, opt_states.critics_exploration[k], params["critics_exploration"][k]["module"]
+            )
+            new_critics_expl[k] = {
+                "module": optax.apply_updates(params["critics_exploration"][k]["module"], c_updates),
+                "target_module": target_critics_expl[k],
+            }
+            new_critics_opt[k] = c_opt
+            value_losses_expl[k] = v_loss
+
+        # ---- (5) zero-shot task behaviour, exactly DreamerV3 (reference :375-487)
+        def actor_task_loss_fn(actor_params):
+            trajectories, im_actions = imagine(
+                modules.actor_task, actor_params, new_wm, start_prior, start_recurrent, k_task0, task_keys
+            )
+            predicted_values = TwoHotEncodingDistribution(
+                modules.critic_task.apply(params["critic_task"], trajectories), dims=1
+            ).mean
+            predicted_rewards = TwoHotEncodingDistribution(
+                modules.reward_model.apply(new_wm["reward_model"], trajectories), dims=1
+            ).mean
+            continues = Independent(
+                BernoulliSafeMode(logits=modules.continue_model.apply(new_wm["continue_model"], trajectories)), 1
+            ).base.mode
+            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+            lambda_values = compute_lambda_values(
+                predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda=lmbda
+            )
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+            offset, invscale, new_task_moments = norm_moments("task", moments, lambda_values)
+            advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
+            objective, entropy = actor_objective(
+                modules.actor_task, actor_params, trajectories, im_actions, advantage
+            )
+            p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
+            aux_t = {
+                "trajectories": trajectories,
+                "lambda_values": lambda_values,
+                "discount": discount,
+                "moments": new_task_moments,
+            }
+            return p_loss, aux_t
+
+        (policy_loss_task, aux_t), actor_task_grads = jax.value_and_grad(actor_task_loss_fn, has_aux=True)(
+            params["actor_task"]
+        )
+        actor_task_gn = optax.global_norm(actor_task_grads)
+        actor_task_updates, actor_task_opt = actor_tx.update(
+            actor_task_grads, opt_states.actor_task, params["actor_task"]
+        )
+        new_actor_task = optax.apply_updates(params["actor_task"], actor_task_updates)
+
+        task_traj = jax.lax.stop_gradient(aux_t["trajectories"])
+        task_lambda = jax.lax.stop_gradient(aux_t["lambda_values"])
+        value_loss_task, critic_task_grads = jax.value_and_grad(
+            lambda p: twohot_critic_loss(
+                modules.critic_task, p, target_critic_task, task_traj, task_lambda, aux_t["discount"]
+            )
+        )(params["critic_task"])
+        critic_task_gn = optax.global_norm(critic_task_grads)
+        critic_task_updates, critic_task_opt = critic_tx.update(
+            critic_task_grads, opt_states.critic_task, params["critic_task"]
+        )
+        new_critic_task = optax.apply_updates(params["critic_task"], critic_task_updates)
+
+        post_ent = Independent(OneHotCategorical(logits=aux["posteriors_logits"]), 1).entropy().mean()
+        prior_ent = Independent(OneHotCategorical(logits=aux["priors_logits"]), 1).entropy().mean()
+
+        new_params = {
+            "world_model": new_wm,
+            "ensembles": new_ens,
+            "actor_task": new_actor_task,
+            "critic_task": new_critic_task,
+            "target_critic_task": target_critic_task,
+            "actor_exploration": new_actor_expl,
+            "critics_exploration": new_critics_expl,
+        }
+        new_opt = P2EDV3OptStates(
+            world=world_opt,
+            ensembles=ens_opt,
+            actor_task=actor_task_opt,
+            critic_task=critic_task_opt,
+            actor_exploration=actor_expl_opt,
+            critics_exploration=new_critics_opt,
+        )
+        new_moments = {"task": aux_t["moments"], **aux_e["moments"]}
+        metrics = {
+            "Loss/world_model_loss": world_loss,
+            "Loss/observation_loss": aux["observation_loss"],
+            "Loss/reward_loss": aux["reward_loss"],
+            "Loss/state_loss": aux["state_loss"],
+            "Loss/continue_loss": aux["continue_loss"],
+            "State/kl": aux["kl"],
+            "State/post_entropy": post_ent,
+            "State/prior_entropy": prior_ent,
+            "Loss/ensemble_loss": ens_loss,
+            "Loss/policy_loss_exploration": policy_loss_expl,
+            "Loss/policy_loss_task": policy_loss_task,
+            "Loss/value_loss_task": value_loss_task,
+            "Grads/world_model": world_grad_norm,
+            "Grads/ensemble": ens_grad_norm,
+            "Grads/actor_exploration": actor_expl_gn,
+            "Grads/actor_task": actor_task_gn,
+            "Grads/critic_task": critic_task_gn,
+        }
+        for k in critic_keys:
+            metrics[f"Loss/value_loss_exploration_{k}"] = value_losses_expl[k]
+            metrics[f"Values_exploration/predicted_values_{k}"] = aux_e["per_critic"][k][
+                "predicted_values"
+            ].mean()
+            metrics[f"Values_exploration/lambda_values_{k}"] = aux_e["per_critic"][k]["lambda_values"].mean()
+            metrics[f"Grads/critic_exploration_{k}"] = critic_expl_gns[k]
+            if critics_spec[k]["reward_type"] == "intrinsic":
+                metrics[f"Rewards/intrinsic_{k}"] = aux_e["intrinsic_reward"].mean()
+        return (new_params, new_opt, new_moments, counter + 1), metrics
+
+    def train(params, opt_states, moments, counter, batches, key):
+        g = next(iter(batches.values())).shape[0]
+        keys = jax.random.split(key, g)
+        (params, opt_states, moments, counter), metrics = jax.lax.scan(
+            one_step, (params, opt_states, moments, counter), (batches, keys)
+        )
+        named = {k: v.mean(axis=0) for k, v in metrics.items()}
+        return params, opt_states, moments, counter, named
+
+    return init_opt, init_moments_dict, jax.jit(train, donate_argnums=(0, 1, 2))
+
+
+def expand_critic_metric_keys(cfg, critics_spec) -> None:
+    """Clone the generic exploration-critic metric specs into per-key specs
+    (reference p2e_dv3_exploration.py:679-708). ``Rewards/intrinsic`` is only
+    cloned for intrinsic-reward critics — the train step never emits it for
+    task-reward ones."""
+    if "aggregator" not in cfg.metric or "metrics" not in cfg.metric.aggregator:
+        return
+    metrics_cfg = cfg.metric.aggregator.metrics
+    generic = [
+        "Loss/value_loss_exploration",
+        "Values_exploration/predicted_values",
+        "Values_exploration/lambda_values",
+        "Grads/critic_exploration",
+    ]
+    for k, spec in critics_spec.items():
+        for g in generic:
+            if g in metrics_cfg:
+                metrics_cfg[f"{g}_{k}"] = metrics_cfg[g]
+        if spec["reward_type"] == "intrinsic" and "Rewards/intrinsic" in metrics_cfg:
+            metrics_cfg[f"Rewards/intrinsic_{k}"] = metrics_cfg["Rewards/intrinsic"]
+    for g in generic + ["Rewards/intrinsic"]:
+        metrics_cfg.pop(g, None)
+
+
+@register_algorithm()
+def main(runtime, cfg: Dict[str, Any]):
+    world_size = runtime.world_size
+    rank = runtime.global_rank
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    # These arguments cannot be changed (reference p2e_dv3_exploration.py:540-542)
+    cfg.env.frame_stack = 1
+    cfg.algo.player.actor_type = "exploration"
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    envs = vectorized_env(
+        [
+            partial(
+                RestartOnException,
+                make_env(
+                    cfg,
+                    cfg.seed + rank * cfg.env.num_envs + i,
+                    rank * cfg.env.num_envs,
+                    log_dir if runtime.is_global_zero else None,
+                    "train",
+                    vector_env_idx=i,
+                ),
+            )
+            for i in range(cfg.env.num_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if (
+        len(set(cfg.algo.cnn_keys.encoder).intersection(set(cfg.algo.cnn_keys.decoder))) == 0
+        and len(set(cfg.algo.mlp_keys.encoder).intersection(set(cfg.algo.mlp_keys.decoder))) == 0
+    ):
+        raise RuntimeError("The CNN keys or the MLP keys of the encoder and decoder must not be disjointed")
+    if len(set(cfg.algo.cnn_keys.decoder) - set(cfg.algo.cnn_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The CNN keys of the decoder must be contained in the encoder ones. "
+            f"Those keys are decoded without being encoded: {list(set(cfg.algo.cnn_keys.decoder))}"
+        )
+    if len(set(cfg.algo.mlp_keys.decoder) - set(cfg.algo.mlp_keys.encoder)) > 0:
+        raise RuntimeError(
+            "The MLP keys of the decoder must be contained in the encoder ones. "
+            f"Those keys are decoded without being encoded: {list(set(cfg.algo.mlp_keys.decoder))}"
+        )
+    obs_keys = list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+
+    modules, params, player = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"] if state else None,
+        state["ensembles"] if state else None,
+        state["actor_task"] if state else None,
+        state["critic_task"] if state else None,
+        state["target_critic_task"] if state else None,
+        state["actor_exploration"] if state else None,
+        state["critics_exploration"] if state else None,
+    )
+    critic_keys = list(modules.critics_exploration.keys())
+    expand_critic_metric_keys(cfg, modules.critics_exploration)
+
+    init_opt, init_moments_dict, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    moments = init_moments_dict()
+    if state and "moments_task" in state:
+        moments["task"] = MomentsState(*[jnp.asarray(v) for v in state["moments_task"]])
+        for k in critic_keys:
+            if f"moments_exploration_{k}" in state:
+                moments[k] = MomentsState(*[jnp.asarray(v) for v in state[f"moments_exploration_{k}"]])
+    counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
+    params = runtime.replicate(params)
+    opt_states = runtime.replicate(opt_states)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=cfg.env.num_envs,
+        obs_keys=tuple(obs_keys),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    train_step = 0
+    last_train = 0
+    start_iter = (state["iter_num"] // world_size) + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    policy_steps_per_iter = int(cfg.env.num_envs * world_size)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    step_data: Dict[str, np.ndarray] = {}
+    obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        step_data[k] = np.asarray(obs[k])[np.newaxis]
+    step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["is_first"] = np.ones_like(step_data["terminated"])
+    player.init_states()
+
+    cumulative_per_rank_gradient_steps = 0
+    for iter_num in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        with timer("Time/env_interaction_time", SumMetric()):
+            if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():
+                real_actions = actions = np.array(envs.action_space.sample())
+                if not is_continuous:
+                    actions = np.concatenate(
+                        [
+                            np.eye(act_dim, dtype=np.float32)[act.reshape(-1)]
+                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                        ],
+                        axis=-1,
+                    )
+            else:
+                jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+                rng, act_key = jax.random.split(rng)
+                actions_list = player.get_actions(jax_obs, act_key, mask=mask)
+                actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+                if is_continuous:
+                    real_actions = actions
+                else:
+                    real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
+
+            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            next_obs, rewards, terminated, truncated, infos = envs.step(
+                real_actions.reshape(envs.action_space.shape)
+            )
+            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
+        if "restart_on_exception" in infos:
+            for i, agent_roe in enumerate(infos["restart_on_exception"]):
+                if agent_roe and not dones[i]:
+                    last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
+                    rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["terminated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
+                        rb.buffer[i]["truncated"][last_inserted_idx]
+                    )
+                    rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
+                        rb.buffer[i]["is_first"][last_inserted_idx]
+                    )
+                    step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
+
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                if aggregator:
+                    if "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+        finals = final_observations(infos, obs_keys)
+        if finals:
+            for idx, final_obs in finals.items():
+                for k, v in final_obs.items():
+                    real_next_obs[k][idx] = v
+
+        for k in obs_keys:
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+        obs = next_obs
+
+        rewards = np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+        step_data["rewards"] = clip_rewards_fn(rewards)
+
+        dones_idxes = dones.nonzero()[0].tolist()
+        reset_envs = len(dones_idxes)
+        if reset_envs > 0:
+            reset_data = {}
+            for k in obs_keys:
+                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+
+            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
+            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
+            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
+            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+            player.init_states(dones_idxes)
+
+        if iter_num >= learning_starts:
+            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+            per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0:
+                local_data = rb.sample(
+                    cfg.algo.per_rank_batch_size * world_size,
+                    sequence_length=cfg.algo.per_rank_sequence_length,
+                    n_samples=per_rank_gradient_steps,
+                )
+                with timer("Time/train_time", SumMetric()):
+                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_states, moments, counter, train_metrics = train_fn(
+                        params, opt_states, moments, counter, batches, train_key
+                    )
+                    jax.block_until_ready(params["actor_exploration"])
+                    player.wm_params = params["world_model"]
+                    player.actor_params = params["actor_exploration"]
+                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                    train_step += world_size * per_rank_gradient_steps
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+
+        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            if aggregator and not aggregator.disabled:
+                logger.log_metrics(aggregator.compute(), policy_step)
+                aggregator.reset()
+            if logger and policy_step > 0:
+                logger.log_metrics(
+                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
+                    policy_step,
+                )
+            if not timer.disabled:
+                timer_metrics = timer.compute()
+                if logger and timer_metrics.get("Time/train_time", 0) > 0:
+                    logger.log_metrics(
+                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        policy_step,
+                    )
+                if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                    logger.log_metrics(
+                        {
+                            "Time/sps_env_interaction": (
+                                (policy_step - last_log) / world_size * cfg.env.action_repeat
+                            )
+                            / timer_metrics["Time/env_interaction_time"]
+                        },
+                        policy_step,
+                    )
+                timer.reset()
+            last_log = policy_step
+            last_train = train_step
+
+        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+            iter_num == total_iters and cfg.checkpoint.save_last
+        ):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "world_model": jax.device_get(params["world_model"]),
+                "ensembles": jax.device_get(params["ensembles"]),
+                "actor_task": jax.device_get(params["actor_task"]),
+                "critic_task": jax.device_get(params["critic_task"]),
+                "target_critic_task": jax.device_get(params["target_critic_task"]),
+                "actor_exploration": jax.device_get(params["actor_exploration"]),
+                "critics_exploration": jax.device_get(params["critics_exploration"]),
+                "opt_states": jax.device_get(opt_states),
+                "moments_task": tuple(np.asarray(v) for v in moments["task"]),
+                **{
+                    f"moments_exploration_{k}": tuple(np.asarray(v) for v in moments[k])
+                    for k in critic_keys
+                },
+                "counter": int(counter),
+                "ratio": ratio.state_dict(),
+                "iter_num": iter_num * world_size,
+                "batch_size": cfg.algo.per_rank_batch_size * world_size,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+            runtime.call(
+                "on_checkpoint_coupled",
+                ckpt_path=ckpt_path,
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    # Zero-shot evaluation runs with the TASK policy (reference :1032-1036).
+    if runtime.is_global_zero and cfg.algo.run_test:
+        player.actor = modules.actor_task
+        player.actor_params = params["actor_task"]
+        player.actor_type = "task"
+        test(player, runtime, cfg, log_dir, "zero-shot", greedy=False)
+    if logger:
+        logger.finalize()

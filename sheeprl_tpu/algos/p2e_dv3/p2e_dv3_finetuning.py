@@ -1,10 +1,11 @@
-"""Plan2Explore (DV2) — finetuning phase (reference
-sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py:35-509).
+"""Plan2Explore (DV3) — finetuning phase (reference
+sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py:28-477).
 
 Loads the exploration checkpoint, pins the model hyper-parameters to the
-exploration run's, and finetunes the TASK actor-critic (+ target critic, + world
-model) with the plain DreamerV2 train step on real rewards. The player rolls out
-with the exploration policy until training starts, then switches to the task policy.
+exploration run's, and finetunes the TASK actor-critic (+ EMA target critic, +
+world model, + Moments) with the plain DreamerV3 train step on real rewards. The
+player rolls out with the exploration policy until training starts, then switches
+to the task policy (reference :331-338).
 """
 
 from __future__ import annotations
@@ -19,12 +20,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.dreamer_v2.agent import expl_amount_schedule
-from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import DV2OptStates, make_train_fn
-from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
-from sheeprl_tpu.algos.p2e_dv2.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import DV3OptStates, make_train_fn
+from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test
+from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.checkpoint import load_state
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -44,15 +44,16 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     state = load_state(cfg.checkpoint.resume_from if resumed else str(ckpt_path))
 
     # All the models must be equal to the ones of the exploration phase
-    # (reference p2e_dv2_finetuning.py:52-75).
+    # (reference p2e_dv3_finetuning.py:45-70).
     cfg.algo.gamma = exploration_cfg.algo.gamma
     cfg.algo.lmbda = exploration_cfg.algo.lmbda
     cfg.algo.horizon = exploration_cfg.algo.horizon
-    cfg.algo.layer_norm = exploration_cfg.algo.layer_norm
     cfg.algo.dense_units = exploration_cfg.algo.dense_units
     cfg.algo.mlp_layers = exploration_cfg.algo.mlp_layers
     cfg.algo.dense_act = exploration_cfg.algo.dense_act
     cfg.algo.cnn_act = exploration_cfg.algo.cnn_act
+    cfg.algo.unimix = exploration_cfg.algo.unimix
+    cfg.algo.hafner_initialization = exploration_cfg.algo.hafner_initialization
     cfg.algo.world_model = exploration_cfg.algo.world_model
     cfg.algo.actor = exploration_cfg.algo.actor
     cfg.algo.critic = exploration_cfg.algo.critic
@@ -62,8 +63,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     cfg.algo.cnn_keys = exploration_cfg.algo.cnn_keys
     cfg.algo.mlp_keys = exploration_cfg.algo.mlp_keys
 
-    # These arguments cannot be changed
-    cfg.env.screen_size = 64
+    # These arguments cannot be changed (reference :72-73)
     cfg.env.frame_stack = 1
 
     logger = get_logger(runtime, cfg)
@@ -120,12 +120,11 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         state["target_critic_task"],
         state["actor_exploration"],
         None,
-        None,
     )
 
-    # Finetune the TASK behaviour with the plain DV2 step on real rewards.
-    dv2_modules = modules.as_dv2(task=True)
-    init_opt, train_fn = make_train_fn(dv2_modules, cfg, runtime, is_continuous, actions_dim)
+    # Finetune the TASK behaviour with the plain DV3 step on real rewards.
+    dv3_modules = modules.as_dv3(task=True)
+    init_opt, train_fn = make_train_fn(dv3_modules, cfg, runtime, is_continuous, actions_dim)
     fine_params = {
         "world_model": params["world_model"],
         "actor": params["actor_task"],
@@ -137,15 +136,20 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
     elif "opt_states" in state:
         # Carry over the world/actor_task/critic_task optimizer moments from the
-        # exploration phase (reference p2e_dv2_finetuning.py:171-177).
+        # exploration phase (reference p2e_dv3_finetuning.py:153-160).
         expl_opt = state["opt_states"]
         get = expl_opt.get if isinstance(expl_opt, dict) else lambda name, d=None: getattr(expl_opt, name, d)
         world, actor, critic = get("world"), get("actor_task"), get("critic_task")
-        opt_states = DV2OptStates(
+        opt_states = DV3OptStates(
             world=jax.tree_util.tree_map(jnp.asarray, world) if world is not None else opt_states.world,
             actor=jax.tree_util.tree_map(jnp.asarray, actor) if actor is not None else opt_states.actor,
             critic=jax.tree_util.tree_map(jnp.asarray, critic) if critic is not None else opt_states.critic,
         )
+    moments_state = init_moments()
+    if "moments_task" in state:
+        moments_state = MomentsState(*[jnp.asarray(v) for v in state["moments_task"]])
+    elif resumed and "moments" in state:
+        moments_state = MomentsState(*[jnp.asarray(v) for v in state["moments"]])
     counter = jnp.int32(state["counter"]) if resumed and "counter" in state else jnp.int32(0)
     fine_params = runtime.replicate(fine_params)
     opt_states = runtime.replicate(opt_states)
@@ -158,30 +162,14 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         aggregator = instantiate(cfg.metric.aggregator)
 
     buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
-    buffer_type = str(cfg.buffer.type).lower()
-    if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=cfg.env.num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
-    elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            buffer_size,
-            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
-            n_envs=cfg.env.num_envs,
-            obs_keys=tuple(obs_keys),
-            prioritize_ends=cfg.buffer.prioritize_ends,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        )
-    else:
-        raise ValueError(
-            f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
-        )
+    rb = EnvIndependentReplayBuffer(
+        buffer_size,
+        n_envs=cfg.env.num_envs,
+        obs_keys=tuple(obs_keys),
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+        buffer_cls=SequentialReplayBuffer,
+    )
     if "rb" in state and (resumed or (cfg.buffer.load_from_exploration and exploration_cfg.buffer.checkpoint)):
         rb.load_state_dict(state["rb"])
 
@@ -220,20 +208,11 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]
-    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
-    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
-    if cfg.dry_run:
-        step_data["truncated"] = step_data["truncated"] + 1
-        step_data["terminated"] = step_data["terminated"] + 1
-    step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))))
     step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["truncated"] = np.zeros((1, cfg.env.num_envs, 1))
+    step_data["terminated"] = np.zeros((1, cfg.env.num_envs, 1))
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player.init_states()
-
-    base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
-    expl_decay = float(cfg.algo.actor.get("expl_decay", 0.0))
-    expl_min = float(cfg.algo.actor.get("expl_min", 0.0))
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
@@ -241,24 +220,24 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
         with timer("Time/env_interaction_time", SumMetric()):
             jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+            mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
             rng, act_key = jax.random.split(rng)
-            player.expl_amount = expl_amount_schedule(base_expl_amount, expl_decay, expl_min, policy_step)
-            actions_list = player.get_actions(jax_obs, act_key)
+            actions_list = player.get_actions(jax_obs, act_key, mask=mask)
             actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
             if is_continuous:
                 real_actions = actions
             else:
                 real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
 
-            step_data["is_first"] = np.logical_or(step_data["terminated"], step_data["truncated"]).astype(
-                np.float32
-            )
+            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
             )
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
-            if cfg.dry_run and buffer_type == "episode":
-                dones = np.ones_like(dones)
+
+        step_data["is_first"] = np.zeros_like(step_data["terminated"])
 
         if cfg.metric.log_level > 0:
             for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
@@ -277,34 +256,31 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     real_next_obs[k][idx] = v
 
         for k in obs_keys:
-            step_data[k] = real_next_obs[k][np.newaxis]
+            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
         obs = next_obs
 
+        rewards = np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
         step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
         step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        if cfg.dry_run and buffer_type == "episode":
-            step_data["terminated"] = np.ones_like(step_data["terminated"])
-        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-        step_data["rewards"] = clip_rewards_fn(
-            np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        )
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        step_data["rewards"] = clip_rewards_fn(rewards)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
         if reset_envs > 0:
             reset_data = {}
             for k in obs_keys:
-                reset_data[k] = (np.asarray(next_obs[k])[dones_idxes])[np.newaxis]
-            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
-            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
-            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
-            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
             rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-            for d in dones_idxes:
-                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
-                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
+
+            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
+            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
+            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
+            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
             player.init_states(dones_idxes)
 
         if iter_num >= learning_starts:
@@ -312,7 +288,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
                 # Switch the player to the task policy once training starts
-                # (reference p2e_dv2_finetuning.py:350-357).
+                # (reference p2e_dv3_finetuning.py:331-338).
                 if player.actor_type != "task":
                     player.actor_type = "task"
                     player.actor = modules.actor_task
@@ -325,8 +301,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 with timer("Time/train_time", SumMetric()):
                     batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
-                    fine_params, opt_states, counter, train_metrics = train_fn(
-                        fine_params, opt_states, counter, batches, train_key
+                    fine_params, opt_states, moments_state, counter, train_metrics = train_fn(
+                        fine_params, opt_states, moments_state, counter, batches, train_key
                     )
                     jax.block_until_ready(fine_params["actor"])
                     player.wm_params = fine_params["world_model"]
@@ -337,10 +313,6 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     for k, v in train_metrics.items():
                         if k in aggregator:
                             aggregator.update(k, float(v))
-                    if "Params/exploration_amount_task" in aggregator:
-                        aggregator.update("Params/exploration_amount_task", player.expl_amount)
-                    if "Params/exploration_amount_exploration" in aggregator:
-                        aggregator.update("Params/exploration_amount_exploration", player.expl_amount)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
             if aggregator and not aggregator.disabled:
@@ -383,6 +355,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 "target_critic_task": jax.device_get(fine_params["target_critic"]),
                 "actor_exploration": jax.device_get(params["actor_exploration"]),
                 "opt_states": jax.device_get(opt_states),
+                "moments_task": tuple(np.asarray(v) for v in moments_state),
                 "counter": int(counter),
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
@@ -403,6 +376,6 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         player.actor = modules.actor_task
         player.actor_params = fine_params["actor"]
         player.actor_type = "task"
-        test(player, runtime, cfg, log_dir, "few-shot")
+        test(player, runtime, cfg, log_dir, "few-shot", greedy=False)
     if logger:
         logger.finalize()

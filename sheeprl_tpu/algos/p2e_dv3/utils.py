@@ -1,0 +1,104 @@
+"""P2E-DV3 utilities (reference sheeprl/algos/p2e_dv3/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v3.utils import AGGREGATOR_KEYS as AGGREGATOR_KEYS_DV3
+from sheeprl_tpu.algos.dreamer_v3.utils import prepare_obs, test  # noqa: F401
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/policy_loss_task",
+    "Loss/value_loss_task",
+    "Loss/policy_loss_exploration",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "Loss/ensemble_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+    "Grads/world_model",
+    "Grads/actor_task",
+    "Grads/critic_task",
+    "Grads/actor_exploration",
+    "Grads/ensemble",
+    # General key names for the exploration critics; the exploration entrypoint
+    # clones them into per-critic-key variants (reference utils.py:38-44).
+    "Loss/value_loss_exploration",
+    "Values_exploration/predicted_values",
+    "Values_exploration/lambda_values",
+    "Grads/critic_exploration",
+    "Rewards/intrinsic",
+}.union(AGGREGATOR_KEYS_DV3)
+MODELS_TO_REGISTER = {
+    "world_model",
+    "ensembles",
+    "actor_exploration",
+    "critic_exploration_intrinsic",
+    "target_critic_exploration_intrinsic",
+    "moments_exploration_intrinsic",
+    "critic_exploration_extrinsic",
+    "target_critic_exploration_extrinsic",
+    "moments_exploration_extrinsic",
+    "actor_task",
+    "critic_task",
+    "target_critic_task",
+    "moments_task",
+}
+
+
+def log_models_from_checkpoint(runtime, env, cfg, state) -> Dict[str, Any]:
+    """Register P2E-DV3 models from a checkpoint (reference utils.py:62-148).
+
+    Exploration checkpoints carry every model (incl. the per-key exploration
+    critics and their Moments); finetuning checkpoints carry the task quadruple +
+    world model + exploration actor.
+    """
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
+    from sheeprl_tpu.utils.model_manager import log_model
+
+    is_continuous = isinstance(env.action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        env.action_space.shape
+        if is_continuous
+        else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+    )
+    exploration = "exploration" in cfg.algo.name
+    _, params, _ = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        env.observation_space,
+        state["world_model"],
+        state["ensembles"] if exploration else None,
+        state["actor_task"],
+        state["critic_task"],
+        state["target_critic_task"],
+        state["actor_exploration"] if "actor_exploration" in state else None,
+        state["critics_exploration"] if exploration else None,
+    )
+    info = {}
+    for name in ("world_model", "actor_task", "critic_task", "target_critic_task"):
+        info[name] = log_model(runtime, cfg, name, params[name])
+    info["moments_task"] = log_model(runtime, cfg, "moments_task", state.get("moments_task"))
+    if exploration:
+        info["ensembles"] = log_model(runtime, cfg, "ensembles", params["ensembles"])
+        info["actor_exploration"] = log_model(runtime, cfg, "actor_exploration", params["actor_exploration"])
+        for k, cp in params["critics_exploration"].items():
+            info[f"critic_exploration_{k}"] = log_model(runtime, cfg, f"critic_exploration_{k}", cp["module"])
+            info[f"target_critic_exploration_{k}"] = log_model(
+                runtime, cfg, f"target_critic_exploration_{k}", cp["target_module"]
+            )
+            info[f"moments_exploration_{k}"] = log_model(
+                runtime, cfg, f"moments_exploration_{k}", state.get(f"moments_exploration_{k}")
+            )
+    return info

@@ -444,3 +444,51 @@ def test_p2e_dv2(standard_args, env_id, tmp_path, monkeypatch):
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV2_TINY
     _run(args)
+
+
+_P2E_DV3_TINY = _P2E_DV2_TINY + [
+    # DV3-style mains add one row per iteration (no initial reset add), so a dry
+    # run only has 1 sample (reference tests/test_algos/test_algos.py:497)
+    "algo.per_rank_sequence_length=1",
+    "algo.world_model.reward_model.bins=5",
+    "algo.critic.bins=5",
+]
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_p2e_dv3(standard_args, env_id, tmp_path, monkeypatch):
+    """Exploration phase then finetuning from its checkpoint (reference
+    tests/test_algos/test_algos.py p2e flow)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=p2e_dv3_exploration",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "checkpoint.save_last=True",
+    ] + _P2E_DV3_TINY
+    _run(args)
+
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+    # The exploration run must not have produced NaNs anywhere (guards the
+    # degenerate T=1 ensemble slice and any future NaN poisoning).
+    import jax
+    import numpy as np
+
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    expl_state = load_state(ckpts[0])
+    for name in ("world_model", "ensembles", "actor_exploration", "critics_exploration", "actor_task"):
+        for leaf in jax.tree_util.tree_leaves(expl_state[name]):
+            assert np.isfinite(np.asarray(leaf)).all(), f"non-finite values in checkpointed '{name}'"
+
+    args = standard_args + [
+        "exp=p2e_dv3_finetuning",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        f"checkpoint.exploration_ckpt_path={ckpts[0]}",
+    ] + _P2E_DV3_TINY
+    _run(args)
