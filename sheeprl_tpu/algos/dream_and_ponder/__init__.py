@@ -1,0 +1,2 @@
+from sheeprl_tpu.algos.dream_and_ponder import dream_and_ponder  # noqa: F401
+from sheeprl_tpu.algos.dream_and_ponder import evaluate  # noqa: F401  (must import after the algorithm registers)

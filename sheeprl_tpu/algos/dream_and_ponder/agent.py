@@ -1,0 +1,147 @@
+"""Dream-and-Ponder agent: the full DreamerV3 world model + critic with the
+actor replaced by a PonderNet actor (reference
+sheeprl/algos/dream_and_ponder/agent.py:1104-1422).
+
+The world model, critic, and player plumbing are DV3's; only the actor (and how
+the player queries it — inference-mode pondering needs a PRNG for the halting
+decisions) differs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import gymnasium
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dream_and_ponder.ponder_actor import PonderActor
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    ActorOutput,
+    DV3Modules,
+    PlayerDV3,
+    _ln_enabled,
+    build_agent as dv3_build_agent,
+)
+
+# Exposed for config-driven class selection (reference agent.py:747).
+Actor = PonderActor
+
+
+class PlayerDAP(PlayerDV3):
+    """DV3 player whose per-step actor call runs inference-mode pondering.
+
+    Reference PlayerDV3.get_actions (agent.py:710-744) sets
+    ``actor.training = False`` so the ponder actor early-halts; here the halting
+    decisions are explicit Bernoulli draws keyed off the step PRNG.
+    """
+
+    def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False):
+        recurrent_state, stochastic_state, actions = state
+        k_rep, k_halt, k_act = jax.random.split(key, 3)
+        embedded = self.encoder.apply(wm_params["encoder"], obs)
+        recurrent_state = self.rssm._recurrent(wm_params, stochastic_state, actions, recurrent_state)
+        if self.rssm.decoupled:
+            _, stoch = self.rssm._representation(wm_params, embedded, k_rep)
+        else:
+            _, stoch = self.rssm._representation(wm_params, embedded, k_rep, recurrent_state=recurrent_state)
+        stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
+        latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
+        pre_dist, _ = self.actor.apply(actor_params, latent, k_halt, method=PonderActor.ponder_infer)
+        out = ActorOutput(self.actor, pre_dist)
+        actions_list = out.sample_actions(k_act, greedy=greedy)
+        actions = jnp.concatenate(actions_list, axis=-1)
+        return tuple(actions_list), (recurrent_state, stochastic_state, actions)
+
+
+def build_agent(
+    runtime,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Dict[str, Any],
+    obs_space: gymnasium.spaces.Dict,
+    world_model_state: Optional[Dict[str, Any]] = None,
+    actor_state: Optional[Dict[str, Any]] = None,
+    critic_state: Optional[Dict[str, Any]] = None,
+    target_critic_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[DV3Modules, Dict[str, Any], PlayerDAP]:
+    """Build module defs + init params (reference agent.py:1104-1422).
+
+    Returns (modules, params, player); ``params`` keys match DreamerV3's
+    (world_model/actor/critic/target_critic) so checkpoints and the model
+    manager share the DV3 layout.
+    """
+    world_model_cfg = cfg.algo.world_model
+    actor_cfg = cfg.algo.actor
+    ponder_cfg = cfg.algo.ponder
+    stochastic_size = int(world_model_cfg.stochastic_size) * int(world_model_cfg.discrete_size)
+    recurrent_state_size = int(world_model_cfg.recurrent_model.recurrent_state_size)
+    latent_state_size = stochastic_size + recurrent_state_size
+
+    dv3_modules, dv3_params, _ = dv3_build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        obs_space,
+        world_model_state,
+        None,
+        critic_state,
+        target_critic_state,
+        build_actor=False,
+    )
+
+    actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
+    actor = PonderActor(
+        latent_state_size=latent_state_size,
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        distribution=cfg.distribution.get("type", "auto"),
+        init_std=float(actor_cfg.init_std),
+        min_std=float(actor_cfg.min_std),
+        max_std=float(actor_cfg.get("max_std", 1.0)),
+        dense_units=int(actor_cfg.dense_units),
+        mlp_layers=int(actor_cfg.mlp_layers),
+        layer_norm=actor_ln,
+        layer_norm_eps=actor_eps,
+        activation=actor_cfg.dense_act,
+        unimix=float(cfg.algo.unimix),
+        action_clip=float(actor_cfg.get("action_clip", 1.0)),
+        max_ponder_steps=int(ponder_cfg.max_ponder_steps),
+        cum_halt_prob_threshold=float(ponder_cfg.cum_halt_prob_threshold),
+        deterministic_inference=bool(ponder_cfg.get("deterministic_inference", False)),
+        dtype=runtime.compute_dtype,
+    )
+    actor_params = actor.init(jax.random.PRNGKey(cfg.seed + 2), jnp.zeros((1, latent_state_size)))
+    if actor_state:
+        actor_params = jax.tree_util.tree_map(jnp.asarray, actor_state)
+
+    modules = DV3Modules(
+        encoder=dv3_modules.encoder,
+        rssm=dv3_modules.rssm,
+        observation_model=dv3_modules.observation_model,
+        reward_model=dv3_modules.reward_model,
+        continue_model=dv3_modules.continue_model,
+        actor=actor,
+        critic=dv3_modules.critic,
+    )
+    params = {
+        "world_model": dv3_params["world_model"],
+        "actor": actor_params,
+        "critic": dv3_params["critic"],
+        "target_critic": dv3_params["target_critic"],
+    }
+
+    player = PlayerDAP(
+        encoder=dv3_modules.encoder,
+        rssm=dv3_modules.rssm,
+        actor=actor,
+        actions_dim=actions_dim,
+        num_envs=cfg.env.num_envs,
+        stochastic_size=int(world_model_cfg.stochastic_size),
+        recurrent_state_size=recurrent_state_size,
+        discrete_size=int(world_model_cfg.discrete_size),
+    )
+    player.wm_params = params["world_model"]
+    player.actor_params = actor_params
+    return modules, params, player

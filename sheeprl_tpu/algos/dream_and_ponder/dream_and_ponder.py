@@ -1,25 +1,46 @@
-"""Plan2Explore (DV3) — exploration phase (reference
-sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:41-1059).
+"""Dream-and-Ponder, coupled training (reference
+sheeprl/algos/dream_and_ponder/dream_and_ponder.py:48-926): DreamerV3 with a
+PonderNet adaptive-computation actor.
 
-One jitted train call per iteration `lax.scan`s over the G gradient steps; each step
-fuses (1) the DV3 world-model update — with the reward/continue heads trained on
-DETACHED latents so task-reward gradients cannot shape the exploration-phase world
-model (reference :154-161) — (2) the ensemble update (next-stochastic-state MSE
-log-likelihood, reference :205-227), (3) the exploration actor with a *weighted set*
-of two-hot exploration critics (intrinsic = ensemble-disagreement reward, task =
-learned reward model; advantages normalized per-critic by its own Moments state and
-weight-averaged, reference :259-305), (4) one two-hot critic update per exploration
-critic with its EMA target regularizer (:344-369), and (5) the zero-shot task
-actor/critic exactly as in DreamerV3 (:375-487). All EMA target updates run in-graph
-via `lax.cond` on the step counter (replacing the reference's host-side copies,
-:917-930).
+Train-step structure follows our DreamerV3 port (one jitted call `lax.scan`s
+over the G gradient steps; world model / actor / critic fused per step). The
+behaviour learning differs:
+
+- The initial imagined action is sampled in ponder-TRAIN mode, yielding one
+  action per ponder step plus the differentiable halting distribution
+  (reference :247-258).
+- One imagined trajectory is rolled out per ponder step; later steps use the
+  inference-mode (halting) actor (reference :260-283). TPU-first divergence:
+  instead of the reference's sequential Python loop over ponder branches, the
+  branch dim is FOLDED INTO THE BATCH — all N branches run in ONE H-step
+  `lax.scan` as one big MXU batch. This also fixes a reference artifact where
+  branch i>0 resumes imagination from branch i-1's terminal state rather than
+  the posterior (reference :261-283 never resets `imagined_prior` between
+  branches, though `imagined_trajectories[0]` is preset to the posterior).
+- Actor loss = PonderNet loss over per-branch policy losses weighted by the
+  halting distribution + β·KL to a truncated geometric prior (reference
+  :357-367, ponder_actor.py:243-319); β comes from ``cfg.algo.ponder.beta``
+  (the reference constructs PonderActorLoss without forwarding its configured
+  beta and always uses the 0.01 default — we default to 0.01 too but honor an
+  explicit config value).
+- Critic loss = per-branch two-hot losses weighted by the DETACHED halting
+  distribution (reference :380-417).
+- Moments are updated once on the λ-values of all branches jointly rather than
+  N times sequentially (reference :331 calls moments() once per branch per
+  gradient step); one batched quantile over [H, N·T·B] is the SPMD-friendly
+  equivalent and applies the EMA decay once per step.
+- The per-timestep log-prob at t=0 uses the branch's own TRAIN-mode
+  distribution (the one its initial action was sampled from); the reference
+  recomputes all policies with a fresh inference-mode pass whose random halting
+  may disagree with the sampling pass (reference :329).
 """
 
 from __future__ import annotations
 
 import os
 import warnings
-from typing import Any, Dict, NamedTuple
+from functools import partial
+from typing import Any, Dict, NamedTuple, Sequence
 
 import gymnasium as gym
 import jax
@@ -28,7 +49,9 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput
+from sheeprl_tpu.algos.dream_and_ponder.agent import build_agent
+from sheeprl_tpu.algos.dream_and_ponder.ponder_actor import PonderActor, geometric_prior, ponder_loss
+from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput, DV3Modules
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
     MomentsState,
@@ -38,7 +61,6 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
     update_moments,
 )
-from sheeprl_tpu.algos.p2e_dv3.agent import P2EDV3Modules, build_agent
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
 from sheeprl_tpu.envs.wrappers import RestartOnException
@@ -58,30 +80,17 @@ from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
 
-from functools import partial
 
-
-class P2EDV3OptStates(NamedTuple):
+class DAPOptStates(NamedTuple):
     world: Any
-    ensembles: Any
-    actor_task: Any
-    critic_task: Any
-    actor_exploration: Any
-    critics_exploration: Dict[str, Any]
+    actor: Any
+    critic: Any
 
 
-def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, actions_dim):
-    """Build (init_opt, train): jitted G-step scan over the five P2E-DV3 updates.
-
-    The moments argument/return is a dict ``{"task": MomentsState, <critic_key>:
-    MomentsState, ...}`` — the per-critic percentile normalizers of the reference's
-    ``moments_exploration``/``moments_task`` (p2e_dv3_exploration.py:660-675).
-    """
+def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, actions_dim: Sequence[int]):
+    """Build (init_opt, train) where train is a single jitted scan over G gradient steps."""
     rssm = modules.rssm
-    ensembles = modules.ensembles
-    critics_spec = modules.critics_exploration  # {key: {weight, reward_type}} — static
-    critic_keys = list(critics_spec.keys())
-    weights_sum = sum(c["weight"] for c in critics_spec.values())
+    actor: PonderActor = modules.actor
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)
     lmbda = float(cfg.algo.lmbda)
@@ -91,7 +100,6 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
     kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
     kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
     continue_scale_factor = float(cfg.algo.world_model.continue_scale_factor)
-    intrinsic_reward_multiplier = float(cfg.algo.intrinsic_reward_multiplier)
     stoch_size = rssm.stoch_state_size
     cnn_keys = list(cfg.algo.cnn_keys.encoder)
     mlp_keys = list(cfg.algo.mlp_keys.encoder)
@@ -100,114 +108,39 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     tau = float(cfg.algo.critic.tau)
     moments_cfg = cfg.algo.actor.moments
+    n_ponder = int(cfg.algo.ponder.max_ponder_steps)
+    ponder_beta = float(cfg.algo.ponder.get("beta", 0.01))
+    ponder_prior = jnp.asarray(geometric_prior(n_ponder, float(cfg.algo.ponder.lambda_prior_geom)))
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
 
     world_tx = with_clipping(
         instantiate(dict(cfg.algo.world_model.optimizer))(), cfg.algo.world_model.clip_gradients
     )
-    ens_tx = with_clipping(instantiate(dict(cfg.algo.ensembles.optimizer))(), cfg.algo.ensembles.clip_gradients)
     actor_tx = with_clipping(instantiate(dict(cfg.algo.actor.optimizer))(), cfg.algo.actor.clip_gradients)
     critic_tx = with_clipping(instantiate(dict(cfg.algo.critic.optimizer))(), cfg.algo.critic.clip_gradients)
 
-    def init_opt(params) -> P2EDV3OptStates:
-        return P2EDV3OptStates(
+    def init_opt(params) -> DAPOptStates:
+        return DAPOptStates(
             world=world_tx.init(params["world_model"]),
-            ensembles=ens_tx.init(params["ensembles"]),
-            actor_task=actor_tx.init(params["actor_task"]),
-            critic_task=critic_tx.init(params["critic_task"]),
-            actor_exploration=actor_tx.init(params["actor_exploration"]),
-            critics_exploration={
-                k: critic_tx.init(params["critics_exploration"][k]["module"]) for k in critic_keys
-            },
+            actor=actor_tx.init(params["actor"]),
+            critic=critic_tx.init(params["critic"]),
         )
-
-    def init_moments_dict() -> Dict[str, MomentsState]:
-        return {"task": init_moments(), **{k: init_moments() for k in critic_keys}}
-
-    def ema(new_p, old_p, tau_eff):
-        return jax.tree_util.tree_map(lambda p, tp: tau_eff * p + (1.0 - tau_eff) * tp, new_p, old_p)
-
-    def norm_moments(key_name, moments, lambda_values):
-        return update_moments(
-            moments[key_name],
-            lambda_values,
-            decay=float(moments_cfg.decay),
-            max_=float(moments_cfg.max),
-            percentile_low=float(moments_cfg.percentile.low),
-            percentile_high=float(moments_cfg.percentile.high),
-        )
-
-    def imagine(actor_mod, actor_params, wm_params, start_prior, start_recurrent, key0, keys):
-        """H+1-step differentiable imagination (reference :259-283): actions come
-        from the actor on the (detached) latent, then one RSSM imagination step."""
-        latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
-        out0 = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent0)))
-        actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)
-
-        def step(carry, k):
-            prior_flat, rec_state, act = carry
-            k_img_step, k_act_step = jax.random.split(k)
-            prior, rec_state = rssm.imagination_step(wm_params, prior_flat, rec_state, act, k_img_step)
-            prior_flat = prior.reshape(prior_flat.shape)
-            latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
-            out = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent)))
-            new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
-            return (prior_flat, rec_state, new_act), (latent, new_act)
-
-        _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
-        trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
-        im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
-        return trajectories, im_actions
-
-    def actor_objective(actor_mod, actor_params, trajectories, im_actions, advantage):
-        policies = ActorOutput(
-            actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(trajectories))
-        )
-        if is_continuous:
-            objective = advantage
-        else:
-            splits = np.cumsum(np.asarray(actions_dim))[:-1]
-            action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
-            log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))  # [H+1, TB]
-            objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
-        try:
-            entropy = ent_coef * policies.entropy()
-        except NotImplementedError:
-            entropy = jnp.zeros(trajectories.shape[:-1], dtype=jnp.float32)
-        return objective, entropy
-
-    def twohot_critic_loss(critic_mod, critic_params, target_params, trajectories, lambda_values, discount):
-        """Two-hot critic regression onto λ-targets + EMA-target regularizer
-        (reference :344-369 per exploration critic, :460-476 task)."""
-        qv = TwoHotEncodingDistribution(critic_mod.apply(critic_params, trajectories[:-1]), dims=1)
-        predicted_target_values = TwoHotEncodingDistribution(
-            critic_mod.apply(target_params, trajectories[:-1]), dims=1
-        ).mean
-        value_loss = -qv.log_prob(lambda_values) - qv.log_prob(jax.lax.stop_gradient(predicted_target_values))
-        return jnp.mean(value_loss * discount[:-1][..., 0])
 
     def one_step(carry, inp):
-        params, opt_states, moments, counter = carry
+        params, opt_states, moments_state, counter = carry
         data, key = inp
         data = jax.tree_util.tree_map(lambda v: jax.lax.with_sharding_constraint(v, data_sharding), data)
-        k_wm, k_expl0, k_expl, k_task0, k_task = jax.random.split(key, 5)
+        k_wm, k_img0, k_img, k_actor = jax.random.split(key, 4)
 
-        # ---- EMA target critics (reference :917-930): tau=1 on the first step
-        def do_ema(targets):
+        # ---- target critic EMA (reference dream_and_ponder.py:823-838): tau=1 first step
+        def do_ema(tc):
             tau_eff = jnp.where(counter == 0, 1.0, tau)
-            new_task = ema(params["critic_task"], targets[0], tau_eff)
-            new_expl = {
-                k: ema(params["critics_exploration"][k]["module"], targets[1][k], tau_eff)
-                for k in critic_keys
-            }
-            return (new_task, new_expl)
+            return jax.tree_util.tree_map(
+                lambda p, tp: tau_eff * p + (1.0 - tau_eff) * tp, params["critic"], tc
+            )
 
-        old_targets = (
-            params["target_critic_task"],
-            {k: params["critics_exploration"][k]["target_module"] for k in critic_keys},
-        )
-        target_critic_task, target_critics_expl = jax.lax.cond(
-            counter % target_freq == 0, do_ema, lambda t: t, old_targets
+        target_critic = jax.lax.cond(
+            counter % target_freq == 0, do_ema, lambda tc: tc, params["target_critic"]
         )
 
         batch_obs = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
@@ -218,8 +151,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
         rewards = data["rewards"].astype(jnp.float32)
         continues_targets = 1.0 - data["terminated"].astype(jnp.float32)
 
-        # ---- (1) world-model update; reward/continue heads on DETACHED latents
-        # (reference :154-161)
+        # ---- world-model update (identical to DreamerV3; reference :110-215)
         def world_loss_fn(wm_params):
             embedded = modules.encoder.apply(wm_params["encoder"], batch_obs)
             recurrent_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
@@ -239,14 +171,11 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
                     for k in mlp_keys_dec
                 }
             )
-            detached_latents = jax.lax.stop_gradient(latent_states)
             pr = TwoHotEncodingDistribution(
-                modules.reward_model.apply(wm_params["reward_model"], detached_latents), dims=1
+                modules.reward_model.apply(wm_params["reward_model"], latent_states), dims=1
             )
             pc = Independent(
-                BernoulliSafeMode(
-                    logits=modules.continue_model.apply(wm_params["continue_model"], detached_latents)
-                ),
+                BernoulliSafeMode(logits=modules.continue_model.apply(wm_params["continue_model"], latent_states)),
                 1,
             )
             loss, kl, state_loss, reward_loss, observation_loss, continue_loss = reconstruction_loss(
@@ -279,138 +208,60 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
         world_updates, world_opt = world_tx.update(world_grads, opt_states.world, params["world_model"])
         new_wm = optax.apply_updates(params["world_model"], world_updates)
 
+        # ---- behaviour learning: ponder-branched imagination, branches batched
         posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S, D]
         recurrent_states = jax.lax.stop_gradient(aux["recurrent_states"])  # [T, B, R]
-        posteriors_flat = posteriors.reshape(*posteriors.shape[:-2], -1)
-
-        # ---- (2) ensemble update: predict posterior[t+1] from (post, h, action)[t]
-        # with an MSE head (reference :205-227); raw (unshifted) actions as input.
-        ens_input = jnp.concatenate([posteriors_flat, recurrent_states, actions], axis=-1)
-
-        def ensemble_loss_fn(ens_params):
-            out = ensembles.apply(ens_params, ens_input)  # [N, T, B, S*D]
-            if out.shape[1] < 2:
-                # T == 1: there is no next-state target, and a mean over the empty
-                # [:, :-1] slice would be NaN and poison every downstream param.
-                return 0.0 * jnp.sum(out)
-            out = out[:, :-1]  # [N, T-1, B, S*D]
-            target = jnp.broadcast_to(posteriors_flat[None, 1:], out.shape)
-            log_prob = MSEDistribution(out, dims=1).log_prob(target)
-            return -(log_prob.mean(axis=(1, 2)).sum())
-
-        ens_loss, ens_grads = jax.value_and_grad(ensemble_loss_fn)(params["ensembles"])
-        ens_grad_norm = optax.global_norm(ens_grads)
-        ens_updates, ens_opt = ens_tx.update(ens_grads, opt_states.ensembles, params["ensembles"])
-        new_ens = optax.apply_updates(params["ensembles"], ens_updates)
-
-        start_prior = posteriors_flat.reshape(1, -1, stoch_size)[0]  # [T*B, S*D]
+        start_prior = posteriors.reshape(1, -1, stoch_size)[0]  # [TB, S*D]
         start_recurrent = recurrent_states.reshape(1, -1, recurrent_states.shape[-1])[0]
-        true_continue = continues_targets.reshape(-1, 1)  # [T*B, 1]
-        expl_keys = jax.random.split(k_expl, horizon)
-        task_keys = jax.random.split(k_task, horizon)
+        tb = start_prior.shape[0]
+        true_continue = continues_targets.reshape(-1, 1)  # [TB, 1]
+        true_continue_b = jnp.tile(true_continue, (n_ponder, 1))  # [N*TB, 1]
+        img_keys = jax.random.split(k_img, horizon)
 
-        # ---- (3) exploration actor on the weighted multi-critic advantage
-        # (reference :259-333)
-        def actor_expl_loss_fn(actor_params):
-            trajectories, im_actions = imagine(
-                modules.actor_exploration, actor_params, new_wm, start_prior, start_recurrent, k_expl0, expl_keys
-            )
-            continues = Independent(
-                BernoulliSafeMode(logits=modules.continue_model.apply(new_wm["continue_model"], trajectories)), 1
-            ).base.mode
-            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
-            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
+        def branch_major(x):
+            """[TB, N, ...] -> [N*TB, ...] (branch-major so reshape(N, TB) splits branches)."""
+            return jnp.moveaxis(x, -2, 0).reshape(n_ponder * tb, *x.shape[-1:])
 
-            # Intrinsic (disagreement) reward is shared by every intrinsic critic
-            ens_in = jax.lax.stop_gradient(jnp.concatenate([trajectories, im_actions], axis=-1))
-            ens_preds = ensembles.apply(new_ens, ens_in)  # [N, H+1, TB, S*D]
-            intrinsic_reward = (
-                ens_preds.var(axis=0).mean(axis=-1, keepdims=True) * intrinsic_reward_multiplier
-            )
-            extrinsic_reward = TwoHotEncodingDistribution(
-                modules.reward_model.apply(new_wm["reward_model"], trajectories), dims=1
-            ).mean
+        def imagine(actor_params, key0, keys):
+            latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)  # [TB, L]
+            pre0, _, halt_dist = modules.actor.apply(
+                actor_params, jax.lax.stop_gradient(latent0), method=PonderActor.ponder_train
+            )  # pre0: each [TB, N, dim]; halt_dist [TB, N]
+            out0 = ActorOutput(actor, pre0)
+            actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)  # [TB, N, A]
+            a0 = branch_major(actions0)  # [N*TB, A]
+            pre0_b = [branch_major(p) for p in pre0]  # each [N*TB, dim]
+            prior_b = jnp.tile(start_prior, (n_ponder, 1))
+            rec_b = jnp.tile(start_recurrent, (n_ponder, 1))
+            latent0_b = jnp.concatenate([prior_b, rec_b], axis=-1)
 
-            advantage = 0.0
-            new_moments = {}
-            per_critic = {}
-            for k in critic_keys:
-                spec = critics_spec[k]
-                predicted_values = TwoHotEncodingDistribution(
-                    modules.critic_exploration.apply(params["critics_exploration"][k]["module"], trajectories),
-                    dims=1,
-                ).mean
-                reward = intrinsic_reward if spec["reward_type"] == "intrinsic" else extrinsic_reward
-                lambda_values = compute_lambda_values(
-                    reward[1:], predicted_values[1:], continues[1:] * gamma, lmbda=lmbda
+            def step(carry, k):
+                prior, rec, act = carry
+                k_img_step, k_halt, k_act = jax.random.split(k, 3)
+                prior, rec = rssm.imagination_step(new_wm, prior, rec, act, k_img_step)
+                prior = prior.reshape(prior_b.shape)
+                latent = jnp.concatenate([prior, rec], axis=-1)
+                pre, _ = modules.actor.apply(
+                    actor_params, jax.lax.stop_gradient(latent), k_halt, method=PonderActor.ponder_infer
                 )
-                offset, invscale, new_moments[k] = norm_moments(k, moments, lambda_values)
-                normed_lambda = (lambda_values - offset) / invscale
-                normed_baseline = (predicted_values[:-1] - offset) / invscale
-                advantage = advantage + (normed_lambda - normed_baseline) * (spec["weight"] / weights_sum)
-                per_critic[k] = {
-                    "lambda_values": lambda_values,
-                    "predicted_values": predicted_values,
-                }
+                out = ActorOutput(actor, pre)
+                act = jnp.concatenate(out.sample_actions(k_act), axis=-1)
+                return (prior, rec, act), (latent, act, tuple(pre))
 
-            objective, entropy = actor_objective(
-                modules.actor_exploration, actor_params, trajectories, im_actions, advantage
-            )
-            p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
-            aux_e = {
-                "trajectories": trajectories,
-                "discount": discount,
-                "moments": new_moments,
-                "per_critic": per_critic,
-                "intrinsic_reward": intrinsic_reward,
-            }
-            return p_loss, aux_e
+            _, (latents, acts, pre_seq) = jax.lax.scan(step, (prior_b, rec_b, a0), keys)
+            trajectories = jnp.concatenate([latent0_b[None], latents], axis=0)  # [H+1, N*TB, L]
+            im_actions = jnp.concatenate([a0[None], acts], axis=0)  # [H+1, N*TB, A]
+            # Per-timestep pre-distributions: the branch's own train-mode dist at
+            # t=0, the halting-mode dists afterwards.
+            full_pre = [
+                jnp.concatenate([p0[None], ps], axis=0) for p0, ps in zip(pre0_b, pre_seq)
+            ]  # each [H+1, N*TB, dim]
+            return trajectories, im_actions, full_pre, halt_dist
 
-        (policy_loss_expl, aux_e), actor_expl_grads = jax.value_and_grad(actor_expl_loss_fn, has_aux=True)(
-            params["actor_exploration"]
-        )
-        actor_expl_gn = optax.global_norm(actor_expl_grads)
-        actor_expl_updates, actor_expl_opt = actor_tx.update(
-            actor_expl_grads, opt_states.actor_exploration, params["actor_exploration"]
-        )
-        new_actor_expl = optax.apply_updates(params["actor_exploration"], actor_expl_updates)
-
-        # ---- (4) per-key exploration critic updates on the detached trajectories
-        expl_traj = jax.lax.stop_gradient(aux_e["trajectories"])
-        expl_discount = aux_e["discount"]
-        new_critics_expl: Dict[str, Dict[str, Any]] = {}
-        new_critics_opt: Dict[str, Any] = {}
-        value_losses_expl = {}
-        critic_expl_gns = {}
-        for k in critic_keys:
-            lam_k = jax.lax.stop_gradient(aux_e["per_critic"][k]["lambda_values"])
-            loss_fn = partial(
-                twohot_critic_loss,
-                modules.critic_exploration,
-                target_params=target_critics_expl[k],
-                trajectories=expl_traj,
-                lambda_values=lam_k,
-                discount=expl_discount,
-            )
-            v_loss, c_grads = jax.value_and_grad(lambda p: loss_fn(p))(params["critics_exploration"][k]["module"])
-            critic_expl_gns[k] = optax.global_norm(c_grads)
-            c_updates, c_opt = critic_tx.update(
-                c_grads, opt_states.critics_exploration[k], params["critics_exploration"][k]["module"]
-            )
-            new_critics_expl[k] = {
-                "module": optax.apply_updates(params["critics_exploration"][k]["module"], c_updates),
-                "target_module": target_critics_expl[k],
-            }
-            new_critics_opt[k] = c_opt
-            value_losses_expl[k] = v_loss
-
-        # ---- (5) zero-shot task behaviour, exactly DreamerV3 (reference :375-487)
-        def actor_task_loss_fn(actor_params):
-            trajectories, im_actions = imagine(
-                modules.actor_task, actor_params, new_wm, start_prior, start_recurrent, k_task0, task_keys
-            )
+        def actor_loss_fn(actor_params):
+            trajectories, im_actions, full_pre, halt_dist = imagine(actor_params, k_img0, img_keys)
             predicted_values = TwoHotEncodingDistribution(
-                modules.critic_task.apply(params["critic_task"], trajectories), dims=1
+                modules.critic.apply(params["critic"], trajectories), dims=1
             ).mean
             predicted_rewards = TwoHotEncodingDistribution(
                 modules.reward_model.apply(new_wm["reward_model"], trajectories), dims=1
@@ -418,132 +269,134 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
             continues = Independent(
                 BernoulliSafeMode(logits=modules.continue_model.apply(new_wm["continue_model"], trajectories)), 1
             ).base.mode
-            continues = jnp.concatenate([true_continue[None], continues[1:]], axis=0)
+            continues = jnp.concatenate([true_continue_b[None], continues[1:]], axis=0)
             lambda_values = compute_lambda_values(
                 predicted_rewards[1:], predicted_values[1:], continues[1:] * gamma, lmbda=lmbda
-            )
+            )  # [H, N*TB, 1]
             discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, axis=0) / gamma)
-            offset, invscale, new_task_moments = norm_moments("task", moments, lambda_values)
-            advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
-            objective, entropy = actor_objective(
-                modules.actor_task, actor_params, trajectories, im_actions, advantage
+
+            offset, invscale, new_moments = update_moments(
+                moments_state,
+                lambda_values,
+                decay=float(moments_cfg.decay),
+                max_=float(moments_cfg.max),
+                percentile_low=float(moments_cfg.percentile.low),
+                percentile_high=float(moments_cfg.percentile.high),
             )
-            p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
-            aux_t = {
+            advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
+
+            policies = ActorOutput(actor, full_pre)
+            if is_continuous:
+                objective = advantage
+            else:
+                splits = np.cumsum(np.asarray(actions_dim))[:-1]
+                action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+                log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))  # [H+1, N*TB]
+                objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
+            try:
+                entropy = ent_coef * policies.entropy()
+            except NotImplementedError:
+                entropy = jnp.zeros(trajectories.shape[:-1], dtype=jnp.float32)
+            # Per-sample per-branch policy loss, combined by the PonderNet loss
+            # (reference :358-367)
+            per_timestep = -(
+                jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1])
+            )  # [H, N*TB, 1]
+            per_sample = per_timestep.mean(axis=0)[..., 0].reshape(n_ponder, tb).T  # [TB, N]
+            policy_loss = ponder_loss(per_sample, halt_dist, ponder_prior, beta=ponder_beta)
+            aux_a = {
                 "trajectories": trajectories,
                 "lambda_values": lambda_values,
                 "discount": discount,
-                "moments": new_task_moments,
+                "halt_dist": halt_dist,
+                "moments": new_moments,
             }
-            return p_loss, aux_t
+            return policy_loss, aux_a
 
-        (policy_loss_task, aux_t), actor_task_grads = jax.value_and_grad(actor_task_loss_fn, has_aux=True)(
-            params["actor_task"]
-        )
-        actor_task_gn = optax.global_norm(actor_task_grads)
-        actor_task_updates, actor_task_opt = actor_tx.update(
-            actor_task_grads, opt_states.actor_task, params["actor_task"]
-        )
-        new_actor_task = optax.apply_updates(params["actor_task"], actor_task_updates)
+        (policy_loss, aux_a), actor_grads = jax.value_and_grad(actor_loss_fn, has_aux=True)(params["actor"])
+        actor_grad_norm = optax.global_norm(actor_grads)
+        actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states.actor, params["actor"])
+        new_actor = optax.apply_updates(params["actor"], actor_updates)
 
-        task_traj = jax.lax.stop_gradient(aux_t["trajectories"])
-        task_lambda = jax.lax.stop_gradient(aux_t["lambda_values"])
-        value_loss_task, critic_task_grads = jax.value_and_grad(
-            lambda p: twohot_critic_loss(
-                modules.critic_task, p, target_critic_task, task_traj, task_lambda, aux_t["discount"]
-            )
-        )(params["critic_task"])
-        critic_task_gn = optax.global_norm(critic_task_grads)
-        critic_task_updates, critic_task_opt = critic_tx.update(
-            critic_task_grads, opt_states.critic_task, params["critic_task"]
-        )
-        new_critic_task = optax.apply_updates(params["critic_task"], critic_task_updates)
+        # ---- critic update: per-branch two-hot losses weighted by the detached
+        # halting distribution (reference :380-417)
+        trajectories = jax.lax.stop_gradient(aux_a["trajectories"])
+        lambda_values = jax.lax.stop_gradient(aux_a["lambda_values"])
+        discount = aux_a["discount"]
+        halt_dist = jax.lax.stop_gradient(aux_a["halt_dist"])  # [TB, N]
+
+        def critic_loss_fn(critic_params):
+            qv = TwoHotEncodingDistribution(modules.critic.apply(critic_params, trajectories[:-1]), dims=1)
+            predicted_target_values = TwoHotEncodingDistribution(
+                modules.critic.apply(target_critic, trajectories[:-1]), dims=1
+            ).mean
+            per_timestep = -qv.log_prob(lambda_values) - qv.log_prob(
+                jax.lax.stop_gradient(predicted_target_values)
+            )  # [H, N*TB]
+            per_timestep = per_timestep * discount[:-1][..., 0]
+            per_sample = per_timestep.mean(axis=0).reshape(n_ponder, tb).T  # [TB, N]
+            return (per_sample * halt_dist).sum(axis=-1).mean()
+
+        value_loss, critic_grads = jax.value_and_grad(critic_loss_fn)(params["critic"])
+        critic_grad_norm = optax.global_norm(critic_grads)
+        critic_updates, critic_opt = critic_tx.update(critic_grads, opt_states.critic, params["critic"])
+        new_critic = optax.apply_updates(params["critic"], critic_updates)
 
         post_ent = Independent(OneHotCategorical(logits=aux["posteriors_logits"]), 1).entropy().mean()
         prior_ent = Independent(OneHotCategorical(logits=aux["priors_logits"]), 1).entropy().mean()
-
         new_params = {
             "world_model": new_wm,
-            "ensembles": new_ens,
-            "actor_task": new_actor_task,
-            "critic_task": new_critic_task,
-            "target_critic_task": target_critic_task,
-            "actor_exploration": new_actor_expl,
-            "critics_exploration": new_critics_expl,
+            "actor": new_actor,
+            "critic": new_critic,
+            "target_critic": target_critic,
         }
-        new_opt = P2EDV3OptStates(
-            world=world_opt,
-            ensembles=ens_opt,
-            actor_task=actor_task_opt,
-            critic_task=critic_task_opt,
-            actor_exploration=actor_expl_opt,
-            critics_exploration=new_critics_opt,
+        metrics = jnp.stack(
+            [
+                world_loss,
+                value_loss,
+                policy_loss,
+                aux["observation_loss"],
+                aux["reward_loss"],
+                aux["state_loss"],
+                aux["continue_loss"],
+                aux["kl"],
+                post_ent,
+                prior_ent,
+                world_grad_norm,
+                actor_grad_norm,
+                critic_grad_norm,
+                # Expected ponder depth under the halting distribution
+                (halt_dist * jnp.arange(1, n_ponder + 1, dtype=jnp.float32)).sum(axis=-1).mean(),
+            ]
         )
-        new_moments = {"task": aux_t["moments"], **aux_e["moments"]}
-        metrics = {
-            "Loss/world_model_loss": world_loss,
-            "Loss/observation_loss": aux["observation_loss"],
-            "Loss/reward_loss": aux["reward_loss"],
-            "Loss/state_loss": aux["state_loss"],
-            "Loss/continue_loss": aux["continue_loss"],
-            "State/kl": aux["kl"],
-            "State/post_entropy": post_ent,
-            "State/prior_entropy": prior_ent,
-            "Loss/ensemble_loss": ens_loss,
-            "Loss/policy_loss_exploration": policy_loss_expl,
-            "Loss/policy_loss_task": policy_loss_task,
-            "Loss/value_loss_task": value_loss_task,
-            "Grads/world_model": world_grad_norm,
-            "Grads/ensemble": ens_grad_norm,
-            "Grads/actor_exploration": actor_expl_gn,
-            "Grads/actor_task": actor_task_gn,
-            "Grads/critic_task": critic_task_gn,
-        }
-        for k in critic_keys:
-            metrics[f"Loss/value_loss_exploration_{k}"] = value_losses_expl[k]
-            metrics[f"Values_exploration/predicted_values_{k}"] = aux_e["per_critic"][k][
-                "predicted_values"
-            ].mean()
-            metrics[f"Values_exploration/lambda_values_{k}"] = aux_e["per_critic"][k]["lambda_values"].mean()
-            metrics[f"Grads/critic_exploration_{k}"] = critic_expl_gns[k]
-            if critics_spec[k]["reward_type"] == "intrinsic":
-                metrics[f"Rewards/intrinsic_{k}"] = aux_e["intrinsic_reward"].mean()
-        return (new_params, new_opt, new_moments, counter + 1), metrics
+        return (new_params, DAPOptStates(world_opt, actor_opt, critic_opt), aux_a["moments"], counter + 1), metrics
 
-    def train(params, opt_states, moments, counter, batches, key):
+    def train(params, opt_states, moments_state, counter, batches, key):
         g = next(iter(batches.values())).shape[0]
         keys = jax.random.split(key, g)
-        (params, opt_states, moments, counter), metrics = jax.lax.scan(
-            one_step, (params, opt_states, moments, counter), (batches, keys)
+        (params, opt_states, moments_state, counter), metrics = jax.lax.scan(
+            one_step, (params, opt_states, moments_state, counter), (batches, keys)
         )
-        named = {k: v.mean(axis=0) for k, v in metrics.items()}
-        return params, opt_states, moments, counter, named
+        m = metrics.mean(axis=0)
+        named = {
+            "Loss/world_model_loss": m[0],
+            "Loss/value_loss": m[1],
+            "Loss/policy_loss": m[2],
+            "Loss/observation_loss": m[3],
+            "Loss/reward_loss": m[4],
+            "Loss/state_loss": m[5],
+            "Loss/continue_loss": m[6],
+            "State/kl": m[7],
+            "State/post_entropy": m[8],
+            "State/prior_entropy": m[9],
+            "Grads/world_model": m[10],
+            "Grads/actor": m[11],
+            "Grads/critic": m[12],
+            "State/expected_ponder_steps": m[13],
+        }
+        return params, opt_states, moments_state, counter, named
 
-    return init_opt, init_moments_dict, jax.jit(train, donate_argnums=(0, 1, 2))
-
-
-def expand_critic_metric_keys(cfg, critics_spec) -> None:
-    """Clone the generic exploration-critic metric specs into per-key specs
-    (reference p2e_dv3_exploration.py:679-708). ``Rewards/intrinsic`` is only
-    cloned for intrinsic-reward critics — the train step never emits it for
-    task-reward ones."""
-    if "aggregator" not in cfg.metric or "metrics" not in cfg.metric.aggregator:
-        return
-    metrics_cfg = cfg.metric.aggregator.metrics
-    generic = [
-        "Loss/value_loss_exploration",
-        "Values_exploration/predicted_values",
-        "Values_exploration/lambda_values",
-        "Grads/critic_exploration",
-    ]
-    for k, spec in critics_spec.items():
-        for g in generic:
-            if g in metrics_cfg:
-                metrics_cfg[f"{g}_{k}"] = metrics_cfg[g]
-        if spec["reward_type"] == "intrinsic" and "Rewards/intrinsic" in metrics_cfg:
-            metrics_cfg[f"Rewards/intrinsic_{k}"] = metrics_cfg["Rewards/intrinsic"]
-    for g in generic + ["Rewards/intrinsic"]:
-        metrics_cfg.pop(g, None)
+    return init_opt, jax.jit(train, donate_argnums=(0, 1, 2))
 
 
 @register_algorithm()
@@ -557,9 +410,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
         state = load_state(cfg.checkpoint.resume_from)
 
-    # These arguments cannot be changed (reference p2e_dv3_exploration.py:540-542)
-    cfg.env.frame_stack = 1
-    cfg.algo.player.actor_type = "exploration"
+    # These arguments cannot be changed (reference dream_and_ponder.py:465-468)
+    cfg.env.frame_stack = -1
     if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
         raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
 
@@ -624,26 +476,18 @@ def main(runtime, cfg: Dict[str, Any]):
         cfg,
         observation_space,
         state["world_model"] if state else None,
-        state["ensembles"] if state else None,
-        state["actor_task"] if state else None,
-        state["critic_task"] if state else None,
-        state["target_critic_task"] if state else None,
-        state["actor_exploration"] if state else None,
-        state["critics_exploration"] if state else None,
+        state["actor"] if state else None,
+        state["critic"] if state else None,
+        state["target_critic"] if state else None,
     )
-    critic_keys = list(modules.critics_exploration.keys())
-    expand_critic_metric_keys(cfg, modules.critics_exploration)
 
-    init_opt, init_moments_dict, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
-    moments = init_moments_dict()
-    if state and "moments_task" in state:
-        moments["task"] = MomentsState(*[jnp.asarray(v) for v in state["moments_task"]])
-        for k in critic_keys:
-            if f"moments_exploration_{k}" in state:
-                moments[k] = MomentsState(*[jnp.asarray(v) for v in state[f"moments_exploration_{k}"]])
+    moments_state = init_moments()
+    if state and "moments" in state:
+        moments_state = MomentsState(*[jnp.asarray(v) for v in state["moments"]])
     counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
     params = runtime.replicate(params)
     opt_states = runtime.replicate(opt_states)
@@ -814,12 +658,12 @@ def main(runtime, cfg: Dict[str, Any]):
                 with timer("Time/train_time", SumMetric()):
                     batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, moments, counter, train_metrics = train_fn(
-                        params, opt_states, moments, counter, batches, train_key
+                    params, opt_states, moments_state, counter, train_metrics = train_fn(
+                        params, opt_states, moments_state, counter, batches, train_key
                     )
-                    jax.block_until_ready(params["actor_exploration"])
+                    jax.block_until_ready(params["actor"])
                     player.wm_params = params["world_model"]
-                    player.actor_params = params["actor_exploration"]
+                    player.actor_params = params["actor"]
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
@@ -863,18 +707,11 @@ def main(runtime, cfg: Dict[str, Any]):
             last_checkpoint = policy_step
             ckpt_state = {
                 "world_model": jax.device_get(params["world_model"]),
-                "ensembles": jax.device_get(params["ensembles"]),
-                "actor_task": jax.device_get(params["actor_task"]),
-                "critic_task": jax.device_get(params["critic_task"]),
-                "target_critic_task": jax.device_get(params["target_critic_task"]),
-                "actor_exploration": jax.device_get(params["actor_exploration"]),
-                "critics_exploration": jax.device_get(params["critics_exploration"]),
+                "actor": jax.device_get(params["actor"]),
+                "critic": jax.device_get(params["critic"]),
+                "target_critic": jax.device_get(params["target_critic"]),
                 "opt_states": jax.device_get(opt_states),
-                "moments_task": tuple(np.asarray(v) for v in moments["task"]),
-                **{
-                    f"moments_exploration_{k}": tuple(np.asarray(v) for v in moments[k])
-                    for k in critic_keys
-                },
+                "moments": tuple(np.asarray(v) for v in moments_state),
                 "counter": int(counter),
                 "ratio": ratio.state_dict(),
                 "iter_num": iter_num * world_size,
@@ -891,11 +728,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     envs.close()
-    # Zero-shot evaluation runs with the TASK policy (reference :1032-1036).
     if runtime.is_global_zero and cfg.algo.run_test:
-        player.actor = modules.actor_task
-        player.actor_params = params["actor_task"]
-        player.actor_type = "task"
-        test(player, runtime, cfg, log_dir, "zero-shot", greedy=False)
+        test(player, runtime, cfg, log_dir, greedy=False)
     if logger:
         logger.finalize()

@@ -1,0 +1,50 @@
+"""Dream-and-Ponder evaluation entrypoint (reference
+sheeprl/algos/dream_and_ponder/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import gymnasium as gym
+
+from sheeprl_tpu.algos.dream_and_ponder.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="dream_and_ponder")
+def evaluate(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+
+    env = make_env(cfg, cfg.seed, 0, log_dir, "test", vector_env_idx=0)()
+    observation_space = env.observation_space
+    action_space = env.action_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        action_space.shape
+        if is_continuous
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
+    )
+    _, _, player = build_agent(
+        runtime,
+        actions_dim,
+        is_continuous,
+        cfg,
+        observation_space,
+        state["world_model"],
+        state["actor"],
+        state["critic"],
+        state["target_critic"],
+    )
+    test(player, runtime, cfg, log_dir)

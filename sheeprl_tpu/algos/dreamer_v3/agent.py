@@ -755,11 +755,14 @@ def build_agent(
     actor_state: Optional[Dict[str, Any]] = None,
     critic_state: Optional[Dict[str, Any]] = None,
     target_critic_state: Optional[Dict[str, Any]] = None,
+    build_actor: bool = True,
 ) -> Tuple[DV3Modules, Dict[str, Any], PlayerDV3]:
     """Build module defs + init params (reference agent.py:935-1260).
 
     Returns (modules, params, player) where params is a dict with keys
-    ``world_model``, ``actor``, ``critic``, ``target_critic``.
+    ``world_model``, ``actor``, ``critic``, ``target_critic``. With
+    ``build_actor=False`` the actor and player are skipped (``None`` in the
+    results) — for callers that supply their own actor (e.g. dream_and_ponder).
     """
     world_model_cfg = cfg.algo.world_model
     actor_cfg = cfg.algo.actor
@@ -922,7 +925,7 @@ def build_agent(
     )
 
     actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
-    actor = Actor(
+    actor = None if not build_actor else Actor(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,
@@ -972,12 +975,12 @@ def build_agent(
     wm_params["reward_model"] = reward_model.init(keys[5], jnp.zeros((1, latent_state_size)))
     wm_params["continue_model"] = continue_model.init(keys[6], jnp.zeros((1, latent_state_size)))
     wm_params["initial_recurrent_state"] = jnp.zeros((recurrent_state_size,), dtype=jnp.float32)
-    actor_params = actor.init(keys[7], jnp.zeros((1, latent_state_size)))
+    actor_params = actor.init(keys[7], jnp.zeros((1, latent_state_size))) if build_actor else None
     critic_params = critic.init(keys[8], jnp.zeros((1, latent_state_size)))
 
     if world_model_state:
         wm_params = jax.tree_util.tree_map(jnp.asarray, world_model_state)
-    if actor_state:
+    if actor_state and build_actor:
         actor_params = jax.tree_util.tree_map(jnp.asarray, actor_state)
     if critic_state:
         critic_params = jax.tree_util.tree_map(jnp.asarray, critic_state)
@@ -1003,16 +1006,18 @@ def build_agent(
         "target_critic": target_critic_params,
     }
 
-    player = PlayerDV3(
-        encoder=encoder,
-        rssm=rssm,
-        actor=actor,
-        actions_dim=actions_dim,
-        num_envs=cfg.env.num_envs,
-        stochastic_size=int(world_model_cfg.stochastic_size),
-        recurrent_state_size=recurrent_state_size,
-        discrete_size=int(world_model_cfg.discrete_size),
-    )
-    player.wm_params = wm_params
-    player.actor_params = actor_params
+    player = None
+    if build_actor:
+        player = PlayerDV3(
+            encoder=encoder,
+            rssm=rssm,
+            actor=actor,
+            actions_dim=actions_dim,
+            num_envs=cfg.env.num_envs,
+            stochastic_size=int(world_model_cfg.stochastic_size),
+            recurrent_state_size=recurrent_state_size,
+            discrete_size=int(world_model_cfg.discrete_size),
+        )
+        player.wm_params = wm_params
+        player.actor_params = actor_params
     return modules, params, player

@@ -65,6 +65,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
     # These arguments cannot be changed (reference :72-73)
     cfg.env.frame_stack = 1
+    if 2 ** int(np.log2(cfg.env.screen_size)) != cfg.env.screen_size:
+        raise ValueError(f"The screen size must be a power of 2, got: {cfg.env.screen_size}")
 
     logger = get_logger(runtime, cfg)
     if logger:

@@ -492,3 +492,36 @@ def test_p2e_dv3(standard_args, env_id, tmp_path, monkeypatch):
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV3_TINY
     _run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_dream_and_ponder(standard_args, env_id, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=dream_and_ponder",
+        "env=dummy",
+        f"env.id={env_id}",
+        "fabric.devices=1",
+        "algo.per_rank_batch_size=1",
+        "algo.per_rank_sequence_length=1",
+        "buffer.size=4",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.horizon=4",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.ponder.max_ponder_steps=2",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=8",
+        "algo.world_model.representation_model.hidden_size=8",
+        "algo.world_model.transition_model.hidden_size=8",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "algo.world_model.reward_model.bins=5",
+        "algo.critic.bins=5",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "env.num_envs=1",
+    ]
+    _run(args)
