@@ -1,0 +1,7 @@
+"""Thin shim: `python sheeprl_model_manager.py checkpoint_path=...`
+(reference: sheeprl_model_manager.py)."""
+
+from sheeprl_tpu.cli import registration
+
+if __name__ == "__main__":
+    registration()

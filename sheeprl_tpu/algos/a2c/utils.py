@@ -21,3 +21,6 @@ def normalize_obs(obs, cnn_keys: Sequence[str], obs_keys: Sequence[str]):
 def prepare_obs(runtime, obs: Dict[str, np.ndarray], *, num_envs: int = 1, **kwargs) -> Dict[str, jax.Array]:
     """A2C is vector-obs only (reference utils.py:16-21)."""
     return {k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(num_envs, -1)) for k, v in obs.items()}
+
+# Single-'agent' registration shared with the other model-free algos.
+from sheeprl_tpu.utils.model_manager import log_agent_from_checkpoint as log_models_from_checkpoint  # noqa: E402, F401

@@ -36,22 +36,11 @@ class PlayerDAP(PlayerDV3):
     decisions are explicit Bernoulli draws keyed off the step PRNG.
     """
 
-    def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False):
-        recurrent_state, stochastic_state, actions = state
-        k_rep, k_halt, k_act = jax.random.split(key, 3)
-        embedded = self.encoder.apply(wm_params["encoder"], obs)
-        recurrent_state = self.rssm._recurrent(wm_params, stochastic_state, actions, recurrent_state)
-        if self.rssm.decoupled:
-            _, stoch = self.rssm._representation(wm_params, embedded, k_rep)
-        else:
-            _, stoch = self.rssm._representation(wm_params, embedded, k_rep, recurrent_state=recurrent_state)
-        stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
-        latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
+    def _actor_step(self, actor_params, latent, key, greedy: bool = False):
+        k_halt, k_act = jax.random.split(key)
         pre_dist, _ = self.actor.apply(actor_params, latent, k_halt, method=PonderActor.ponder_infer)
         out = ActorOutput(self.actor, pre_dist)
-        actions_list = out.sample_actions(k_act, greedy=greedy)
-        actions = jnp.concatenate(actions_list, axis=-1)
-        return tuple(actions_list), (recurrent_state, stochastic_state, actions)
+        return out.sample_actions(k_act, greedy=greedy)
 
 
 def build_agent(

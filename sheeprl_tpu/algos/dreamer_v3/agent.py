@@ -684,6 +684,12 @@ class PlayerDV3:
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
 
+    def _actor_step(self, actor_params, latent, key, greedy: bool = False):
+        """Sample actions from the latent; subclasses override to change how the
+        actor is queried (e.g. PonderNet inference-mode halting in PlayerDAP)."""
+        out = ActorOutput(self.actor, self.actor.apply(actor_params, latent))
+        return out.sample_actions(key, greedy=greedy)
+
     def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False):
         recurrent_state, stochastic_state, actions = state
         k_rep, k_act = jax.random.split(key)
@@ -695,8 +701,7 @@ class PlayerDV3:
             _, stoch = self.rssm._representation(wm_params, embedded, k_rep, recurrent_state=recurrent_state)
         stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
         latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
-        out = ActorOutput(self.actor, self.actor.apply(actor_params, latent))
-        actions_list = out.sample_actions(k_act, greedy=greedy)
+        actions_list = self._actor_step(actor_params, latent, k_act, greedy=greedy)
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 

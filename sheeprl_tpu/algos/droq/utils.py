@@ -1,3 +1,6 @@
 """DroQ utilities (reference sheeprl/algos/droq/utils.py): reuses SAC's surfaces."""
 
 from sheeprl_tpu.algos.sac.utils import AGGREGATOR_KEYS, MODELS_TO_REGISTER, prepare_obs, test  # noqa: F401
+
+# Single-'agent' registration shared with the other model-free algos.
+from sheeprl_tpu.utils.model_manager import log_agent_from_checkpoint as log_models_from_checkpoint  # noqa: E402, F401

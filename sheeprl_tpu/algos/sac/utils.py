@@ -48,3 +48,6 @@ def test(player, runtime, cfg, log_dir: str) -> None:
         if getattr(runtime, "logger", None) is not None:
             runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
     env.close()
+
+# Single-'agent' registration shared with the other model-free algos.
+from sheeprl_tpu.utils.model_manager import log_agent_from_checkpoint as log_models_from_checkpoint  # noqa: E402, F401

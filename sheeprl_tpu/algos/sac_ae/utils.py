@@ -65,3 +65,20 @@ def test(player, runtime, cfg, log_dir: str) -> None:
         if getattr(runtime, "logger", None) is not None:
             runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
     env.close()
+
+
+def log_models_from_checkpoint(runtime, env, cfg, state) -> Dict[str, Any]:
+    """Register the SAC-AE agent (+ its encoder/decoder subtrees) from a checkpoint
+    (reference sac_ae/utils.py logs agent, encoder, decoder)."""
+    del env
+    from sheeprl_tpu.algos.sac_ae.agent import SACAEParams
+    from sheeprl_tpu.utils.model_manager import log_model
+
+    agent = state["agent"]
+    if not isinstance(agent, SACAEParams):
+        agent = SACAEParams(*agent) if isinstance(agent, (tuple, list)) else SACAEParams(**agent)
+    return {
+        "agent": log_model(runtime, cfg, "agent", agent),
+        "encoder": log_model(runtime, cfg, "encoder", agent.encoder),
+        "decoder": log_model(runtime, cfg, "decoder", agent.decoder),
+    }

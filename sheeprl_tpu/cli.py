@@ -270,18 +270,55 @@ def evaluation(overrides: Optional[Sequence[str]] = None) -> None:
 def registration(overrides: Optional[Sequence[str]] = None) -> None:
     """`sheeprl-registration` entry: register checkpointed models in a model registry.
 
-    Reference: sheeprl/cli.py:408-450. Requires MLflow, which is optional; without it
-    this command degrades to a clear error message.
+    Reference: sheeprl/cli.py:408-450 (MLflow-backed). Here the default backend is
+    the local filesystem registry (sheeprl_tpu/utils/model_manager.py); the command
+    boots entirely from the checkpoint's sidecar config, like evaluation.
+    Usage: ``sheeprl-registration checkpoint_path=<ckpt> [model_manager.registry_dir=...]``.
     """
-    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+    import yaml
 
-    if not _IS_MLFLOW_AVAILABLE:
-        raise ModuleNotFoundError("MLflow is not installed; model registration is unavailable in this build")
-    from sheeprl_tpu.utils.mlflow import register_model_from_checkpoint  # pragma: no cover
+    from sheeprl_tpu.utils.model_manager import register_model_from_checkpoint
 
     overrides = list(overrides if overrides is not None else sys.argv[1:])
-    cfg = compose(config_name="model_manager_config", overrides=overrides)  # pragma: no cover
-    register_model_from_checkpoint(cfg)  # pragma: no cover
+    cli_cfg: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        cli_cfg[key.strip()] = yaml.safe_load(value)
+    ckpt_path = cli_cfg.pop("checkpoint_path", None)
+    if ckpt_path is None:
+        raise ConfigError("You must specify checkpoint_path=<path> for model registration")
+    ckpt_path = os.path.abspath(ckpt_path)
+    cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
+    if not os.path.isfile(cfg_path):
+        raise RuntimeError(f"The config file of the checkpoint does not exist: {cfg_path}")
+    with open(cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+    for key, value in cli_cfg.items():  # dotted overrides, e.g. model_manager.registry_dir=...
+        node = cfg
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, dotdict({}))
+        node[parts[-1]] = value
+    cfg.env.num_envs = 1
+    cfg.fabric.devices = 1
+
+    _import_algorithms()
+    entry = _find_entrypoint(cfg.algo.name)
+    if entry is None:
+        raise RuntimeError(f"Given the algorithm named '{cfg.algo.name}', no entrypoint has been registered")
+    utils = importlib.import_module(f"{entry['module']}.utils")
+    log_models_fn = getattr(utils, "log_models_from_checkpoint", None)
+    if log_models_fn is None:
+        raise RuntimeError(f"The algorithm '{cfg.algo.name}' does not support model registration")
+
+    runtime = Runtime(accelerator=cfg.fabric.get("accelerator", "auto"), devices=1, precision=cfg.fabric.precision)
+    seed_everything(cfg.seed)
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    state = load_state(ckpt_path)
+    registered = register_model_from_checkpoint(runtime, cfg, state, log_models_fn)
+    for name, version in registered.items():
+        runtime.print(f"{name}: registered as '{version.name}' v{version.version} at {version.path}")
 
 
 def run(overrides: Optional[Sequence[str]] = None) -> None:

@@ -21,6 +21,7 @@ class TensorBoardLogger:
         self.log_dir = os.path.join(root_dir, name) if name else root_dir
         os.makedirs(self.log_dir, exist_ok=True)
         self._writer = SummaryWriter(logdir=self.log_dir)
+        self._last_values: Dict[str, float] = {}
 
     @property
     def name(self) -> str:
@@ -30,6 +31,7 @@ class TensorBoardLogger:
         for key, value in metrics.items():
             try:
                 self._writer.add_scalar(key, float(value), global_step=step)
+                self._last_values[key] = float(value)
             except (TypeError, ValueError):
                 pass
 
@@ -43,6 +45,16 @@ class TensorBoardLogger:
         self._writer.add_video(tag, video, global_step=step, fps=fps)
 
     def finalize(self) -> None:
+        # Queryable sidecar of the final scalar values: the model manager ranks runs
+        # by these (register_best_models), the analogue of ranking MLflow runs by a
+        # logged metric (reference mlflow.py:214-279).
+        try:
+            import json
+
+            with open(os.path.join(self.log_dir, "metrics.json"), "w") as f:
+                json.dump(self._last_values, f, indent=2)
+        except Exception:
+            pass
         self._writer.close()
 
     def close(self) -> None:

@@ -1,0 +1,374 @@
+"""Model manager: versioned model registry for trained agents.
+
+TPU-native replacement for the reference's MLflow-backed manager
+(sheeprl/utils/mlflow.py:35-427). The default backend is a LOCAL filesystem
+registry — models are host-numpy pytrees pickled under
+``<registry_dir>/<model_name>/v<N>/`` with JSON metadata and a Markdown
+changelog, mirroring MLflow's model-version semantics (register / latest /
+transition-stage / delete / download). A ``model_manager.backend=mlflow``
+selection is reserved but NOT implemented — it raises with a pointer to
+mlflow's own registry; the local backend is the supported path.
+
+Every algorithm's ``utils.log_models_from_checkpoint`` calls :func:`log_model`
+per model and returns ``{name: ModelInfo}``; the registration CLI
+(:func:`register_model_from_checkpoint`) then registers the subset declared in
+``cfg.model_manager.models`` (reference mlflow.py:368-382).
+"""
+
+from __future__ import annotations
+
+import getpass
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+VERSION_MD_TEMPLATE = "\n## **Version {}**\n"
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+        tree,
+        is_leaf=lambda x: isinstance(x, jax.Array),
+    )
+
+
+@dataclass
+class ModelInfo:
+    """What ``log_model`` returns (stands in for mlflow's ModelInfo)."""
+
+    model_uri: str
+    name: str
+    metadata: Dict[str, Any] = field(default_factory=dict)
+
+    # keep the reference's attribute spelling working (mlflow.py:381: `_model_uri`)
+    @property
+    def _model_uri(self) -> str:
+        return self.model_uri
+
+
+@dataclass
+class ModelVersion:
+    """A registered model version (stands in for mlflow's ModelVersion)."""
+
+    name: str
+    version: int
+    path: str
+    stage: str = "None"
+    description: str = ""
+
+
+def default_registry_dir(cfg: Optional[Dict[str, Any]] = None) -> str:
+    if cfg is not None:
+        mm = cfg.get("model_manager", {}) if hasattr(cfg, "get") else {}
+        reg = mm.get("registry_dir") if hasattr(mm, "get") else None
+        if reg:
+            return str(reg)
+    return os.environ.get("SHEEPRL_REGISTRY_DIR", "models_registry")
+
+
+def log_model(runtime, cfg, name: str, params: Any, artifacts_dir: Optional[str] = None) -> ModelInfo:
+    """Serialize one model pytree as a run artifact and return its location.
+
+    The reference logs each module with ``mlflow.pytorch.log_model``
+    (e.g. dreamer_v3/utils.py:226-234); here the artifact is a pickled
+    host-numpy pytree under ``runtime.log_dir`` (set by
+    :func:`register_model_from_checkpoint` to a temp dir it cleans up) or a
+    caller-provided ``artifacts_dir``.
+    """
+    if artifacts_dir is None:
+        base = getattr(runtime, "log_dir", None)
+        if base is None:
+            raise ValueError(
+                "log_model needs a destination: pass artifacts_dir or set runtime.log_dir "
+                "(register_model_from_checkpoint does this automatically)"
+            )
+        artifacts_dir = os.path.join(base, "model_artifacts")
+    os.makedirs(artifacts_dir, exist_ok=True)
+    path = os.path.join(artifacts_dir, f"{name}.pkl")
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(params), f, protocol=pickle.HIGHEST_PROTOCOL)
+    return ModelInfo(model_uri=path, name=name, metadata={"algo": cfg.algo.name, "env": cfg.env.id})
+
+
+def log_agent_from_checkpoint(runtime, env, cfg, state) -> Dict[str, ModelInfo]:
+    """``log_models_from_checkpoint`` for single-model algorithms whose checkpoint
+    stores the whole agent under ``state["agent"]`` (ppo, ppo_recurrent, a2c, sac,
+    droq — reference per-algo utils all register just ``{"agent"}``)."""
+    del env
+    return {"agent": log_model(runtime, cfg, "agent", state["agent"])}
+
+
+class LocalModelManager:
+    """Filesystem model registry with MLflow-like version semantics
+    (reference AbstractModelManager, mlflow.py:35-72).
+
+    Layout::
+
+        <registry_dir>/<model_name>/
+            CHANGELOG.md
+            v1/model.pkl
+            v1/meta.json        {author, date, description, tags, stage}
+            v2/...
+    """
+
+    def __init__(self, runtime, registry_dir: str):
+        self.runtime = runtime
+        self.registry_dir = os.path.abspath(registry_dir)
+        os.makedirs(self.registry_dir, exist_ok=True)
+
+    # ----- helpers -------------------------------------------------------------------
+    def _model_dir(self, model_name: str) -> str:
+        return os.path.join(self.registry_dir, model_name)
+
+    def _versions(self, model_name: str) -> Dict[int, str]:
+        mdir = self._model_dir(model_name)
+        if not os.path.isdir(mdir):
+            return {}
+        out = {}
+        for d in os.listdir(mdir):
+            if d.startswith("v") and d[1:].isdigit():
+                out[int(d[1:])] = os.path.join(mdir, d)
+        return out
+
+    @staticmethod
+    def _author() -> str:
+        try:
+            return getpass.getuser()
+        except Exception:  # pragma: no cover - getuser can fail in odd envs
+            return "unknown"
+
+    @classmethod
+    def _author_and_date(cls) -> str:
+        return f"**Author**: {cls._author()}\n\n**Date**: {datetime.now().strftime('%d/%m/%Y %H:%M:%S')}\n\n"
+
+    def _append_changelog(self, model_name: str, text: str) -> None:
+        path = os.path.join(self._model_dir(model_name), "CHANGELOG.md")
+        header = "" if os.path.isfile(path) else "# MODEL CHANGELOG\n"
+        with open(path, "a") as f:
+            f.write(header + text)
+
+    def _read_meta(self, model_name: str, version: int) -> Dict[str, Any]:
+        versions = self._versions(model_name)
+        if version not in versions:
+            raise ValueError(f"Model '{model_name}' has no version {version}")
+        with open(os.path.join(versions[version], "meta.json")) as f:
+            return json.load(f)
+
+    def _write_meta(self, model_name: str, version: int, meta: Dict[str, Any]) -> None:
+        vdir = self._versions(model_name)[version]
+        with open(os.path.join(vdir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+
+    # ----- API (reference AbstractModelManager:35-72) ---------------------------------
+    def register_model(
+        self,
+        model_location: str,
+        model_name: str,
+        description: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+    ) -> ModelVersion:
+        """Copy a logged artifact into the registry as the next version
+        (reference MlflowModelManager.register_model, mlflow.py:88-123)."""
+        versions = self._versions(model_name)
+        version = max(versions) + 1 if versions else 1
+        vdir = os.path.join(self._model_dir(model_name), f"v{version}")
+        os.makedirs(vdir, exist_ok=True)
+        shutil.copy2(model_location, os.path.join(vdir, "model.pkl"))
+        meta = {
+            "author": self._author(),
+            "date": datetime.now().isoformat(),
+            "description": description or "",
+            "tags": dict(tags or {}),
+            "stage": "None",
+        }
+        with open(os.path.join(vdir, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+        self._append_changelog(
+            model_name,
+            VERSION_MD_TEMPLATE.format(version) + self._author_and_date() + (f"{description}\n\n" if description else ""),
+        )
+        if self.runtime is not None:
+            self.runtime.print(f"Registered model {model_name} with version {version}")
+        return ModelVersion(name=model_name, version=version, path=vdir, description=description or "")
+
+    def get_latest_version(self, model_name: str) -> ModelVersion:
+        versions = self._versions(model_name)
+        if not versions:
+            raise ValueError(f"Model '{model_name}' is not registered")
+        latest = max(versions)
+        meta = self._read_meta(model_name, latest)
+        return ModelVersion(
+            name=model_name,
+            version=latest,
+            path=versions[latest],
+            stage=meta.get("stage", "None"),
+            description=meta.get("description", ""),
+        )
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> ModelVersion:
+        """Move a model version to a new stage (reference mlflow.py:139-176)."""
+        meta = self._read_meta(model_name, version)
+        meta["stage"] = stage
+        self._write_meta(model_name, version, meta)
+        self._append_changelog(
+            model_name,
+            f"\n## **Transition model {model_name} version {version} to stage {stage}**\n"
+            + self._author_and_date()
+            + (f"{description}\n\n" if description else ""),
+        )
+        versions = self._versions(model_name)
+        return ModelVersion(name=model_name, version=version, path=versions[version], stage=stage)
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        """Delete one version of a model (reference mlflow.py:178-212)."""
+        versions = self._versions(model_name)
+        if version not in versions:
+            raise ValueError(f"Model '{model_name}' has no version {version}")
+        shutil.rmtree(versions[version])
+        self._append_changelog(
+            model_name,
+            f"\n## **Deleted model {model_name} version {version}**\n"
+            + self._author_and_date()
+            + (f"{description}\n\n" if description else ""),
+        )
+
+    def register_best_models(
+        self,
+        experiment_dir: str,
+        models_keys: set,
+        metric: str = "Test/cumulative_reward",
+    ) -> Dict[str, ModelVersion]:
+        """Register the models of the best run under an experiment directory.
+
+        Runs are ranked by the final value of ``metric`` in each run's
+        ``metrics.json`` (written by the logger on finalize); the winning run's
+        latest checkpoint supplies the model pytrees (reference mlflow.py:214-279
+        ranks MLflow runs by a logged metric the same way).
+        """
+        best_score, best_run = None, None
+        for root, _, files in os.walk(experiment_dir):
+            if "metrics.json" not in files:
+                continue
+            with open(os.path.join(root, "metrics.json")) as f:
+                metrics = json.load(f)
+            score = metrics.get(metric)
+            if score is None:
+                continue
+            if best_score is None or score > best_score:
+                best_score, best_run = score, root
+        if best_run is None:
+            raise RuntimeError(f"No run under '{experiment_dir}' has '{metric}' in its metrics.json")
+        ckpt_dir = os.path.join(best_run, "checkpoint")
+        ckpts = sorted(
+            (os.path.join(ckpt_dir, f) for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")),
+            key=os.path.getmtime,
+        ) if os.path.isdir(ckpt_dir) else []
+        if not ckpts:
+            raise RuntimeError(f"The best run '{best_run}' (score {best_score}) has no checkpoint to register")
+        with open(ckpts[-1], "rb") as f:
+            state = pickle.load(f)
+        out = {}
+        with tempfile.TemporaryDirectory(prefix="sheeprl_tpu_best_") as tmp:
+            for name in sorted(models_keys):
+                if name not in state:
+                    continue
+                path = os.path.join(tmp, f"{name}.pkl")
+                with open(path, "wb") as f:
+                    pickle.dump(_to_host(state[name]), f, protocol=pickle.HIGHEST_PROTOCOL)
+                out[name] = self.register_model(path, name, description=f"Best {metric}: {best_score}")
+        return out
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        """Copy a registered version's artifact to ``output_path``
+        (reference mlflow.py:281-295)."""
+        versions = self._versions(model_name)
+        if version not in versions:
+            raise ValueError(f"Model '{model_name}' has no version {version}")
+        os.makedirs(output_path, exist_ok=True)
+        shutil.copy2(os.path.join(versions[version], "model.pkl"), output_path)
+
+    def load_model(self, model_name: str, version: Optional[int] = None) -> Any:
+        """Load a registered model pytree (local-registry convenience)."""
+        if version is None:
+            version = self.get_latest_version(model_name).version
+        versions = self._versions(model_name)
+        with open(os.path.join(versions[version], "model.pkl"), "rb") as f:
+            return pickle.load(f)
+
+
+def build_model_manager(runtime, cfg) -> LocalModelManager:
+    backend = str(cfg.model_manager.get("backend", "local")).lower() if "model_manager" in cfg else "local"
+    if backend == "mlflow":  # pragma: no cover - optional dependency
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "model_manager.backend=mlflow requires mlflow, which is not installed; "
+                "use the default local backend instead"
+            )
+        raise NotImplementedError(
+            "The MLflow backend is delegated to mlflow's own registry; point MLFLOW_TRACKING_URI "
+            "at your server and use mlflow.register_model on the logged artifacts."
+        )
+    return LocalModelManager(runtime, default_registry_dir(cfg))
+
+
+def register_model_from_checkpoint(
+    runtime,
+    cfg,
+    state: Dict[str, Any],
+    log_models_from_checkpoint: Callable[..., Dict[str, ModelInfo]],
+) -> Dict[str, ModelVersion]:
+    """Rebuild the agent from a checkpoint, log its models, and register the subset
+    declared in ``cfg.model_manager.models`` (reference mlflow.py:330-382)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, None, "test", vector_env_idx=0)()
+    tmpdir = tempfile.mkdtemp(prefix="sheeprl_tpu_models_")
+    prev_log_dir = getattr(runtime, "log_dir", None)
+    runtime.log_dir = tmpdir  # log_model writes its artifacts here; removed below
+    try:
+        import gymnasium as gym
+
+        if not isinstance(env.observation_space, gym.spaces.Dict):
+            raise RuntimeError(
+                f"Unexpected observation type, should be of type Dict, got: {env.observation_space}"
+            )
+        if list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder) == []:
+            raise RuntimeError(
+                "You should specify at least one CNN keys or MLP keys from the cli: "
+                "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+            )
+        models_info = log_models_from_checkpoint(runtime, env, cfg, state)
+        manager = build_model_manager(runtime, cfg)
+        declared = set(cfg.model_manager.models.keys())
+        if not declared.issubset(models_info.keys()):
+            raise RuntimeError(
+                f"The models you want to register must be a subset of the models of the {cfg.algo.name} agent. "
+                f"\nModels specified in the configs: {sorted(declared)}."
+                f"\nModels of the {cfg.algo.name} agent: {sorted(models_info.keys())}."
+            )
+        registered = {}
+        for k, cfg_model in cfg.model_manager.models.items():
+            registered[k] = manager.register_model(
+                models_info[k].model_uri,
+                cfg_model["model_name"],
+                cfg_model.get("description"),
+                cfg_model.get("tags"),
+            )
+        return registered
+    finally:
+        runtime.log_dir = prev_log_dir
+        shutil.rmtree(tmpdir, ignore_errors=True)
+        env.close()

@@ -1,0 +1,133 @@
+"""Local model-registry tests (reference mlflow-backed manager, sheeprl/utils/mlflow.py)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.model_manager import LocalModelManager, ModelInfo, log_model
+
+
+class _FakeRuntime:
+    log_dir = None
+
+    def print(self, *a, **k):
+        pass
+
+
+@pytest.fixture()
+def manager(tmp_path):
+    return LocalModelManager(_FakeRuntime(), str(tmp_path / "registry"))
+
+
+def _artifact(tmp_path, name="m.pkl"):
+    path = tmp_path / name
+    with open(path, "wb") as f:
+        pickle.dump({"w": np.ones((2, 2))}, f)
+    return str(path)
+
+
+def test_register_and_versioning(manager, tmp_path):
+    art = _artifact(tmp_path)
+    v1 = manager.register_model(art, "ppo_agent", description="first")
+    v2 = manager.register_model(art, "ppo_agent", description="second")
+    assert (v1.version, v2.version) == (1, 2)
+    latest = manager.get_latest_version("ppo_agent")
+    assert latest.version == 2
+    assert latest.description == "second"
+    changelog = open(os.path.join(manager.registry_dir, "ppo_agent", "CHANGELOG.md")).read()
+    assert "Version 1" in changelog and "Version 2" in changelog
+
+
+def test_transition_and_delete(manager, tmp_path):
+    art = _artifact(tmp_path)
+    manager.register_model(art, "m")
+    manager.register_model(art, "m")
+    moved = manager.transition_model("m", 1, "production")
+    assert moved.stage == "production"
+    manager.delete_model("m", 2)
+    assert manager.get_latest_version("m").version == 1
+    with pytest.raises(ValueError):
+        manager.delete_model("m", 2)
+
+
+def test_download_and_load(manager, tmp_path):
+    art = _artifact(tmp_path)
+    manager.register_model(art, "m")
+    out = tmp_path / "downloaded"
+    manager.download_model("m", 1, str(out))
+    assert (out / "model.pkl").is_file()
+    tree = manager.load_model("m")
+    assert np.allclose(tree["w"], 1.0)
+
+
+def test_log_model_returns_uri(tmp_path):
+    class _Cfg:
+        class algo:
+            name = "ppo"
+
+        class env:
+            id = "dummy"
+
+    info = log_model(_FakeRuntime(), _Cfg, "agent", {"w": np.zeros(3)}, artifacts_dir=str(tmp_path / "arts"))
+    assert isinstance(info, ModelInfo)
+    assert os.path.isfile(info.model_uri)
+    assert info._model_uri == info.model_uri
+
+
+def test_registration_cli_from_ppo_checkpoint(standard_args, tmp_path, monkeypatch):
+    """End-to-end: train PPO with a checkpoint, register its agent via the CLI."""
+    from sheeprl_tpu.cli import registration, run
+
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=standard_args
+        + [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "fabric.devices=1",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=2",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "buffer.memmap=False",
+            "env.num_envs=1",
+            "checkpoint.save_last=True",
+        ]
+    )
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+    registry = tmp_path / "registry"
+    registration(
+        overrides=[f"checkpoint_path={ckpts[0]}", f"model_manager.registry_dir={registry}"]
+    )
+    model_dirs = os.listdir(registry)
+    assert len(model_dirs) == 1  # PPO registers a single 'agent' model
+    assert (registry / model_dirs[0] / "v1" / "model.pkl").is_file()
+
+
+def test_register_best_models(manager, tmp_path):
+    """Runs are ranked by metrics.json; the winner's checkpoint supplies the models."""
+    import json
+
+    exp = tmp_path / "exp"
+    for name, score in [("run_a", 1.0), ("run_b", 5.0)]:
+        run = exp / name / "version_0"
+        (run / "checkpoint").mkdir(parents=True)
+        with open(run / "metrics.json", "w") as f:
+            json.dump({"Test/cumulative_reward": score}, f)
+        with open(run / "checkpoint" / "ckpt_1_0.ckpt", "wb") as f:
+            pickle.dump({"agent": {"w": np.full((2,), score)}, "iter_num": 1}, f)
+
+    out = manager.register_best_models(str(exp), {"agent"})
+    assert set(out) == {"agent"}
+    tree = manager.load_model("agent")
+    assert np.allclose(tree["w"], 5.0)  # run_b won
+    assert "Best Test/cumulative_reward: 5.0" in manager.get_latest_version("agent").description
