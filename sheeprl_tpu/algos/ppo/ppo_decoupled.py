@@ -1,0 +1,339 @@
+"""PPO, decoupled actor-learner (reference sheeprl/algos/ppo/ppo_decoupled.py:33-670).
+
+Role split on the device mesh (see sheeprl_tpu/parallel/decoupled.py): device 0
+is the PLAYER (policy forwards for env stepping run on their own chip), devices
+1..N-1 are the TRAINERS (the jitted PPO optimization phase data-shards its
+minibatches over the trainer mesh; XLA's all-reduce over ICI is the reference's
+DDP ``optimization_pg``). Per round the player ships the full rollout to the
+trainer role and blocks for the refreshed parameters — the same synchronous
+scatter -> train -> broadcast cycle as the reference (:294-310), with
+``jax.device_put`` replacing both the object scatter and the flattened-vector
+parameter broadcast.
+
+Per-rank semantics: ``per_rank_batch_size`` applies per TRAINER device, so the
+global minibatch is ``per_rank_batch_size * (num_devices - 1)`` — matching the
+reference where only ranks 1..N-1 optimize (:497-548).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.ppo import make_train_fn
+from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel import split_runtime
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in cfg.env.wrapper._target_.lower():
+        raise ValueError(
+            "MineDojo is not currently supported by PPO agent, since it does not take "
+            "into consideration the action masks provided by the environment, but needed "
+            "in order to play correctly the game. "
+            "As an alternative you can use one of the Dreamers' agents."
+        )
+    player_rt, trainer_rt = split_runtime(runtime)
+    trainer_world = trainer_rt.world_size
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    initial_clip_coef = float(cfg.algo.clip_coef)
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.print(
+        f"Decoupled PPO: player on {player_rt.mesh.devices.ravel()[0]}, "
+        f"{trainer_world} trainer device(s)"
+    )
+
+    # The player drives num_envs envs (reference player, ppo_decoupled.py:56-70)
+    n_envs = cfg.env.num_envs
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    observation_space = envs.single_observation_space
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
+        raise RuntimeError(
+            "You should specify at least one CNN keys or MLP keys from the cli: "
+            "`cnn_keys.encoder=[rgb]` or `mlp_keys.encoder=[state]`"
+        )
+    obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
+    cnn_keys = cfg.algo.cnn_keys.encoder
+
+    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    actions_dim = tuple(
+        envs.single_action_space.shape
+        if is_continuous
+        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+    )
+    clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
+
+    # Trainer-side agent/optimizer (params replicated over the trainer mesh);
+    # the player keeps its own copy on the player device (reference :114-127:
+    # the player receives the initial weights from trainer rank-1).
+    agent, params, player = build_agent(
+        trainer_rt, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
+    )
+    player.params = player_rt.replicate(params)
+
+    policy_steps_per_iter = int(n_envs * cfg.algo.rollout_steps)
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    n_data = cfg.algo.rollout_steps * n_envs
+    global_bs = int(cfg.algo.per_rank_batch_size) * trainer_world
+    updates_per_iter = int(cfg.algo.update_epochs) * max(n_data // global_bs, 1)
+    optim_kwargs = dict(cfg.algo.optimizer)
+    if cfg.algo.anneal_lr:
+        lr0 = optim_kwargs.pop("lr", 1e-3)
+        optim_kwargs["lr"] = optax.linear_schedule(lr0, 0.0, total_iters * updates_per_iter)
+    tx = with_clipping(instantiate(optim_kwargs)(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    if state:
+        opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
+    opt_state = trainer_rt.replicate(opt_state)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    if cfg.buffer.size < cfg.algo.rollout_steps:
+        raise ValueError(
+            f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
+            f"than the rollout steps ({cfg.algo.rollout_steps})"
+        )
+    rb = ReplayBuffer(
+        cfg.buffer.size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=obs_keys,
+    )
+
+    last_train = 0
+    train_step = 0
+    start_iter = state["iter_num"] + 1 if state else 1
+    policy_step = state["iter_num"] * policy_steps_per_iter if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // trainer_world
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---- trainer role: the whole optimization phase (GAE + epochs x minibatches)
+    # compiled once over the trainer mesh
+    train_fn = make_train_fn(agent, tx, cfg, trainer_rt, n_data, obs_keys, cnn_keys)
+    trainer_state = {"params": params, "opt_state": opt_state}
+
+    def trainer_step(payload):
+        # The whole payload moves onto the trainer mesh (replicated rollout —
+        # the global minibatch permutation spans it, like the reference's
+        # DistributedSampler over the scattered chunks); the per-minibatch
+        # sharding constraint inside train_fn splits work across trainers.
+        device_data, next_values, train_key, clip_coef, ent_coef = trainer_rt.replicate(payload)
+        new_params, new_opt, metrics = train_fn(
+            trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
+            clip_coef, ent_coef,
+        )
+        trainer_state["params"] = new_params
+        trainer_state["opt_state"] = new_opt
+        # Parameter refresh for the player: direct device-to-device resharding
+        # (reference :550-554 does a flattened-vector NCCL broadcast)
+        player_params = jax.device_put(new_params, player_rt.replicated)
+        return player_params, metrics
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    step_data = {}
+    next_obs = envs.reset(seed=cfg.seed)[0]
+    for k in obs_keys:
+        if k in cnn_keys:
+            next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
+        step_data[k] = next_obs[k][np.newaxis]
+
+    for iter_num in range(start_iter, total_iters + 1):
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += n_envs
+
+                with timer("Time/env_interaction_time", SumMetric()):
+                    jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                    cat_actions, env_actions, logprobs, values, rng = player(jax_obs, rng)
+                    real_actions = np.asarray(env_actions)
+                    np_actions = np.asarray(cat_actions)
+
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        final_obs_arr = np.asarray(info["final_obs"], dtype=object)
+                        real_next_obs = {k: [] for k in obs_keys}
+                        valid_idx = []
+                        for te in truncated_envs:
+                            fo = final_obs_arr[te]
+                            if fo is None:
+                                continue
+                            valid_idx.append(te)
+                            for k in obs_keys:
+                                v = np.asarray(fo[k], dtype=np.float32)
+                                if k in cnn_keys:
+                                    v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+                                real_next_obs[k].append(v)
+                        if valid_idx:
+                            stacked = {k: jnp.asarray(np.stack(v)) for k, v in real_next_obs.items()}
+                            vals = np.asarray(player.get_values(stacked)).reshape(len(valid_idx))
+                            rewards = np.asarray(rewards, dtype=np.float32)
+                            rewards[valid_idx] += cfg.algo.gamma * vals
+                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                    rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
+
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(values)[np.newaxis]
+                step_data["actions"] = np_actions[np.newaxis]
+                step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+                next_obs = {}
+                for k in obs_keys:
+                    _obs = obs[k]
+                    if k in cnn_keys:
+                        _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                    step_data[k] = _obs[np.newaxis]
+                    next_obs[k] = _obs
+
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            # ---- ship the rollout to the trainer role, block for new params
+            # (the reference's scatter_object_list + params broadcast round)
+            local_data = rb.to_arrays(dtype=np.float32)
+            if cfg.buffer.size > cfg.algo.rollout_steps:
+                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                local_data = {k: v[idx] for k, v in local_data.items()}
+            with timer("Time/train_time", SumMetric()):
+                jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                next_values = np.asarray(player.get_values(jax_obs))
+                rng, train_key = jax.random.split(rng)
+                host_data = {k: v for k, v in local_data.items() if k not in ("returns", "advantages")}
+                player_params, train_metrics = trainer_step(
+                    (host_data, next_values, train_key, jnp.float32(cfg.algo.clip_coef), jnp.float32(cfg.algo.ent_coef))
+                )
+                jax.block_until_ready(player_params)
+                player.params = player_params
+            train_step += trainer_world
+
+            if cfg.metric.log_level > 0:
+                if aggregator:
+                    for k, v in train_metrics.items():
+                        if k in aggregator:
+                            aggregator.update(k, float(v))
+                logger.log_metrics(
+                    {"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step
+                )
+                if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    if aggregator and not aggregator.disabled:
+                        logger.log_metrics(aggregator.compute(), policy_step)
+                        aggregator.reset()
+                    if not timer.disabled:
+                        timer_metrics = timer.compute()
+                        if timer_metrics.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) * cfg.env.action_repeat
+                                    )
+                                    / timer_metrics["Time/env_interaction_time"]
+                                },
+                                policy_step,
+                            )
+                        timer.reset()
+                    last_log = policy_step
+                    last_train = train_step
+
+            if cfg.algo.anneal_clip_coef:
+                cfg.algo.clip_coef = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                cfg.algo.ent_coef = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": jax.device_get(trainer_state["params"]),
+                    "optimizer": jax.device_get(trainer_state["opt_state"]),
+                    "iter_num": iter_num,
+                    "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, player_rt, cfg, log_dir)
+    if logger:
+        logger.finalize()

@@ -1,0 +1,319 @@
+"""SAC, decoupled actor-learner (reference sheeprl/algos/sac/sac_decoupled.py:33-588).
+
+Role split on the device mesh (see sheeprl_tpu/parallel/decoupled.py): device 0
+is the PLAYER — it owns the envs AND the replay buffer (reference :116-123) and
+runs policy forwards on its own chip — devices 1..N-1 are the TRAINERS. Each
+training round the player samples ``G x per_rank_batch_size x (N-1)``
+transitions and ships them to the trainer role, which `lax.scan`s the G fused
+SAC updates over the trainer mesh and hands the refreshed parameters back
+(reference :243-260 scatter + :550-554 broadcast).
+
+Per-rank semantics: ``per_rank_batch_size`` applies per TRAINER device and the
+replay ratio is computed against the trainer world size (reference :237:
+``ratio(ratio_steps / (fabric.world_size - 1))``).
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+from math import prod
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import make_train_fn
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel import split_runtime
+from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm(decoupled=True)
+def main(runtime, cfg: Dict[str, Any]):
+    if "minedojo" in cfg.env.wrapper._target_.lower():
+        raise ValueError("MineDojo is not currently supported by SAC agent.")
+    player_rt, trainer_rt = split_runtime(runtime)
+    trainer_world = trainer_rt.world_size
+
+    state = None
+    if cfg.checkpoint.resume_from:
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(cfg.checkpoint.resume_from)
+
+    if len(cfg.algo.cnn_keys.encoder) > 0:
+        warnings.warn("SAC algorithm cannot allow to use images as observations, the CNN keys will be ignored")
+        cfg.algo.cnn_keys.encoder = []
+
+    logger = get_logger(runtime, cfg)
+    if logger:
+        logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    runtime.logger = logger
+    runtime.print(f"Log dir: {log_dir}")
+    runtime.print(
+        f"Decoupled SAC: player on {player_rt.mesh.devices.ravel()[0]}, "
+        f"{trainer_world} trainer device(s)"
+    )
+
+    n_envs = cfg.env.num_envs
+    envs = vectorized_env(
+        [
+            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+            for i in range(n_envs)
+        ],
+        sync=cfg.env.sync_env,
+    )
+    action_space = envs.single_action_space
+    observation_space = envs.single_observation_space
+    if not isinstance(action_space, gym.spaces.Box):
+        raise ValueError("Only continuous action space is supported for the SAC agent")
+    if not isinstance(observation_space, gym.spaces.Dict):
+        raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
+    if len(cfg.algo.mlp_keys.encoder) == 0:
+        raise RuntimeError("You should specify at least one MLP key for the encoder: `mlp_keys.encoder=[state]`")
+    for k in cfg.algo.mlp_keys.encoder:
+        if len(observation_space[k].shape) > 1:
+            raise ValueError(
+                "Only environments with vector-only observations are supported by the SAC agent. "
+                f"The observation with key '{k}' has shape {observation_space[k].shape}. "
+                f"Provided environment: {cfg.env.id}"
+            )
+
+    # Trainer-side agent (params replicated over the trainer mesh); the player's
+    # actor copy lives on the player device (reference :93-127).
+    actor, critic, params, player = build_agent(
+        trainer_rt, cfg, observation_space, action_space, state["agent"] if state else None
+    )
+    player.params = player_rt.replicate(params.actor)
+    act_dim = prod(action_space.shape)
+    target_entropy = jnp.float32(-act_dim)
+    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
+    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+
+    policy_steps_per_iter = int(n_envs)
+    ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+    init_opt, train_fn = make_train_fn(
+        actor, critic, cfg, trainer_rt, action_scale, action_bias, target_entropy, ema_every
+    )
+    opt_states = init_opt(params)
+    if state:
+        opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
+    opt_states = trainer_rt.replicate(opt_states)
+    update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(0)
+
+    if runtime.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    aggregator = None
+    if not MetricAggregator.disabled:
+        aggregator = instantiate(cfg.metric.aggregator)
+
+    # The PLAYER owns the replay buffer (reference :116-123)
+    buffer_size = cfg.buffer.size // n_envs if not cfg.dry_run else 1
+    rb = ReplayBuffer(
+        buffer_size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=("observations",),
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    last_train = 0
+    train_step = 0
+    start_iter = state["iter_num"] + 1 if state else 1
+    policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
+    last_log = state["last_log"] if state else 0
+    last_checkpoint = state["last_checkpoint"] if state else 0
+    total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
+    learning_starts = cfg.algo.learning_starts // policy_steps_per_iter if not cfg.dry_run else 0
+    prefill_steps = learning_starts - int(learning_starts > 0)
+    if state:
+        cfg.algo.per_rank_batch_size = state["batch_size"] // trainer_world
+        learning_starts += start_iter
+        prefill_steps += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state:
+        ratio.load_state_dict(state["ratio"])
+
+    if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+    if cfg.checkpoint.every % policy_steps_per_iter != 0:
+        warnings.warn(
+            f"The checkpoint.every parameter ({cfg.checkpoint.every}) is not a multiple of the "
+            f"policy_steps_per_iter value ({policy_steps_per_iter})."
+        )
+
+    # ---- trainer role
+    trainer_state = {"params": params, "opt_states": opt_states, "update_counter": update_counter}
+
+    def trainer_step(payload):
+        batches, train_key = trainer_rt.replicate(payload)
+        new_params, new_opt, update_end, metrics = train_fn(
+            trainer_state["params"], trainer_state["opt_states"], batches, train_key,
+            trainer_state["update_counter"],
+        )
+        trainer_state["params"] = new_params
+        trainer_state["opt_states"] = new_opt
+        trainer_state["update_counter"] = update_end
+        # Only the actor goes back to the player (reference :550-554 broadcasts
+        # the actor vector)
+        player_params = jax.device_put(new_params.actor, player_rt.replicated)
+        return player_params, metrics
+
+    rng = jax.random.PRNGKey(cfg.seed)
+    mlp_keys = cfg.algo.mlp_keys.encoder
+    cumulative_grad_steps = 0
+
+    obs = envs.reset(seed=cfg.seed)[0]
+    obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
+
+    for iter_num in range(start_iter, total_iters + 1):
+            policy_step += n_envs
+
+            with timer("Time/env_interaction_time", SumMetric()):
+                if iter_num < learning_starts:
+                    actions = envs.action_space.sample()
+                else:
+                    rng, act_key = jax.random.split(rng)
+                    actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
+                next_obs, rewards, terminated, truncated, info = envs.step(
+                    actions.reshape(envs.action_space.shape)
+                )
+                next_obs_vec = np.concatenate(
+                    [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
+                )
+                real_next_obs = next_obs_vec.copy()
+                if "final_obs" in info:
+                    for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
+                        if fo is not None:
+                            real_next_obs[idx] = np.concatenate(
+                                [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
+                            )
+
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            step_data = {
+                "observations": obs_vec[np.newaxis],
+                "actions": np.asarray(actions, dtype=np.float32).reshape(1, n_envs, -1),
+                "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
+                "terminated": np.asarray(terminated, dtype=np.uint8).reshape(1, n_envs, -1),
+                "truncated": np.asarray(truncated, dtype=np.uint8).reshape(1, n_envs, -1),
+            }
+            if not cfg.buffer.sample_next_obs:
+                step_data["next_observations"] = real_next_obs[np.newaxis]
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs_vec = next_obs_vec
+
+            if iter_num >= learning_starts:
+                ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+                per_rank_gradient_steps = ratio(ratio_steps / trainer_world)
+                if per_rank_gradient_steps > 0:
+                    # The player samples and ships the batch (reference :243-257)
+                    sample = rb.sample(
+                        per_rank_gradient_steps * cfg.algo.per_rank_batch_size * trainer_world,
+                        sample_next_obs=cfg.buffer.sample_next_obs,
+                        n_samples=1,
+                    )
+                    batches = {
+                        k: np.asarray(v, dtype=np.float32).reshape(
+                            per_rank_gradient_steps,
+                            cfg.algo.per_rank_batch_size * trainer_world,
+                            *v.shape[2:],
+                        )
+                        for k, v in sample.items()
+                    }
+                    with timer("Time/train_time", SumMetric()):
+                        rng, train_key = jax.random.split(rng)
+                        player_params, train_metrics = trainer_step((batches, train_key))
+                        jax.block_until_ready(player_params)
+                        player.params = player_params
+                        cumulative_grad_steps += per_rank_gradient_steps
+                        train_step += trainer_world * per_rank_gradient_steps
+                    if aggregator:
+                        for k, v in train_metrics.items():
+                            if k in aggregator:
+                                aggregator.update(k, float(v))
+
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
+            ):
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if logger and policy_step > 0:
+                    logger.log_metrics(
+                        {"Params/replay_ratio": cumulative_grad_steps * trainer_world / policy_step},
+                        policy_step,
+                    )
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_state = {
+                    "agent": jax.device_get(trainer_state["params"]),
+                    "opt_states": jax.device_get(trainer_state["opt_states"]),
+                    "update_counter": int(trainer_state["update_counter"]),
+                    "ratio": ratio.state_dict(),
+                    "iter_num": iter_num,
+                    "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                }
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                runtime.call(
+                    "on_checkpoint_player",
+                    ckpt_path=ckpt_path,
+                    state=ckpt_state,
+                    replay_buffer=rb if cfg.buffer.checkpoint else None,
+                )
+
+    envs.close()
+    if runtime.is_global_zero and cfg.algo.run_test:
+        test(player, player_rt, cfg, log_dir)
+    if logger:
+        logger.finalize()

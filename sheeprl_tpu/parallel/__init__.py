@@ -1,0 +1,8 @@
+"""Parallelism strategies beyond plain in-graph data parallelism.
+
+- ``decoupled``: the actor-learner device split + host-side pipe (the TPU-native
+  replacement of the reference's rank-0-player / DDP-trainers topology,
+  sheeprl/algos/ppo/ppo_decoupled.py:623-670).
+"""
+
+from sheeprl_tpu.parallel.decoupled import split_runtime  # noqa: F401

@@ -1,0 +1,55 @@
+"""Decoupled actor-learner runtime split (reference
+sheeprl/algos/ppo/ppo_decoupled.py:623-670 and sac/sac_decoupled.py:548-588).
+
+The reference dedicates rank-0 as the env-stepping *player* and ranks 1..N-1 as
+DDP *trainers*, joined by torch.distributed object collectives
+(``scatter_object_list`` for rollout chunks, tensor ``broadcast`` for the
+parameter refresh). JAX is single-controller SPMD, so the TPU-native shape is a
+DEVICE split rather than a process split:
+
+- ``split_runtime`` carves the device set into a 1-device PLAYER mesh (the
+  policy forward runs on its own chip, uncontended by training) and an
+  (N-1)-device TRAINER mesh (the jitted train step data-shards its batch over
+  it; XLA inserts the gradient all-reduce over ICI — the DDP sub-group
+  ``optimization_pg`` of the reference).
+- The reference's scatter -> train -> broadcast cycle is synchronous, so on a
+  single controller it is a plain function call: the player hands the payload
+  to the trainer step and receives the refreshed parameters back as a direct
+  device-to-device ``jax.device_put`` onto the player chip (no host round-trip,
+  no NCCL-style flattened-vector broadcast).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh
+
+from sheeprl_tpu.core.runtime import Runtime
+
+
+def _sub_runtime(runtime: Runtime, devices: Sequence[Any], axes: Tuple[str, ...] = ("data",)) -> Runtime:
+    """A shallow copy of ``runtime`` whose mesh spans exactly ``devices``."""
+    rt = copy.copy(runtime)
+    rt._devices = list(devices)
+    rt.devices = len(devices)
+    shape = (len(devices),) + (1,) * (len(axes) - 1)
+    rt.mesh = Mesh(np.asarray(devices).reshape(shape), axes)
+    return rt
+
+
+def split_runtime(runtime: Runtime) -> Tuple[Runtime, Runtime]:
+    """(player_runtime, trainer_runtime): device 0 acts, devices 1..N-1 train.
+
+    Mirrors the reference's role split (player = rank 0, trainers = the
+    ``optimization_pg`` sub-group, ppo_decoupled.py:654-666). Requires >= 2
+    devices — the same constraint the reference enforces in ``check_configs``.
+    """
+    devices = list(runtime._devices)
+    if len(devices) < 2:
+        raise RuntimeError(
+            f"The decoupled actor-learner split requires at least 2 devices, got {len(devices)}"
+        )
+    return _sub_runtime(runtime, devices[:1]), _sub_runtime(runtime, devices[1:])

@@ -525,3 +525,81 @@ def test_dream_and_ponder(standard_args, env_id, tmp_path, monkeypatch):
         "env.num_envs=1",
     ]
     _run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_ppo_decoupled(standard_args, env_id, tmp_path, monkeypatch):
+    """Player on device 0, trainers on the rest of the CPU mesh (reference
+    tests run the decoupled algos with LT_DEVICES=2 over Gloo)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        f"env.id={env_id}",
+        "fabric.devices=3",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+        "checkpoint.save_last=True",
+    ]
+    _run(args)
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) >= 1
+
+
+def test_ppo_decoupled_rejects_single_device(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=1",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+    ]
+    with pytest.raises(RuntimeError, match="requires at least 2 devices"):
+        _run(args)
+
+
+def test_sac_decoupled(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=2",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+def test_sac_decoupled_rejects_single_device(standard_args, tmp_path, monkeypatch):
+    """Reference parity: decoupled SAC must refuse to run on one device
+    (reference tests/test_algos/test_algos.py test_sac_decoupled)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=1",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+    ]
+    with pytest.raises(RuntimeError, match="requires at least 2 devices"):
+        _run(args)
