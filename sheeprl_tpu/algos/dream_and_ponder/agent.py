@@ -36,7 +36,8 @@ class PlayerDAP(PlayerDV3):
     decisions are explicit Bernoulli draws keyed off the step PRNG.
     """
 
-    def _actor_step(self, actor_params, latent, key, greedy: bool = False):
+    def _actor_step(self, actor_params, latent, key, greedy: bool = False, mask=None):
+        del mask  # no masked (MineDojo) variant of the ponder actor (reference agent.py:1006-1024)
         k_halt, k_act = jax.random.split(key)
         pre_dist, _ = self.actor.apply(actor_params, latent, k_halt, method=PonderActor.ponder_infer)
         out = ActorOutput(self.actor, pre_dist)

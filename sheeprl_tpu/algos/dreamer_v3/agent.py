@@ -447,6 +447,59 @@ class Actor(nn.Module):
         ]
 
 
+class MinedojoActor(Actor):
+    """DV3 actor for MineDojo (reference agent.py:848-934): same parameters as
+    `Actor`, but rollout-time sampling applies the env-provided action masks —
+    see `sample_minedojo_actions`. Selected via ``cfg.algo.actor.cls``."""
+
+
+def sample_minedojo_actions(
+    actor: Actor,
+    pre_dist: List[jax.Array],
+    mask: Optional[Dict[str, jax.Array]],
+    key: jax.Array,
+    greedy: bool = False,
+) -> List[jax.Array]:
+    """Sequential masked sampling over MineDojo's three action heads
+    (reference MinedojoActor.forward, agent.py:883-934).
+
+    Head 0 (action type) is masked by ``mask_action_type``; head 1 (craft
+    target) is masked by ``mask_craft_smelt`` only when the sampled macro is 15
+    (craft); head 2 (equip/place/destroy target) is masked by
+    ``mask_equip_place`` for macros 16/17 and ``mask_destroy`` for macro 18.
+    The reference loops over every [t, b] element in Python; here the
+    conditional masking is a batched `jnp.where` on the logits.
+    """
+    if mask is None:
+        return ActorOutput(actor, pre_dist).sample_actions(key, greedy=greedy)
+
+    def masked(logits, m):
+        m = jnp.broadcast_to(jnp.asarray(m, dtype=bool), logits.shape)
+        return jnp.where(m, logits, -jnp.inf)
+
+    keys = jax.random.split(key, len(pre_dist))
+    actions: List[jax.Array] = []
+    functional_action = None
+    for i, logits in enumerate(pre_dist):
+        logits = uniform_mix(logits, logits.shape[-1], actor.unimix)
+        if i == 0:
+            logits = masked(logits, mask["mask_action_type"])
+        elif i == 1:
+            craft_masked = masked(logits, mask["mask_craft_smelt"])
+            logits = jnp.where((functional_action == 15)[..., None], craft_masked, logits)
+        elif i == 2:
+            equip_masked = masked(logits, mask["mask_equip_place"])
+            destroy_masked = masked(logits, mask["mask_destroy"])
+            is_equip_place = ((functional_action == 16) | (functional_action == 17))[..., None]
+            logits = jnp.where(is_equip_place, equip_masked, logits)
+            logits = jnp.where((functional_action == 18)[..., None], destroy_masked, logits)
+        dist = OneHotCategoricalStraightThrough(logits=logits)
+        actions.append(dist.mode if greedy else dist.rsample(keys[i]))
+        if functional_action is None:
+            functional_action = actions[0].argmax(axis=-1)
+    return actions
+
+
 class ActorOutput:
     """Distribution wrapper over the actor's raw head outputs.
 
@@ -684,13 +737,16 @@ class PlayerDV3:
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
 
-    def _actor_step(self, actor_params, latent, key, greedy: bool = False):
+    def _actor_step(self, actor_params, latent, key, greedy: bool = False, mask=None):
         """Sample actions from the latent; subclasses override to change how the
-        actor is queried (e.g. PonderNet inference-mode halting in PlayerDAP)."""
-        out = ActorOutput(self.actor, self.actor.apply(actor_params, latent))
-        return out.sample_actions(key, greedy=greedy)
+        actor is queried (e.g. PonderNet inference-mode halting in PlayerDAP).
+        The mask only matters for the MinedojoActor (reference agent.py:710-744)."""
+        pre_dist = self.actor.apply(actor_params, latent)
+        if isinstance(self.actor, MinedojoActor):
+            return sample_minedojo_actions(self.actor, pre_dist, mask, key, greedy=greedy)
+        return ActorOutput(self.actor, pre_dist).sample_actions(key, greedy=greedy)
 
-    def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False):
+    def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False, mask=None):
         recurrent_state, stochastic_state, actions = state
         k_rep, k_act = jax.random.split(key)
         embedded = self.encoder.apply(wm_params["encoder"], obs)
@@ -701,7 +757,7 @@ class PlayerDV3:
             _, stoch = self.rssm._representation(wm_params, embedded, k_rep, recurrent_state=recurrent_state)
         stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
         latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
-        actions_list = self._actor_step(actor_params, latent, k_act, greedy=greedy)
+        actions_list = self._actor_step(actor_params, latent, k_act, greedy=greedy, mask=mask)
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 
@@ -723,8 +779,11 @@ class PlayerDV3:
             )
 
     def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
-        del mask  # action masking only used by MinedojoActor
-        actions_list, self.state = self._step(self.wm_params, self.actor_params, self.state, obs, key, greedy=greedy)
+        if not isinstance(self.actor, MinedojoActor):
+            mask = None  # action masking only used by MinedojoActor
+        actions_list, self.state = self._step(
+            self.wm_params, self.actor_params, self.state, obs, key, greedy=greedy, mask=mask
+        )
         return actions_list
 
 
@@ -930,7 +989,10 @@ def build_agent(
     )
 
     actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
-    actor = None if not build_actor else Actor(
+    # Config-selected actor class (reference uses hydra.utils.get_class on
+    # cfg.algo.actor.cls, agent.py:1184): MinedojoActor adds rollout-time masking
+    actor_cls = MinedojoActor if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else Actor
+    actor = None if not build_actor else actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,

@@ -115,7 +115,8 @@ def test(player, runtime, cfg, log_dir: str, test_name: str = "", greedy: bool =
     while not done:
         key, step_key = jax.random.split(key)
         jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
-        actions_list = player.get_actions(jax_obs, step_key, greedy=greedy)
+        mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+        actions_list = player.get_actions(jax_obs, step_key, greedy=greedy, mask=mask)
         if player.actor.is_continuous:
             real_actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
         else:

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from sheeprl_tpu.algos.dreamer_v3.agent import (
     Actor as DV3Actor,
     DV3Modules,
+    MinedojoActor as DV3MinedojoActor,
     MLPWithHead,
     MultiDecoderDV3,
     MultiEncoderDV3,
@@ -39,6 +40,7 @@ from sheeprl_tpu.algos.p2e_dv1.agent import Ensembles
 
 # Exposed for config-driven class selection (reference p2e_dv3/agent.py:23-24).
 Actor = DV3Actor
+MinedojoActor = DV3MinedojoActor
 
 
 class P2EDV3Modules(NamedTuple):
@@ -119,7 +121,8 @@ def build_agent(
     player.actor_type = cfg.algo.player.actor_type
 
     actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
-    actor_exploration = Actor(
+    expl_actor_cls = MinedojoActor if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else Actor
+    actor_exploration = expl_actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,

@@ -1,0 +1,79 @@
+"""Super Mario Bros adapter (reference sheeprl/envs/super_mario_bros.py:26-70).
+
+Wraps gym-super-mario-bros (old-gym API + nes-py joypad) into the framework
+contract: ``{"rgb": ...}`` Dict observations, Discrete actions from a named
+movement set, and a terminated/truncated split keyed on the in-game timer.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.utils.imports import _IS_SUPER_MARIO_AVAILABLE
+
+if not _IS_SUPER_MARIO_AVAILABLE:
+    raise ModuleNotFoundError(
+        "gym_super_mario_bros is not installed; install it to use the Super Mario Bros environments"
+    )
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import gym_super_mario_bros as gsmb
+import gymnasium as gym
+import numpy as np
+from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
+from nes_py.wrappers import JoypadSpace
+
+ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
+
+
+class _JoypadSpaceNewReset(JoypadSpace):
+    """nes-py's JoypadSpace swallows reset kwargs; forward them (reference :22-24)."""
+
+    def reset(self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None):
+        return self.env.reset(seed=seed, options=options)
+
+
+class SuperMarioBrosWrapper(gym.Wrapper):
+    def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
+        if action_space not in ACTIONS_SPACE_MAP:
+            raise ValueError(
+                f"Unknown movement set '{action_space}'; valid sets: {sorted(ACTIONS_SPACE_MAP)}"
+            )
+        env = _JoypadSpaceNewReset(gsmb.make(id), ACTIONS_SPACE_MAP[action_space])
+        super().__init__(env)
+        self._render_mode = render_mode
+        inner = env.observation_space
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
+        )
+        self.action_space = gym.spaces.Discrete(env.action_space.n)
+
+    @property
+    def render_mode(self) -> str:
+        return self._render_mode
+
+    @render_mode.setter
+    def render_mode(self, render_mode: str):
+        self._render_mode = render_mode
+
+    def step(self, action: Union[np.ndarray, int]) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
+        if isinstance(action, np.ndarray):
+            action = action.squeeze().item()
+        obs, reward, done, info = self.env.step(action)
+        # `done` with time still on the clock is a real death; with the timer
+        # expired (info["time"] == 0) it's a time-limit truncation. (The
+        # reference tests the raw truthiness of info["time"],
+        # super_mario_bros.py:59-60, which inverts the split.)
+        is_timelimit = info.get("time", 1) == 0
+        return {"rgb": obs.copy()}, reward, done and not is_timelimit, done and is_timelimit, info
+
+    def render(self):
+        frame = self.env.render(mode=self.render_mode)
+        if self.render_mode == "rgb_array" and frame is not None:
+            return frame.copy()
+        return None
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Any, Dict[str, Any]]:
+        obs = self.env.reset(seed=seed, options=options)
+        return {"rgb": obs.copy()}, {}

@@ -1,0 +1,143 @@
+"""Env wrapper unit tests (reference tests/test_envs: dilated FrameStack,
+actions-as-obs, RestartOnException)."""
+
+import numpy as np
+import gymnasium as gym
+import pytest
+
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+
+
+class _CountingEnv(gym.Env):
+    """Dict obs {rgb, step}: rgb filled with the step counter."""
+
+    def __init__(self, episode_len: int = 100):
+        self.observation_space = gym.spaces.Dict(
+            {
+                "rgb": gym.spaces.Box(0, 255, (3, 4, 4), np.uint8),
+                "state": gym.spaces.Box(-np.inf, np.inf, (2,), np.float32),
+            }
+        )
+        self.action_space = gym.spaces.Discrete(3)
+        self._t = 0
+        self._episode_len = episode_len
+        self.reward_range = (0.0, 1.0)
+
+    def _obs(self):
+        return {
+            "rgb": np.full((3, 4, 4), self._t % 256, dtype=np.uint8),
+            "state": np.array([self._t, 0], dtype=np.float32),
+        }
+
+    def step(self, action):
+        self._t += 1
+        return self._obs(), 1.0, self._t >= self._episode_len, False, {}
+
+    def reset(self, *, seed=None, options=None):
+        self._t = 0
+        return self._obs(), {}
+
+
+def test_action_repeat_sums_rewards():
+    env = ActionRepeat(_CountingEnv(), amount=4)
+    env.reset()
+    _, reward, _, _, _ = env.step(0)
+    assert reward == 4.0
+    assert env.unwrapped._t == 4
+
+
+def test_action_repeat_stops_at_done():
+    env = ActionRepeat(_CountingEnv(episode_len=2), amount=5)
+    env.reset()
+    _, reward, terminated, _, _ = env.step(0)
+    assert reward == 2.0 and terminated
+
+
+def test_frame_stack_shapes_and_reset_fill():
+    env = FrameStack(_CountingEnv(), num_stack=3, cnn_keys=["rgb"])
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (3, 3, 4, 4)
+    # reset fills the deque with copies of the first frame
+    assert (obs["rgb"] == obs["rgb"][0]).all()
+    obs, *_ = env.step(0)
+    assert obs["rgb"][-1].max() == 1  # newest frame is step 1
+
+
+def test_frame_stack_dilation_picks_every_dth():
+    env = FrameStack(_CountingEnv(), num_stack=2, cnn_keys=["rgb"], dilation=2)
+    env.reset()
+    for _ in range(4):
+        obs, *_ = env.step(0)
+    # window holds frames [1,2,3,4]; dilation 2 picks [2, 4]
+    assert obs["rgb"][0].max() == 2 and obs["rgb"][1].max() == 4
+
+
+def test_frame_stack_rejects_zero_stack():
+    with pytest.raises(ValueError):
+        FrameStack(_CountingEnv(), num_stack=0, cnn_keys=["rgb"])
+
+
+class _FlakyEnv(_CountingEnv):
+    """Raises once on the first step after construction."""
+
+    crashes = 0
+
+    def step(self, action):
+        if type(self).crashes < 1:
+            type(self).crashes += 1
+            raise RuntimeError("boom")
+        return super().step(action)
+
+
+def test_restart_on_exception_rebuilds_and_flags():
+    _FlakyEnv.crashes = 0
+    env = RestartOnException(lambda: _FlakyEnv(), wait=0.0)
+    env.reset()
+    obs, reward, terminated, truncated, info = env.step(0)
+    assert info.get("restart_on_exception") is True
+    assert reward == 0.0 and not terminated and not truncated
+    # the rebuilt env works normally afterwards
+    _, reward, _, _, info = env.step(0)
+    assert reward == 1.0 and "restart_on_exception" not in info
+
+
+def test_restart_on_exception_gives_up_after_maxfails():
+    class AlwaysBroken(_CountingEnv):
+        def step(self, action):
+            raise RuntimeError("always")
+
+    env = RestartOnException(lambda: AlwaysBroken(), maxfails=2, wait=0.0)
+    env.reset()
+    env.step(0)
+    env.step(0)
+    with pytest.raises(RuntimeError, match="crashed too many times"):
+        env.step(0)
+
+
+def test_reward_as_observation():
+    env = RewardAsObservationWrapper(_CountingEnv())
+    obs, _ = env.reset()
+    assert obs["reward"] == np.float32(0.0)
+    obs, *_ = env.step(0)
+    assert obs["reward"] == np.float32(1.0)
+    assert "reward" in env.observation_space.spaces
+
+
+def test_actions_as_observation_discrete_one_hot():
+    env = ActionsAsObservationWrapper(_CountingEnv(), num_stack=2, noop=0)
+    obs, _ = env.reset()
+    assert obs["action_stack"].shape == (6,)  # 2 stacked one-hots of dim 3
+    np.testing.assert_allclose(obs["action_stack"], [1, 0, 0, 1, 0, 0])
+    obs, *_ = env.step(2)
+    np.testing.assert_allclose(obs["action_stack"], [1, 0, 0, 0, 0, 1])
+
+
+def test_actions_as_observation_rejects_bad_noop():
+    with pytest.raises(ValueError):
+        ActionsAsObservationWrapper(_CountingEnv(), num_stack=2, noop=[0, 1])
