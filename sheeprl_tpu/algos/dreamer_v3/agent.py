@@ -681,6 +681,10 @@ class RSSM:
             _, (recurrent_states, priors_logits) = jax.lax.scan(
                 step, init_rec, (prev_posts, actions, is_first, keys)
             )
+            # logits leave flat [T,B,S*D]; expose factorized [T,B,S,D] (the shape the
+            # KL-balance loss and entropy metrics expect, reference loss.py:45-70)
+            priors_logits = priors_logits.reshape(T, B, self.stochastic_size, self.discrete_size)
+            posteriors_logits = posteriors_logits.reshape(T, B, self.stochastic_size, self.discrete_size)
             return recurrent_states, posteriors, priors_logits, posteriors_logits
 
         def step(carry, xs):
@@ -695,6 +699,10 @@ class RSSM:
         _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
             step, (init_rec, init_post), (actions, embedded_obs, is_first, keys)
         )
+        # factorized logits [T,B,S,D]: categorical_kl and the entropy metrics softmax
+        # per-categorical over D, not over the flat S*D vector
+        priors_logits = priors_logits.reshape(T, B, self.stochastic_size, self.discrete_size)
+        posteriors_logits = posteriors_logits.reshape(T, B, self.stochastic_size, self.discrete_size)
         return recurrent_states, posteriors, priors_logits, posteriors_logits
 
     def imagination_step(self, wm_params, prior_flat: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key):

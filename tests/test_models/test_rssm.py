@@ -1,0 +1,90 @@
+"""DreamerV3 RSSM unit tests.
+
+Regression focus: `dynamic_scan` must return *factorized* prior/posterior logits
+``[T, B, stoch, discrete]`` — the KL-balance loss softmaxes per categorical over the
+discrete dim (reference sheeprl/algos/dreamer_v3/loss.py via
+torch.distributions.Independent(OneHotCategorical)); flat ``[T, B, S*D]`` logits
+would silently compute one big softmax and reduce over the batch axis too
+(only broadcastable — hence undetected — at T==1).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.algos.dreamer_v3.agent import MLPWithHead, RSSM, RecurrentModel
+from sheeprl_tpu.algos.dreamer_v3.loss import categorical_kl, reconstruction_loss
+
+KEY = jax.random.PRNGKey(0)
+
+S, D, R, E, A = 3, 4, 8, 6, 2
+
+
+def _make_rssm(decoupled: bool = False):
+    rec = RecurrentModel(input_size=S * D + A, recurrent_state_size=R, dense_units=8)
+    repr_in = E if decoupled else R + E
+    repr_m = MLPWithHead(input_dim=repr_in, hidden_sizes=[8], output_dim=S * D)
+    trans = MLPWithHead(input_dim=R, hidden_sizes=[8], output_dim=S * D)
+    rssm = RSSM(rec, repr_m, trans, stochastic_size=S, discrete_size=D, decoupled=decoupled)
+    wm_params = {
+        "recurrent_model": rec.init(KEY, jnp.zeros((1, S * D + A)), jnp.zeros((1, R))),
+        "representation_model": repr_m.init(KEY, jnp.zeros((1, repr_in))),
+        "transition_model": trans.init(KEY, jnp.zeros((1, R))),
+        "initial_recurrent_state": jnp.zeros((R,), dtype=jnp.float32),
+    }
+    return rssm, wm_params
+
+
+@pytest.mark.parametrize("decoupled", [False, True])
+def test_dynamic_scan_returns_factorized_logits(decoupled):
+    rssm, wm_params = _make_rssm(decoupled)
+    T, B = 5, 3
+    embedded = jax.random.normal(jax.random.PRNGKey(1), (T, B, E))
+    actions = jnp.zeros((T, B, A))
+    is_first = jnp.zeros((T, B, 1)).at[0].set(1.0)
+    rec_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
+        wm_params, embedded, actions, is_first, KEY
+    )
+    assert rec_states.shape == (T, B, R)
+    assert posteriors.shape == (T, B, S, D)
+    assert priors_logits.shape == (T, B, S, D)
+    assert posteriors_logits.shape == (T, B, S, D)
+    # KL must stay per-element [T, B] for T > 1 (the T==1 broadcast masked this)
+    kl = categorical_kl(posteriors_logits, priors_logits)
+    assert kl.shape == (T, B)
+    assert bool(jnp.all(kl >= -1e-6))
+
+
+def test_reconstruction_loss_elementwise_at_t_gt_1():
+    rssm, wm_params = _make_rssm()
+    T, B = 4, 2
+    embedded = jax.random.normal(jax.random.PRNGKey(2), (T, B, E))
+    actions = jnp.zeros((T, B, A))
+    is_first = jnp.zeros((T, B, 1)).at[0].set(1.0)
+    _, _, priors_logits, posteriors_logits = rssm.dynamic_scan(
+        wm_params, embedded, actions, is_first, KEY
+    )
+    po = {"state": jnp.zeros((T, B))}
+    loss, kl, state_loss, reward_loss, obs_loss, cont_loss = reconstruction_loss(
+        po,
+        jnp.zeros((T, B)),
+        priors_logits,
+        posteriors_logits,
+        pc_log_prob=jnp.zeros((T, B)),
+    )
+    for v in (loss, kl, state_loss, reward_loss, obs_loss, cont_loss):
+        assert v.shape == ()
+    assert jnp.isfinite(loss)
+
+
+def test_imagination_step_shapes():
+    rssm, wm_params = _make_rssm()
+    B = 6
+    prior_flat = jnp.zeros((B, S * D))
+    rec_state = jnp.zeros((B, R))
+    act = jnp.zeros((B, A))
+    prior, rec = rssm.imagination_step(wm_params, prior_flat, rec_state, act, KEY)
+    assert prior.shape == (B, S * D)
+    assert rec.shape == (B, R)
+    # one-hot per categorical
+    assert jnp.allclose(prior.reshape(B, S, D).sum(-1), 1.0)
