@@ -1,0 +1,34 @@
+"""Base class for adapters over non-gymnasium ("old gym") environments.
+
+gymnasium 1.x's ``gym.Wrapper`` asserts the wrapped object is a gymnasium.Env,
+but several third-party envs (crafter, nes-py/gym-super-mario-bros, minedojo,
+minerl, dm_control) expose old-gym or bespoke APIs. Adapters therefore subclass
+this standalone ``gym.Env`` and hold the inner env as ``self.env`` (same pattern
+the reference applies ad hoc, e.g. sheeprl/envs/dmc.py:49).
+"""
+
+from __future__ import annotations
+
+import gymnasium as gym
+
+
+class OldGymEnvAdapter(gym.Env):
+    """Standalone gymnasium.Env delegating unknown attributes to ``self.env``.
+
+    Subclasses must assign ``self.env`` in ``__init__`` (first, so that failed
+    construction surfaces as AttributeError rather than recursion) and implement
+    ``step``/``reset`` translating the inner env's conventions.
+    """
+
+    env = None  # replaced per-instance; class default keeps __getattr__ safe
+
+    def __getattr__(self, name: str):
+        # only called when normal lookup fails; guard private names and "env"
+        # itself so a partially-constructed instance raises instead of recursing
+        if name.startswith("_") or name == "env":
+            raise AttributeError(name)
+        return getattr(self.env, name)
+
+    def close(self) -> None:
+        if self.env is not None and hasattr(self.env, "close"):
+            self.env.close()

@@ -22,18 +22,21 @@ import gymnasium as gym
 import numpy as np
 from gymnasium import spaces
 
+from sheeprl_tpu.envs.adapter import OldGymEnvAdapter
+
 _VALID_IDS = ("crafter_reward", "crafter_nonreward")
 
 
-class CrafterWrapper(gym.Wrapper):
+class CrafterWrapper(OldGymEnvAdapter):
+    """crafter.Env is a plain old-gym-style class; see OldGymEnvAdapter."""
+
     def __init__(self, id: str, screen_size: Union[Sequence[int], int], seed: Optional[int] = None) -> None:
         if id not in _VALID_IDS:
             raise ValueError(f"Unknown crafter id '{id}'; valid ids: {_VALID_IDS}")
         if isinstance(screen_size, int):
             screen_size = (screen_size, screen_size)
 
-        env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
-        super().__init__(env)
+        self.env = crafter.Env(size=tuple(screen_size), seed=seed, reward=(id == "crafter_reward"))
         inner = self.env.observation_space
         self.observation_space = spaces.Dict(
             {"rgb": spaces.Box(inner.low, inner.high, inner.shape, inner.dtype)}
@@ -43,7 +46,7 @@ class CrafterWrapper(gym.Wrapper):
         self.observation_space.seed(seed)
         self.action_space.seed(seed)
         self._render_mode = "rgb_array"
-        self._metadata = {"render_fps": 30}
+        self.metadata = {"render_modes": ["rgb_array"], "render_fps": 30}
 
     @property
     def render_mode(self) -> Optional[str]:

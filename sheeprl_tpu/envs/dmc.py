@@ -25,6 +25,8 @@ from dm_control import suite
 from dm_env import specs
 from gymnasium import spaces
 
+from sheeprl_tpu.envs.adapter import OldGymEnvAdapter
+
 
 def _spec_to_box(spec, dtype) -> spaces.Box:
     """Concatenate dm_env array specs into one flat Box (reference dmc.py:17-38)."""
@@ -51,12 +53,12 @@ def _flatten_obs(obs: Dict[Any, Any]) -> np.ndarray:
     return np.concatenate(pieces, axis=0)
 
 
-class DMCWrapper(gym.Env):
+class DMCWrapper(OldGymEnvAdapter):
     """dm_control suite task as a gymnasium env (reference dmc.py:49-244).
 
     The reference subclasses gym.Wrapper directly over the dm_control env;
-    gymnasium 1.x asserts the wrapped object is a gymnasium.Env, so here the
-    dm_control env is held as ``self.env`` on a plain gym.Env subclass.
+    gymnasium 1.x asserts the wrapped object is a gymnasium.Env, so the
+    dm_control env is held as ``self.env`` (see OldGymEnvAdapter).
     """
 
     def __init__(
@@ -117,11 +119,6 @@ class DMCWrapper(gym.Env):
         self._render_mode = "rgb_array"
         self._metadata = {}
         self.seed(seed=seed)
-
-    def __getattr__(self, name):
-        if name.startswith("_"):
-            raise AttributeError(name)
-        return getattr(self.env, name)
 
     @property
     def observation_space(self) -> spaces.Dict:

@@ -28,6 +28,8 @@ import minedojo.tasks
 import numpy as np
 from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS
 
+from sheeprl_tpu.envs.adapter import OldGymEnvAdapter
+
 N_ALL_ITEMS = len(ALL_ITEMS)
 ITEM_ID_TO_NAME = dict(enumerate(ALL_ITEMS))
 ITEM_NAME_TO_ID = {name: i for i, name in enumerate(ALL_ITEMS)}
@@ -65,7 +67,9 @@ def _canon(item: str) -> str:
     return "_".join(item.split(" "))
 
 
-class MineDojoWrapper(gym.Wrapper):
+class MineDojoWrapper(OldGymEnvAdapter):
+    """minedojo.make returns an old-gym object; see OldGymEnvAdapter."""
+
     def __init__(
         self,
         id: str,
@@ -103,7 +107,7 @@ class MineDojoWrapper(gym.Wrapper):
             break_speed_multiplier=self._break_speed_multiplier,
             **kwargs,
         )
-        super().__init__(env)
+        self.env = env
         self._inventory: Dict[str, list] = {}
         self._inventory_names: Optional[np.ndarray] = None
         self._inventory_max = np.zeros(N_ALL_ITEMS)
@@ -134,8 +138,6 @@ class MineDojoWrapper(gym.Wrapper):
     def render_mode(self) -> Optional[str]:
         return self._render_mode
 
-    def __getattr__(self, name):
-        return getattr(self.env, name)
 
     # ----- observation conversion ----------------------------------------------------
     def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
@@ -291,8 +293,9 @@ class MineDojoWrapper(gym.Wrapper):
 
     def render(self):
         if self.render_mode == "human":
-            return super().render()
+            return self.env.render()
         if self.render_mode == "rgb_array":
             prev = self.env.unwrapped._prev_obs
             return None if prev is None else prev["rgb"]
         return None
+

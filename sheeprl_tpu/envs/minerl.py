@@ -24,6 +24,7 @@ import minerl
 import numpy as np
 from minerl.herobraine.hero import mc
 
+from sheeprl_tpu.envs.adapter import OldGymEnvAdapter
 from sheeprl_tpu.envs.minerl_envs.navigate import CustomNavigate
 from sheeprl_tpu.envs.minerl_envs.obtain import CustomObtainDiamond, CustomObtainIronPickaxe
 
@@ -60,8 +61,10 @@ _CAMERA_DELTAS = (
 )
 
 
-class MineRLWrapper(gym.Wrapper):
+class MineRLWrapper(OldGymEnvAdapter):
     """Custom MineRL task as a gymnasium env (reference minerl.py:48-322).
+
+    MineRL's ``.make()`` returns an old-gym object; see OldGymEnvAdapter.
 
     Args:
         id: one of ``custom_navigate``, ``custom_obtain_diamond``,
@@ -101,7 +104,7 @@ class MineRLWrapper(gym.Wrapper):
             kwargs.pop("extreme", None)
 
         env = CUSTOM_ENVS[id.lower()](break_speed=break_speed_multiplier, **kwargs).make()
-        super().__init__(env)
+        self.env = env
 
         # Flatten the dict action space: index 0 is noop, then one discrete index
         # per option of every actionable (enum values, binary keys, and the four
@@ -162,8 +165,6 @@ class MineRLWrapper(gym.Wrapper):
     def render_mode(self) -> Optional[str]:
         return self._render_mode
 
-    def __getattr__(self, name):
-        return getattr(self.env, name)
 
     def _convert_actions(self, action: np.ndarray) -> Dict[str, Any]:
         converted = copy.deepcopy(NOOP)

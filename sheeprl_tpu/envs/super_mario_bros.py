@@ -22,6 +22,8 @@ import numpy as np
 from gym_super_mario_bros.actions import COMPLEX_MOVEMENT, RIGHT_ONLY, SIMPLE_MOVEMENT
 from nes_py.wrappers import JoypadSpace
 
+from sheeprl_tpu.envs.adapter import OldGymEnvAdapter
+
 ACTIONS_SPACE_MAP = {"simple": SIMPLE_MOVEMENT, "right_only": RIGHT_ONLY, "complex": COMPLEX_MOVEMENT}
 
 
@@ -32,14 +34,16 @@ class _JoypadSpaceNewReset(JoypadSpace):
         return self.env.reset(seed=seed, options=options)
 
 
-class SuperMarioBrosWrapper(gym.Wrapper):
+class SuperMarioBrosWrapper(OldGymEnvAdapter):
+    """nes-py/gym-super-mario-bros envs are old-gym objects; see OldGymEnvAdapter."""
+
     def __init__(self, id: str, action_space: str = "simple", render_mode: str = "rgb_array"):
         if action_space not in ACTIONS_SPACE_MAP:
             raise ValueError(
                 f"Unknown movement set '{action_space}'; valid sets: {sorted(ACTIONS_SPACE_MAP)}"
             )
         env = _JoypadSpaceNewReset(gsmb.make(id), ACTIONS_SPACE_MAP[action_space])
-        super().__init__(env)
+        self.env = env
         self._render_mode = render_mode
         inner = env.observation_space
         self.observation_space = gym.spaces.Dict(
@@ -77,3 +81,4 @@ class SuperMarioBrosWrapper(gym.Wrapper):
     ) -> Tuple[Any, Dict[str, Any]]:
         obs = self.env.reset(seed=seed, options=options)
         return {"rgb": obs.copy()}, {}
+

@@ -54,7 +54,14 @@ def make_env(
         except Exception:
             env_spec = ""
 
-        if cfg.env.action_repeat > 1 and "atari" not in env_spec:
+        # DIAMBRA repeats in-engine (wrapper `repeat_action`, reference env.py:75-81
+        # excludes DiambraWrapper); stacking the generic wrapper would double it.
+        wrapper_target = str(wrapper_spec.get("_target_", ""))
+        if (
+            cfg.env.action_repeat > 1
+            and "atari" not in env_spec
+            and not wrapper_target.endswith("DiambraWrapper")
+        ):
             env = ActionRepeat(env, cfg.env.action_repeat)
 
         if cfg.env.get("mask_velocities", False):

@@ -40,7 +40,9 @@ def test_adapters_gate_on_missing_deps(module, flag):
 def fake_crafter(monkeypatch):
     """Minimal crafter stand-in to exercise the adapter's conversion logic."""
 
-    class FakeEnv(gym.Env):
+    class FakeEnv:  # deliberately NOT a gymnasium.Env: real crafter.Env is a
+        # plain old-gym-style class, and the adapters must cope (gymnasium 1.x
+        # gym.Wrapper would assert on it)
         def __init__(self, size=(64, 64), seed=None, reward=True):
             self.size = size
             self.reward_enabled = reward
