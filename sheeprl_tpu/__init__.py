@@ -11,3 +11,21 @@ import os
 __version__ = "0.1.0"
 
 ROOT_DIR = os.path.dirname(os.path.abspath(__file__))
+
+# Persistent XLA compilation cache: first-compile of the jitted train steps costs
+# tens of seconds on TPU; later processes reuse the compiled executables. Opt out
+# with SHEEPRL_TPU_NO_COMP_CACHE=1.
+if not os.environ.get("SHEEPRL_TPU_NO_COMP_CACHE"):
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get(
+                "SHEEPRL_TPU_COMP_CACHE_DIR",
+                os.path.join(os.path.expanduser("~"), ".cache", "sheeprl_tpu_xla"),
+            ),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass

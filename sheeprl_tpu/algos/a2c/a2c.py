@@ -31,10 +31,10 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, save_configs
+from sheeprl_tpu.utils.utils import PlayerParamsSync, gae, normalize_tensor, save_configs
 
 
-def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys):
+def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None):
     global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
     n_minibatches = max(n_data // global_bs, 1)
     data_sharding = NamedSharding(runtime.mesh, P("data"))
@@ -91,7 +91,8 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys):
         )
         updates, opt_state = tx.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, opt_state, {
+        flat_params = params_sync.ravel(params) if params_sync is not None else jnp.zeros(())
+        return params, opt_state, flat_params, {
             "Loss/policy_loss": pg_sum / n_minibatches,
             "Loss/value_loss": v_sum / n_minibatches,
         }
@@ -176,8 +177,10 @@ def main(runtime, cfg: Dict[str, Any]):
         cfg.algo.per_rank_batch_size = state["batch_size"] // world_size
     n_data = cfg.algo.rollout_steps * n_envs
 
-    train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys)
+    params_sync = PlayerParamsSync(player.params)
+    train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys, params_sync)
     rng = jax.random.PRNGKey(cfg.seed)
+    player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
 
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -190,7 +193,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time", SumMetric()):
                 jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-                cat_actions, env_actions, logprobs, values, rng = player(jax_obs, rng)
+                cat_actions, env_actions, logprobs, values, player_rng = player(jax_obs, player_rng)
                 real_actions = np.asarray(env_actions)
                 np_actions = np.asarray(cat_actions)
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -227,12 +230,15 @@ def main(runtime, cfg: Dict[str, Any]):
             local_data = {k: v[idx] for k, v in local_data.items()}
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-            next_values = player.get_values(jax_obs)
+            next_values = np.asarray(player.get_values(jax_obs))
             rng, train_key = jax.random.split(rng)
             device_data = {k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")}
-            params, opt_state, train_metrics = train_fn(params, opt_state, device_data, next_values, train_key)
-            jax.block_until_ready(params)
-            player.params = params
+            params, opt_state, flat_params, train_metrics = train_fn(
+                params, opt_state, device_data, next_values, train_key
+            )
+            player.params = params_sync.pull(flat_params, runtime.player_device)
+            if not timer.disabled:
+                jax.block_until_ready(params)
         train_step += world_size
 
         if cfg.metric.log_level > 0:

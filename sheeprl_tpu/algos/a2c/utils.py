@@ -19,8 +19,13 @@ def normalize_obs(obs, cnn_keys: Sequence[str], obs_keys: Sequence[str]):
 
 
 def prepare_obs(runtime, obs: Dict[str, np.ndarray], *, num_envs: int = 1, **kwargs) -> Dict[str, jax.Array]:
-    """A2C is vector-obs only (reference utils.py:16-21)."""
-    return {k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(num_envs, -1)) for k, v in obs.items()}
+    """A2C is vector-obs only (reference utils.py:16-21); obs land on the player device."""
+    device = runtime.player_device if runtime is not None else None
+    out = {}
+    for k, v in obs.items():
+        arr = np.asarray(v, dtype=np.float32).reshape(num_envs, -1)
+        out[k] = jax.device_put(arr, device) if device is not None else jnp.asarray(arr)
+    return out
 
 # Single-'agent' registration shared with the other model-free algos.
 from sheeprl_tpu.utils.model_manager import log_agent_from_checkpoint as log_models_from_checkpoint  # noqa: E402, F401

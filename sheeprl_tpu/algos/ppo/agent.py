@@ -317,5 +317,8 @@ def build_agent(
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
     params = runtime.replicate(params)
-    player = PPOPlayer(agent, params, actions_dim)
+    # The player's copy lives on the player device (host CPU by default): per-step
+    # policy calls then never pay the accelerator round-trip (reference's
+    # get_single_device_fabric split, sheeprl/utils/fabric.py:8-35).
+    player = PPOPlayer(agent, runtime.to_player(params), actions_dim)
     return agent, params, player

@@ -37,14 +37,22 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import (
+    PlayerParamsSync,
+    gae,
+    normalize_tensor,
+    polynomial_decay,
+    save_configs,
+)
 
 
-def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys):
+def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None):
     """Build the jitted per-iteration optimization function.
 
     Signature: (params, opt_state, data, next_values, key, coefs) ->
-    (params, opt_state, metrics). ``data`` is the whole rollout ``[T, B, ...]``.
+    (params, opt_state, flat_params, metrics). ``data`` is the whole rollout
+    ``[T, B, ...]``; ``flat_params`` is the raveled post-update param vector for the
+    one-transfer player refresh (None if no ``params_sync`` given).
     """
     update_epochs = int(cfg.algo.update_epochs)
     global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
@@ -106,7 +114,8 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys):
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
-        return params, opt_state, {
+        flat = params_sync.ravel(params) if params_sync is not None else jnp.zeros(())
+        return params, opt_state, flat, {
             "Loss/policy_loss": metrics[0],
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
@@ -249,8 +258,12 @@ def main(runtime, cfg: Dict[str, Any]):
             "the checkpoint will be saved at the nearest greater multiple of the policy_steps_per_iter value."
         )
 
-    train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys, cnn_keys)
+    params_sync = PlayerParamsSync(player.params)
+    train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys, cnn_keys, params_sync)
     rng = jax.random.PRNGKey(cfg.seed)
+    # Separate rollout key committed to the player device: the policy forward then
+    # runs entirely there (mixing committed arrays across backends is an error).
+    player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
 
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -265,7 +278,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
             with timer("Time/env_interaction_time", SumMetric()):
                 jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                cat_actions, env_actions, logprobs, values, rng = player(jax_obs, rng)
+                cat_actions, env_actions, logprobs, values, player_rng = player(jax_obs, player_rng)
                 real_actions = np.asarray(env_actions)
                 np_actions = np.asarray(cat_actions)
 
@@ -289,7 +302,10 @@ def main(runtime, cfg: Dict[str, Any]):
                                 v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
                             real_next_obs[k].append(v)
                     if valid_idx:
-                        stacked = {k: jnp.asarray(np.stack(v)) for k, v in real_next_obs.items()}
+                        stacked = {
+                            k: jax.device_put(np.stack(v), runtime.player_device)
+                            for k, v in real_next_obs.items()
+                        }
                         vals = np.asarray(player.get_values(stacked)).reshape(len(valid_idx))
                         rewards = np.asarray(rewards, dtype=np.float32)
                         rewards[valid_idx] += cfg.algo.gamma * vals
@@ -331,10 +347,12 @@ def main(runtime, cfg: Dict[str, Any]):
             local_data = {k: v[idx] for k, v in local_data.items()}
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-            next_values = player.get_values(jax_obs)
+            # bootstrap values come from the player device; re-enter the mesh
+            # uncommitted so the jitted train step can place them freely
+            next_values = np.asarray(player.get_values(jax_obs))
             rng, train_key = jax.random.split(rng)
             device_data = {k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")}
-            params, opt_state, train_metrics = train_fn(
+            params, opt_state, flat_params, train_metrics = train_fn(
                 params,
                 opt_state,
                 device_data,
@@ -343,8 +361,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
-            jax.block_until_ready(params)
-            player.params = params
+            # refresh the player's copy with ONE cross-backend transfer; the next
+            # rollout implicitly waits for (only) the params it needs
+            player.params = params_sync.pull(flat_params, runtime.player_device)
+            if not timer.disabled:  # sync only when the train phase is being timed
+                jax.block_until_ready(params)
         train_step += world_size
 
         if cfg.metric.log_level > 0:

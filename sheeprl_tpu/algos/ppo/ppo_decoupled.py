@@ -176,7 +176,7 @@ def main(runtime, cfg: Dict[str, Any]):
         # DistributedSampler over the scattered chunks); the per-minibatch
         # sharding constraint inside train_fn splits work across trainers.
         device_data, next_values, train_key, clip_coef, ent_coef = trainer_rt.replicate(payload)
-        new_params, new_opt, metrics = train_fn(
+        new_params, new_opt, _flat, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
             clip_coef, ent_coef,
         )

@@ -39,6 +39,7 @@ def prepare_obs(
 ) -> Dict[str, jax.Array]:
     """Host obs dict -> normalized device arrays [num_envs, ...]; frame-stacked cnn
     keys collapse the stack into channels (reference utils.py:25-36)."""
+    device = runtime.player_device if runtime is not None else None
     out = {}
     for k, v in obs.items():
         arr = np.asarray(v, dtype=np.float32)
@@ -47,7 +48,7 @@ def prepare_obs(
             arr = arr / 255.0 - 0.5
         else:
             arr = arr.reshape(num_envs, -1)
-        out[k] = jnp.asarray(arr)
+        out[k] = jax.device_put(arr, device) if device is not None else jnp.asarray(arr)
     return out
 
 

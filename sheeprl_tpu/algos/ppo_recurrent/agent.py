@@ -244,5 +244,7 @@ def build_agent(
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
     params = runtime.replicate(params)
-    player = RecurrentPPOPlayer(agent, params, actions_dim, n_envs)
+    # player copy lives on the player device (host CPU by default): no accelerator
+    # round-trip per env step (see sheeprl_tpu.core.runtime.Runtime.player_device)
+    player = RecurrentPPOPlayer(agent, runtime.to_player(params), actions_dim, n_envs)
     return agent, params, player

@@ -31,14 +31,14 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import PlayerParamsSync, gae, polynomial_decay, save_configs
 
 
 def _masked_mean(x: jax.Array, mask: jax.Array) -> jax.Array:
     return (x * mask).sum() / jnp.clip(mask.sum(), 1, None)
 
 
-def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys):
+def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None):
     update_epochs = int(cfg.algo.update_epochs)
     n_batches = max(int(cfg.algo.per_rank_num_batches), 1)
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
@@ -102,7 +102,8 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys):
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
-        return params, opt_state, {
+        flat_params = params_sync.ravel(params) if params_sync is not None else jnp.zeros(())
+        return params, opt_state, flat_params, {
             "Loss/policy_loss": metrics[0],
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
@@ -235,8 +236,10 @@ def main(runtime, cfg: Dict[str, Any]):
     last_log = state["last_log"] if state else 0
     last_checkpoint = state["last_checkpoint"] if state else 0
 
-    train_fn = make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys)
+    params_sync = PlayerParamsSync(player.params)
+    train_fn = make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync)
     rng = jax.random.PRNGKey(cfg.seed)
+    player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
     h = cfg.algo.rnn.lstm.hidden_size
 
     step_data = {}
@@ -255,8 +258,11 @@ def main(runtime, cfg: Dict[str, Any]):
             with timer("Time/env_interaction_time", SumMetric()):
                 jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                 jax_obs = {k: v[None] for k, v in jax_obs.items()}  # add T=1
-                cat_actions, env_actions, logprobs, values, states, rng = player(
-                    jax_obs, jnp.asarray(prev_actions)[None], prev_states, rng
+                cat_actions, env_actions, logprobs, values, states, player_rng = player(
+                    jax_obs,
+                    jax.device_put(prev_actions[None], runtime.player_device),
+                    prev_states,
+                    player_rng,
                 )
                 real_actions = np.asarray(env_actions)
                 obs, rewards, terminated, truncated, info = envs.step(
@@ -322,7 +328,11 @@ def main(runtime, cfg: Dict[str, Any]):
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
             jax_obs = {k: v[None] for k, v in jax_obs.items()}
-            next_values, _ = player.get_values(jax_obs, jnp.asarray(prev_actions)[None], prev_states)
+            next_values = np.asarray(
+                player.get_values(
+                    jax_obs, jax.device_put(prev_actions[None], runtime.player_device), prev_states
+                )[0]
+            )
             returns, advantages = gae(
                 jnp.asarray(local_data["rewards"]),
                 jnp.asarray(local_data["values"]),
@@ -339,7 +349,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
             device_data = {k: jnp.asarray(v) for k, v in padded.items()}
             rng, train_key = jax.random.split(rng)
-            params, opt_state, train_metrics = train_fn(
+            params, opt_state, flat_params, train_metrics = train_fn(
                 params,
                 opt_state,
                 device_data,
@@ -347,8 +357,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 jnp.float32(cfg.algo.clip_coef),
                 jnp.float32(cfg.algo.ent_coef),
             )
-            jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
-            player.params = params
+            player.params = params_sync.pull(flat_params, runtime.player_device)
+            if not timer.disabled:
+                jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
         train_step += world_size
 
         if cfg.metric.log_level > 0:

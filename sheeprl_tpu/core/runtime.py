@@ -60,6 +60,7 @@ class Runtime:
     mesh_axes: Sequence[str] = ("data",)
     callbacks: Sequence[Any] = field(default_factory=list)
     multihost: bool = False
+    player_on_host: bool = True
 
     def __post_init__(self):
         if self.multihost and jax.process_count() == 1:  # pragma: no cover - multihost only
@@ -113,6 +114,33 @@ class Runtime:
     @property
     def device(self):
         return self._devices[0]
+
+    @property
+    def host_device(self):
+        """The host CPU backend device (jax_platforms always includes cpu)."""
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:  # pragma: no cover - cpu backend always exists
+            return self._devices[0]
+
+    @property
+    def player_device(self):
+        """Where the rollout policy runs.
+
+        Per-env-step policy calls are synchronous host<->device round-trips; on a
+        remote/tunneled TPU one round-trip costs O(100ms), so by default the player
+        runs on the host CPU backend and only the train step uses the accelerator
+        (``fabric.player_on_host=False`` opts back into on-accelerator rollouts,
+        e.g. for locally-attached chips with big CNN policies).
+        """
+        if not self.player_on_host:
+            return self._devices[0]
+        return self.host_device
+
+    def to_player(self, tree):
+        """Move a pytree to the player device (committed), e.g. post-update params."""
+        dev = self.player_device
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, dev), tree)
 
     # ----- sharding ------------------------------------------------------------------
     @property
@@ -193,6 +221,7 @@ def build_runtime(cfg_fabric: Dict[str, Any], extra_callbacks: Optional[Sequence
         precision=cfg_fabric.get("precision", "32-true"),
         callbacks=callbacks,
         multihost=bool(cfg_fabric.get("multihost", False)),
+        player_on_host=bool(cfg_fabric.get("player_on_host", True)),
     )
 
 

@@ -342,18 +342,44 @@ class LayerNormGRUCell(nn.Module):
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     kernel_init: Optional[Callable] = None
+    # opt-in: the builder sets this when the agent's mesh is on TPU (the kernel
+    # can't see the target backend at trace time, so the caller decides)
+    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
-        fused = nn.Dense(
-            3 * self.hidden_size,
-            use_bias=self.bias,
-            dtype=self.dtype,
-            param_dtype=self.param_dtype,
-            kernel_init=self.kernel_init or nn.linear.default_kernel_init,
-        )(jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1))
+        n = 3 * self.hidden_size
+        in_features = h.shape[-1] + x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            self.kernel_init or nn.linear.default_kernel_init,
+            (in_features, n),
+            self.param_dtype,
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (n,), self.param_dtype) if self.bias else None
         if self.layer_norm:
-            fused = LayerNorm()(fused)
+            ln_scale = self.param("ln_scale", nn.initializers.ones_init(), (n,), jnp.float32)
+            ln_bias = self.param("ln_bias", nn.initializers.zeros_init(), (n,), jnp.float32)
+
+        # Fused Pallas kernel for the LN variant (the RSSM hot path): one VMEM
+        # round-trip for matmul+LN+gates, weights resident across the row grid.
+        if self.layer_norm and not self.bias and self.use_pallas and x.ndim == 2:
+            from sheeprl_tpu.ops.pallas import layer_norm_gru, pallas_gru_supported
+
+            if pallas_gru_supported(x.shape[0], x.shape[-1], self.hidden_size, self.dtype):
+                return layer_norm_gru(x, h, kernel, ln_scale, ln_bias).astype(self.dtype)
+
+        xh = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1)
+        fused = xh @ kernel.astype(self.dtype)
+        if bias is not None:
+            fused = fused + bias.astype(self.dtype)
+        if self.layer_norm:
+            # fp32 stats, dtype-preserving (same policy as the LayerNorm module)
+            f32 = fused.astype(jnp.float32)
+            mu = jnp.mean(f32, axis=-1, keepdims=True)
+            var = jnp.var(f32, axis=-1, keepdims=True)
+            f32 = (f32 - mu) * jax.lax.rsqrt(var + 1e-5) * ln_scale + ln_bias
+            fused = f32.astype(self.dtype)
         reset, cand, update = jnp.split(fused, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)
         cand = jnp.tanh(reset * cand)

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+Each kernel ships with a pure-JAX fallback and is enabled only when the input
+shapes/platform qualify; correctness is pinned by parity tests against the
+fallback (tests/test_ops/test_pallas_gru.py).
+"""
+
+from sheeprl_tpu.ops.pallas.gru import layer_norm_gru, pallas_gru_supported
+
+__all__ = ["layer_norm_gru", "pallas_gru_supported"]

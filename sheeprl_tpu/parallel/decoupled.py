@@ -52,4 +52,10 @@ def split_runtime(runtime: Runtime) -> Tuple[Runtime, Runtime]:
         raise RuntimeError(
             f"The decoupled actor-learner split requires at least 2 devices, got {len(devices)}"
         )
-    return _sub_runtime(runtime, devices[:1]), _sub_runtime(runtime, devices[1:])
+    player_rt = _sub_runtime(runtime, devices[:1])
+    trainer_rt = _sub_runtime(runtime, devices[1:])
+    # The whole point of the split is a DEDICATED player chip: the rollout policy
+    # must not fall back to the host CPU (and params/obs must agree on placement).
+    player_rt.player_on_host = False
+    trainer_rt.player_on_host = False
+    return player_rt, trainer_rt

@@ -196,6 +196,33 @@ def polyak_update(params, target_params, tau: float):
     return jax.tree_util.tree_map(lambda p, tp: tau * p + (1.0 - tau) * tp, params, target_params)
 
 
+class PlayerParamsSync:
+    """One-transfer params pipe: training mesh -> player device.
+
+    Per-leaf cross-backend transfers each pay a full host round-trip (~100ms on a
+    tunneled TPU), so the per-iteration player refresh ravels the whole param tree
+    into ONE flat vector on the mesh (call :meth:`ravel` inside the jitted train
+    step), ships that single array, and unravels it on the player device. The
+    reference ships trainer->player params the same way, as one flattened vector
+    (torch ``parameters_to_vector``, sheeprl/algos/ppo/ppo_decoupled.py:302,550).
+    """
+
+    def __init__(self, player_params):
+        from jax.flatten_util import ravel_pytree
+
+        self._ravel_pytree = ravel_pytree
+        _, self._unravel = ravel_pytree(player_params)
+        self._unravel_jit = jax.jit(self._unravel)
+
+    def ravel(self, params) -> jax.Array:
+        """Flatten on the training mesh — call from inside the jitted train step."""
+        return self._ravel_pytree(params)[0]
+
+    def pull(self, flat: jax.Array, device):
+        """One cross-backend transfer + on-device unflatten -> player param tree."""
+        return self._unravel_jit(jax.device_put(flat, device))
+
+
 # --------------------------------------------------------------------------------------
 # Host-side bookkeeping
 # --------------------------------------------------------------------------------------

@@ -93,8 +93,8 @@ def test_layer_norm_gru_cell_math():
     out = cell.apply(params, x, h)
     assert out.shape == (2, 4)
     # replicate the gate math manually
-    kernel = params["params"]["Dense_0"]["kernel"]
-    bias = params["params"]["Dense_0"]["bias"]
+    kernel = params["params"]["kernel"]
+    bias = params["params"]["bias"]
     fused = jnp.concatenate([h, x], -1) @ kernel + bias
     reset, cand, update = jnp.split(fused, 3, -1)
     reset = jax.nn.sigmoid(reset)
@@ -111,6 +111,21 @@ def test_layer_norm_gru_keeps_state_when_update_closed():
     params = cell.init(KEY, x, h)
     out = cell.apply(params, x, h)
     assert out.shape == h.shape
+
+
+def test_layer_norm_gru_ln_matches_pallas_reference():
+    """The flax LN path and the Pallas kernel's pure-JAX reference must agree —
+    they are the same op behind `pallas_gru_supported` dispatch."""
+    from sheeprl_tpu.ops.pallas.gru import layer_norm_gru_reference
+
+    cell = LayerNormGRUCell(hidden_size=16, layer_norm=True, bias=False, use_pallas=False)
+    x = jax.random.normal(jax.random.PRNGKey(3), (5, 12))
+    h = jax.random.normal(jax.random.PRNGKey(4), (5, 16))
+    params = cell.init(KEY, x, h)
+    out = cell.apply(params, x, h)
+    p = params["params"]
+    ref = layer_norm_gru_reference(x, h, p["kernel"], p["ln_scale"], p["ln_bias"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
 
 
 def test_layer_norm_channel_last():
