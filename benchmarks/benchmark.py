@@ -1,0 +1,61 @@
+"""Wall-clock benchmark harness (reference benchmarks/benchmark.py:1-52).
+
+Runs one of the ``exp=*_benchmarks`` recipes through the real CLI with
+test/logging/checkpointing disabled and prints one JSON line with the elapsed
+time, throughput, and the reference's published wall-clock anchor
+(README.md:99-176 of the reference; see BASELINE.md).
+
+Usage:
+    python benchmarks/benchmark.py ppo
+    python benchmarks/benchmark.py dreamer_v3 fabric.devices=1 env.num_envs=4
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Reference wall-clock numbers: (seconds on 4 CPUs, total policy steps of the recipe)
+REFERENCE = {
+    "ppo": (81.27, 65536),
+    "a2c": (84.76, 65536),
+    "sac": (320.21, 65536),
+    "dreamer_v1": (2207.13, 65536),
+    "dreamer_v2": (906.42, 65536),
+    "dreamer_v3": (1589.30, 65536),
+}
+
+
+def main() -> None:
+    if len(sys.argv) < 2 or sys.argv[1] not in REFERENCE:
+        print(f"usage: python benchmarks/benchmark.py <{'|'.join(REFERENCE)}> [overrides...]")
+        raise SystemExit(2)
+    algo = sys.argv[1]
+    overrides = [f"exp={algo}_benchmarks", *sys.argv[2:]]
+
+    from sheeprl_tpu.cli import run
+
+    tic = time.perf_counter()
+    run(overrides=overrides)
+    elapsed = time.perf_counter() - tic
+
+    ref_seconds, total_steps = REFERENCE[algo]
+    print(
+        json.dumps(
+            {
+                "algo": algo,
+                "seconds": round(elapsed, 2),
+                "env_steps_per_sec": round(total_steps / elapsed, 2),
+                "reference_seconds": ref_seconds,
+                "speedup_vs_reference": round(ref_seconds / elapsed, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,15 @@ import numpy as np
 from sheeprl_tpu.utils.memmap import MemmapArray
 from sheeprl_tpu.utils.utils import NUMPY_TO_JAX_DTYPE
 
+
+def _native_seq_gather():
+    """The C++ fused gather (sheeprl_tpu/native) or None when unavailable."""
+    try:
+        from sheeprl_tpu.native import native_available, seq_gather
+    except Exception:  # pragma: no cover - import/build failure
+        return None
+    return seq_gather if native_available() else None
+
 _MEMMAP_ERR = (
     'Accepted values for memmap_mode are "r+", "readwrite", "w+", "write", "c" or '
     '"copyonwrite". Read-only modes are not supported for replay buffers.'
@@ -391,13 +400,48 @@ class SequentialReplayBuffer(ReplayBuffer):
         sample_next_obs: bool = False,
         clone: bool = False,
     ) -> Dict[str, np.ndarray]:
-        flat_batch_idxes = np.ravel(batch_idxes)
         # every element of a sequence must come from the same env stream
         if self._n_envs == 1:
-            env_idxes = np.zeros((batch_size * n_samples * sequence_length,), dtype=np.intp)
+            pair_envs = np.zeros((batch_size * n_samples,), dtype=np.intp)
         else:
-            env_idxes = self._rng.integers(0, self._n_envs, size=(batch_size * n_samples,), dtype=np.intp)
-            env_idxes = np.repeat(env_idxes, sequence_length)
+            pair_envs = self._rng.integers(0, self._n_envs, size=(batch_size * n_samples,), dtype=np.intp)
+
+        # Native fused gather+transpose (sheeprl_tpu/native): one multithreaded
+        # pass writing the final [n_samples, L, B, *] layout. Falls back to the
+        # numpy path when the extension is unavailable.
+        native = _native_seq_gather()
+        if native is not None:
+            srcs = {k: np.asarray(v) for k, v in self._buf.items()}
+            if all(s.flags["C_CONTIGUOUS"] for s in srcs.values()):
+                starts = np.ascontiguousarray(batch_idxes[:, 0], dtype=np.int64)
+                envs64 = pair_envs.astype(np.int64)
+                next_starts = (starts + 1) % self._buffer_size if sample_next_obs else None
+                out: Dict[str, np.ndarray] = {}
+                for k, src in srcs.items():
+                    out[k] = native(src, starts, envs64, n_samples, batch_size, sequence_length)
+                    if sample_next_obs:
+                        out[f"next_{k}"] = native(
+                            src, next_starts, envs64, n_samples, batch_size, sequence_length
+                        )
+                if all(v is not None for v in out.values()):
+                    return out
+
+        return self._gather_sequences_numpy(
+            batch_idxes, pair_envs, batch_size, n_samples, sequence_length, sample_next_obs, clone
+        )
+
+    def _gather_sequences_numpy(
+        self,
+        batch_idxes: np.ndarray,
+        pair_envs: np.ndarray,
+        batch_size: int,
+        n_samples: int,
+        sequence_length: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        flat_batch_idxes = np.ravel(batch_idxes)
+        env_idxes = np.repeat(pair_envs, sequence_length)
         flat_idx = flat_batch_idxes * self._n_envs + env_idxes
         out: Dict[str, np.ndarray] = {}
         for k, v in self._buf.items():

@@ -1,0 +1,61 @@
+// Multithreaded sequence gather for the replay-buffer sampling hot path.
+//
+// The reference's equivalent is torch/numpy fancy indexing inside
+// SequentialReplayBuffer._get_samples (sheeprl/data/buffers.py:467-526) — a
+// single-threaded gather followed by a transpose. Here one pass writes rows
+// straight into the final [n_samples, L, B, row] layout (gather + transpose
+// fused), parallelized over (sample, batch) pairs. This is host-side work that
+// overlaps with TPU compute; keeping it off the GIL matters because the rollout
+// loop shares the process.
+//
+// Built by sheeprl_tpu/native/__init__.py with g++ -O3 -march=native; called
+// through ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// src:   [capacity, n_envs, row_bytes]  (contiguous byte view)
+// dst:   [n_pairs/B, L, B, row_bytes]   (contiguous byte view)
+// starts/envs: per (sample, batch) pair, length n_pairs; the sequence for pair
+// p = (n, b) reads src[(starts[p] + t) % capacity, envs[p], :] for t in [0, L).
+void seq_gather(const char* src, char* dst, const int64_t* starts,
+                const int64_t* envs, int64_t n_pairs, int64_t B, int64_t L,
+                int64_t capacity, int64_t n_envs, int64_t row_bytes,
+                int32_t n_threads) {
+  const int64_t src_step = n_envs * row_bytes;  // one time-step of all envs
+  auto worker = [&](int64_t p_begin, int64_t p_end) {
+    for (int64_t p = p_begin; p < p_end; ++p) {
+      const int64_t n = p / B;
+      const int64_t b = p % B;
+      const int64_t start = starts[p];
+      const char* env_base = src + envs[p] * row_bytes;
+      char* out_base = dst + (n * L * B + b) * row_bytes;
+      for (int64_t t = 0; t < L; ++t) {
+        const int64_t idx = (start + t) % capacity;
+        std::memcpy(out_base + t * B * row_bytes, env_base + idx * src_step,
+                    static_cast<size_t>(row_bytes));
+      }
+    }
+  };
+
+  if (n_threads <= 1 || n_pairs < 2 * n_threads) {
+    worker(0, n_pairs);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(static_cast<size_t>(n_threads));
+  const int64_t chunk = (n_pairs + n_threads - 1) / n_threads;
+  for (int32_t i = 0; i < n_threads; ++i) {
+    const int64_t b0 = i * chunk;
+    const int64_t b1 = b0 + chunk < n_pairs ? b0 + chunk : n_pairs;
+    if (b0 >= b1) break;
+    threads.emplace_back(worker, b0, b1);
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

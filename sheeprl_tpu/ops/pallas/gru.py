@@ -86,7 +86,9 @@ def _fwd_kernel(hidden: int, eps: float, xh_ref, h_ref, w_ref, g_ref, b_ref,
                 hnew_ref, zhat_ref, siginv_ref):
     z = jnp.dot(xh_ref[:], w_ref[:], preferred_element_type=jnp.float32, precision=_DOT_PRECISION)
     mu = jnp.mean(z, axis=1, keepdims=True)
-    var = jnp.mean(jnp.square(z), axis=1, keepdims=True) - jnp.square(mu)
+    # two-pass variance: E[z^2]-mu^2 cancels catastrophically once |mu| >> std
+    # and rsqrt of the resulting negative would NaN the whole RSSM state
+    var = jnp.mean(jnp.square(z - mu), axis=1, keepdims=True)
     sig_inv = jax.lax.rsqrt(var + eps)
     zhat = (z - mu) * sig_inv
     zn = zhat * g_ref[:] + b_ref[:]

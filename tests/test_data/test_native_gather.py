@@ -1,0 +1,61 @@
+"""Parity tests: C++ seq_gather vs the numpy gather path.
+
+The native extension builds on first use (g++ baked into the image); if the
+build is unavailable the module returns None and the tests skip — the buffers
+then always use the (equally tested) numpy path.
+"""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import SequentialReplayBuffer
+from sheeprl_tpu.native import native_available, seq_gather
+
+pytestmark = pytest.mark.skipif(not native_available(), reason="native extension unavailable")
+
+
+def _reference(src, starts, envs, n_samples, b, L):
+    out = np.empty((n_samples, L, b) + src.shape[2:], dtype=src.dtype)
+    for p in range(n_samples * b):
+        n, bb = divmod(p, b)
+        for t in range(L):
+            out[n, t, bb] = src[(starts[p] + t) % src.shape[0], envs[p]]
+    return out
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.uint8, np.float64])
+@pytest.mark.parametrize("feat", [(4,), (3, 8, 8), ()])
+def test_seq_gather_matches_reference(dtype, feat):
+    rng = np.random.default_rng(0)
+    cap, n_envs, n_samples, b, L = 37, 3, 4, 5, 7
+    src = (rng.random((cap, n_envs, *feat)) * 100).astype(dtype)
+    starts = rng.integers(0, cap, size=(n_samples * b,), dtype=np.int64)  # incl. wraparound
+    envs = rng.integers(0, n_envs, size=(n_samples * b,), dtype=np.int64)
+    out = seq_gather(src, starts, envs, n_samples, b, L)
+    np.testing.assert_array_equal(out, _reference(src, starts, envs, n_samples, b, L))
+
+
+def test_sequential_buffer_native_matches_numpy_path(monkeypatch):
+    """Same seed => same sampled indices => identical outputs on both paths."""
+    def fill(rb, steps, n_envs):
+        for i in range(steps):
+            rb.add(
+                {
+                    "obs": np.full((1, n_envs, 4), i, dtype=np.float32),
+                    "rewards": np.full((1, n_envs, 1), i, dtype=np.float32),
+                },
+                validate_args=True,
+            )
+
+    out = {}
+    for use_native in (True, False):
+        rb = SequentialReplayBuffer(16, n_envs=2, obs_keys=("obs",))
+        fill(rb, 24, 2)  # wraps around
+        rb.seed(1234)
+        if not use_native:
+            monkeypatch.setattr("sheeprl_tpu.data.buffers._native_seq_gather", lambda: None)
+        out[use_native] = rb.sample(batch_size=6, n_samples=3, sequence_length=5, sample_next_obs=True)
+        monkeypatch.undo()
+    for k in out[True]:
+        np.testing.assert_array_equal(out[True][k], out[False][k], err_msg=k)
+        assert out[True][k].shape == out[False][k].shape
