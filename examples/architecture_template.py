@@ -1,0 +1,82 @@
+"""Template: the TPU-native decoupled actor-learner architecture.
+
+The reference's template (examples/architecture_template.py) spawns
+buffer/player/trainer *processes* joined by torch.distributed collectives. On a
+single-controller JAX runtime the same architecture is a DEVICE split: one mesh
+chip plays, the rest train, and the "collectives" are direct device-to-device
+array placements — no process groups, no object pipes.
+
+Run on the virtual CPU mesh (no TPU needed):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/architecture_template.py
+"""
+
+import os
+import sys
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.parallel.decoupled import split_runtime
+
+
+def main() -> None:
+    runtime = Runtime(accelerator="cpu" if jax.device_count() < 2 else "auto", devices=8)
+    player_rt, trainer_rt = split_runtime(runtime)
+    print(f"player mesh: {player_rt.mesh}, trainer mesh: {trainer_rt.mesh}")
+
+    # --- a toy "policy": y = x @ w ------------------------------------------------
+    obs_dim, act_dim, batch = 16, 4, 32 * trainer_rt.world_size
+    params = {"w": jnp.zeros((obs_dim, act_dim))}
+    tx = optax.sgd(1e-2)
+    opt_state = trainer_rt.replicate(tx.init(params))
+    params = trainer_rt.replicate(params)
+
+    data_sharding = NamedSharding(trainer_rt.mesh, P("data"))
+
+    @jax.jit
+    def train_step(params, opt_state, batch_x, batch_y):
+        batch_x = jax.lax.with_sharding_constraint(batch_x, data_sharding)
+
+        def loss_fn(p):
+            return jnp.mean((batch_x @ p["w"] - batch_y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)  # psum inserted by XLA
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    # --- player: rollouts on its own chip ----------------------------------------
+    player_params = jax.device_put(params, player_rt.replicated)
+    act = jax.jit(lambda p, x: x @ p["w"])
+
+    rng = np.random.default_rng(0)
+    for it in range(5):
+        # 1) the player acts (dedicated chip, uncontended by training)
+        obs = jax.device_put(rng.normal(size=(batch, obs_dim)).astype(np.float32), player_rt.replicated)
+        actions = act(player_params, obs)
+
+        # 2) the payload moves onto the trainer mesh (reference: scatter_object_list)
+        target = jnp.ones((batch, act_dim))
+        batch_x = jax.device_put(obs, trainer_rt.replicated)
+        params, opt_state, loss = train_step(params, opt_state, batch_x, target)
+
+        # 3) parameter refresh back to the player chip (reference: flattened-vector
+        #    broadcast, ppo_decoupled.py:550-554)
+        player_params = jax.device_put(params, player_rt.replicated)
+        print(f"iter {it}: loss={float(loss):.4f}")
+
+    print("done — see sheeprl_tpu/algos/ppo/ppo_decoupled.py for the full version")
+
+
+if __name__ == "__main__":
+    main()

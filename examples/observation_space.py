@@ -1,0 +1,43 @@
+"""Print the (normalized) Dict observation space an agent will see for any env
+config — useful before picking ``algo.cnn_keys``/``algo.mlp_keys``.
+
+Reference counterpart: examples/observation_space.py.
+
+Usage:
+    python examples/observation_space.py env=gym env.id=CartPole-v1 algo=ppo \
+        algo.mlp_keys.encoder=[state]
+    python examples/observation_space.py env=dummy env.id=discrete_dummy algo=dreamer_v3
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.env import make_env
+
+
+def main() -> None:
+    overrides = sys.argv[1:]
+    # an exp recipe is not required for inspecting spaces: default to ppo
+    if not any(o.startswith("exp=") for o in overrides):
+        overrides = ["exp=ppo", *overrides]
+    cfg = compose(overrides=overrides)
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0, None, "space-check")()
+    try:
+        print("Observation space:")
+        for key, space in env.observation_space.spaces.items():
+            print(f"  {key}: {space}")
+        print("Action space:", env.action_space)
+        print()
+        print("Encoder keys selected by this config:")
+        print("  cnn:", list(cfg.algo.cnn_keys.encoder))
+        print("  mlp:", list(cfg.algo.mlp_keys.encoder))
+    finally:
+        env.close()
+
+
+if __name__ == "__main__":
+    main()

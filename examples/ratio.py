@@ -1,0 +1,37 @@
+"""Demonstrate the ``Ratio`` replay scheduler: how many gradient steps a given
+``algo.replay_ratio`` yields as policy steps accumulate.
+
+Reference counterpart: examples/ratio.py.
+
+Usage:
+    python examples/ratio.py 0.5 1024 64
+    # replay_ratio=0.5, 1024 total policy steps, 64 policy steps per iteration
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from sheeprl_tpu.utils.utils import Ratio
+
+
+def main() -> None:
+    replay_ratio = float(sys.argv[1]) if len(sys.argv) > 1 else 0.5
+    total_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    per_iter = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+
+    ratio = Ratio(replay_ratio)
+    total_grad_steps = 0
+    for policy_step in range(per_iter, total_steps + 1, per_iter):
+        g = ratio(policy_step)
+        total_grad_steps += g
+        print(f"policy_step={policy_step:6d} -> {g:3d} gradient steps (cumulative {total_grad_steps})")
+    print(
+        f"\nrealized replay ratio: {total_grad_steps / total_steps:.4f} "
+        f"(requested {replay_ratio})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -423,8 +423,7 @@ class SequentialReplayBuffer(ReplayBuffer):
                         out[f"next_{k}"] = native(
                             src, next_starts, envs64, n_samples, batch_size, sequence_length
                         )
-                if all(v is not None for v in out.values()):
-                    return out
+                return out
 
         return self._gather_sequences_numpy(
             batch_idxes, pair_envs, batch_size, n_samples, sequence_length, sample_next_obs, clone

@@ -32,8 +32,20 @@ def _build_and_load() -> Optional[ctypes.CDLL]:
     if os.environ.get("SHEEPRL_TPU_NO_NATIVE"):
         return None
     try:
+        import platform
+
         with open(_SRC, "rb") as f:
-            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+            src_bytes = f.read()
+        # -march=native binaries are host-specific: key the cache on the target
+        # ISA too, or a shared home dir on a heterogeneous fleet serves an .so
+        # with illegal instructions to older CPUs
+        try:
+            target = subprocess.run(
+                ["g++", "-march=native", "-Q", "--help=target"], capture_output=True
+            ).stdout
+        except Exception:
+            target = b""
+        digest = hashlib.sha256(src_bytes + platform.machine().encode() + target).hexdigest()[:16]
         cache_dir = os.environ.get(
             "SHEEPRL_TPU_NATIVE_CACHE",
             os.path.join(os.path.expanduser("~"), ".cache", "sheeprl_tpu_native"),

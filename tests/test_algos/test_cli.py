@@ -1,0 +1,111 @@
+"""CLI-level tests: shim invocation, resume round-trip, resume mismatch errors,
+evaluation from checkpoint (reference tests/test_algos/test_cli.py:99-277)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from sheeprl_tpu.cli import evaluation, run
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TINY_PPO = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=2",
+    "algo.update_epochs=1",
+    "algo.total_steps=16",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+]
+
+
+def _find_ckpts(root):
+    found = []
+    for base, _, files in os.walk(root):
+        found += [os.path.join(base, f) for f in files if f.endswith(".ckpt")]
+    return sorted(found)
+
+
+def test_run_algo_subprocess(tmp_path):
+    """The `python sheeprl.py ...` shim end-to-end in a fresh interpreter."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "sheeprl.py"), *TINY_PPO, "dry_run=True", "checkpoint.save_last=False"],
+        cwd=tmp_path,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+
+
+def test_resume_from_checkpoint(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(overrides=TINY_PPO + ["checkpoint.save_last=True"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts, "training did not write a checkpoint"
+    run(overrides=TINY_PPO + ["checkpoint.save_last=False", f"checkpoint.resume_from={ckpts[-1]}"])
+
+
+def test_resume_from_checkpoint_env_error(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(overrides=TINY_PPO + ["checkpoint.save_last=True"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    args = [a if not a.startswith("env.id=") else "env.id=continuous_dummy" for a in TINY_PPO]
+    with pytest.raises(ValueError, match="different environment"):
+        run(overrides=args + [f"checkpoint.resume_from={ckpts[-1]}"])
+
+
+def test_resume_from_checkpoint_algo_error(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(overrides=TINY_PPO + ["checkpoint.save_last=True"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    args = [a if a != "exp=ppo" else "exp=a2c" for a in TINY_PPO]
+    with pytest.raises(ValueError, match="different algorithm"):
+        run(overrides=args + [f"checkpoint.resume_from={ckpts[-1]}"])
+
+
+def test_evaluate(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(overrides=TINY_PPO + ["checkpoint.save_last=True", "dry_run=True"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts
+    evaluation(overrides=[f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
+def test_evaluate_requires_checkpoint_path():
+    from sheeprl_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="checkpoint_path"):
+        evaluation(overrides=[])
+
+
+def test_decoupled_requires_two_devices(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(RuntimeError, match="at least 2 devices"):
+        run(
+            overrides=[
+                "exp=ppo_decoupled",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "env.capture_video=False",
+                "fabric.devices=1",
+                "metric.log_level=0",
+                "algo.mlp_keys.encoder=[state]",
+                "dry_run=True",
+            ]
+        )
