@@ -72,7 +72,13 @@ def main() -> None:
 
     # ---- imagine forward with the trained policy
     frames, rewards, continues = [], [], []
-    cnn_key = list(cfg.algo.cnn_keys.decoder)[0]
+    cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)
+    if not cnn_keys_dec:
+        raise SystemExit(
+            "This checkpoint was trained without pixel observations "
+            "(algo.cnn_keys.decoder is empty) — there are no frames to imagine."
+        )
+    cnn_key = cnn_keys_dec[0]
     for t in range(horizon):
         key, k_act, k_img = jax.random.split(key, 3)
         latent = jnp.concatenate([prior_flat, rec], axis=-1)

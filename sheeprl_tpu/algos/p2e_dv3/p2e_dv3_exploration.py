@@ -54,6 +54,7 @@ from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_en
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, save_configs
@@ -697,6 +698,7 @@ def main(runtime, cfg: Dict[str, Any]):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
@@ -710,6 +712,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     cumulative_per_rank_gradient_steps = 0
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.step(policy_step)
         policy_step += policy_steps_per_iter
 
         with timer("Time/env_interaction_time", SumMetric()):
@@ -890,6 +893,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    profiler.close()
     envs.close()
     # Zero-shot evaluation runs with the TASK policy (reference :1032-1036).
     if runtime.is_global_zero and cfg.algo.run_test:

@@ -35,6 +35,7 @@ from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
@@ -260,6 +261,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     params_sync = PlayerParamsSync(player.params)
     train_fn = make_train_fn(agent, tx, cfg, runtime, n_data, obs_keys, cnn_keys, params_sync)
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     # Separate rollout key committed to the player device: the policy forward then
     # runs entirely there (mixing committed arrays across backends is an error).
@@ -273,6 +275,7 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.step(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += n_envs
 
@@ -424,6 +427,7 @@ def main(runtime, cfg: Dict[str, Any]):
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
             runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
 
+    profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)

@@ -37,6 +37,7 @@ from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
@@ -187,6 +188,7 @@ def main(runtime, cfg: Dict[str, Any]):
         player_params = jax.device_put(new_params, player_rt.replicated)
         return player_params, metrics
 
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -196,6 +198,7 @@ def main(runtime, cfg: Dict[str, Any]):
         step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
+            profiler.step(policy_step)
             for _ in range(cfg.algo.rollout_steps):
                 policy_step += n_envs
 
@@ -332,6 +335,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
                 runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
 
+    profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, player_rt, cfg, log_dir)

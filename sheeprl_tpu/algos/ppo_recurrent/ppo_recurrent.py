@@ -29,6 +29,7 @@ from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import PlayerParamsSync, gae, polynomial_decay, save_configs
@@ -238,6 +239,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     params_sync = PlayerParamsSync(player.params)
     train_fn = make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync)
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
     h = cfg.algo.rnn.lstm.hidden_size
@@ -252,6 +254,7 @@ def main(runtime, cfg: Dict[str, Any]):
     prev_actions = np.zeros((n_envs, sum(actions_dim)), dtype=np.float32)
 
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.step(policy_step)
         for _ in range(cfg.algo.rollout_steps):
             policy_step += n_envs
 
@@ -416,6 +419,7 @@ def main(runtime, cfg: Dict[str, Any]):
             ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
             runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
 
+    profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         from sheeprl_tpu.algos.ppo_recurrent.utils import test

@@ -35,6 +35,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
@@ -250,6 +251,7 @@ def main(runtime, cfg: Dict[str, Any]):
             f"policy_steps_per_iter value ({policy_steps_per_iter})."
         )
 
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     mlp_keys = cfg.algo.mlp_keys.encoder
     cumulative_grad_steps = 0
@@ -258,6 +260,7 @@ def main(runtime, cfg: Dict[str, Any]):
     obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
 
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.step(policy_step)
         policy_step += n_envs
 
         with timer("Time/env_interaction_time", SumMetric()):
@@ -382,6 +385,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)

@@ -30,6 +30,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
@@ -304,6 +305,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
+    profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
 
     def to_stored(o, k):
@@ -316,6 +318,7 @@ def main(runtime, cfg: Dict[str, Any]):
     stored_obs = {k: to_stored(obs, k) for k in obs_keys}
 
     for iter_num in range(start_iter, total_iters + 1):
+        profiler.step(policy_step)
         policy_step += n_envs
 
         with timer("Time/env_interaction_time", SumMetric()):
@@ -428,6 +431,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)

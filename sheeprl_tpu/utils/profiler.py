@@ -1,0 +1,67 @@
+"""Optional jax.profiler trace capture around training iterations.
+
+The reference has no profiler integration (SURVEY §5: profiling is wall-clock
+timers only); on TPU the XLA trace is the tool that actually explains where
+device time goes, so the TPU build adds it behind ``metric.profiler.*``:
+
+    python sheeprl.py exp=dreamer_v3 ... metric.profiler.enabled=True \
+        metric.profiler.start_step=2000 metric.profiler.num_iters=5
+
+Traces are written to ``<log_dir>/profiler`` and open in TensorBoard's profile
+plugin or Perfetto (trace.json.gz inside the capture directory).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class TraceProfiler:
+    """Start/stop a jax.profiler trace across a window of training iterations.
+
+    Call :meth:`step` once per iteration with the global policy step; the trace
+    starts when ``policy_step >= start_step`` and stops ``num_iters`` calls
+    later (or at :meth:`close`).
+    """
+
+    def __init__(self, cfg_profiler, log_dir: Optional[str]):
+        cfg_profiler = cfg_profiler or {}
+        self._enabled = bool(cfg_profiler.get("enabled", False)) and log_dir is not None
+        self._start_step = int(cfg_profiler.get("start_step", 0))
+        self._num_iters = int(cfg_profiler.get("num_iters", 5))
+        self._trace_dir = os.path.join(log_dir, "profiler") if log_dir else None
+        self._active = False
+        self._done = False
+        self._iters_left = self._num_iters
+        if self._enabled:
+            # flush a partial capture even when the training loop dies mid-window
+            # (close() is idempotent, so the explicit end-of-run call stays cheap)
+            import atexit
+
+            atexit.register(self.close)
+
+    def step(self, policy_step: int) -> None:
+        if not self._enabled or self._done:
+            return
+        import jax
+
+        if not self._active:
+            if policy_step >= self._start_step:
+                os.makedirs(self._trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self._trace_dir)
+                self._active = True
+            return
+        self._iters_left -= 1
+        if self._iters_left <= 0:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
