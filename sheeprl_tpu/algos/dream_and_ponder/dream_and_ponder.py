@@ -664,15 +664,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     params, opt_states, moments_state, counter, train_metrics = train_fn(
                         params, opt_states, moments_state, counter, batches, train_key
                     )
-                    jax.block_until_ready(params["actor"])
+                    jax.block_until_ready(params)
                     player.wm_params = params["world_model"]
                     player.actor_params = params["actor"]
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
             if aggregator and not aggregator.disabled:

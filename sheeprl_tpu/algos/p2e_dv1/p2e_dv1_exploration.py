@@ -585,15 +585,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, train_metrics = train_fn(params, opt_states, batches, train_key)
-                    jax.block_until_ready(params["actor_exploration"])
+                    jax.block_until_ready(params)
                     player.wm_params = params["world_model"]
                     player.actor_params = params["actor_exploration"]
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
                     if "Params/exploration_amount_exploration" in aggregator:
                         aggregator.update("Params/exploration_amount_exploration", player.expl_amount)
                     if "Params/exploration_amount_task" in aggregator:

@@ -303,9 +303,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
                     if "Params/exploration_amount_task" in aggregator:
                         aggregator.update("Params/exploration_amount_task", player.expl_amount)
                     if "Params/exploration_amount_exploration" in aggregator:

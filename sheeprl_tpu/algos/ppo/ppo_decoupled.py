@@ -280,9 +280,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
             if cfg.metric.log_level > 0:
                 if aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
                 logger.log_metrics(
                     {"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step
                 )

@@ -367,9 +367,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
         if cfg.metric.log_level > 0:
             if aggregator:
-                for k, v in train_metrics.items():
-                    if k in aggregator:
-                        aggregator.update(k, float(v))
+                aggregator.update_from_device(train_metrics)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)

@@ -331,9 +331,7 @@ def main(runtime, cfg: Dict[str, Any]):
         if cfg.metric.log_level > 0 and policy_step > 0:
             if iter_num >= learning_starts and "train_metrics" in dir():
                 if aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
                 if cumulative_grad_steps > 0:
                     logger.log_metrics(

@@ -257,9 +257,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         cumulative_grad_steps += per_rank_gradient_steps
                         train_step += trainer_world * per_rank_gradient_steps
                     if aggregator:
-                        for k, v in train_metrics.items():
-                            if k in aggregator:
-                                aggregator.update(k, float(v))
+                        aggregator.update_from_device(train_metrics)
 
             if cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters

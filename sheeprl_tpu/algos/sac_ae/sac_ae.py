@@ -381,9 +381,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     player.actor_params = params.actor
                 train_step += world_size * g
                 if cfg.metric.log_level > 0 and aggregator:
-                    for k, v in train_metrics.items():
-                        if k in aggregator:
-                            aggregator.update(k, float(v))
+                    aggregator.update_from_device(train_metrics)
 
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
             if aggregator and not aggregator.disabled:

@@ -136,6 +136,30 @@ class MetricAggregator:
             return
         self.metrics[name].update(value)
 
+    def update_from_device(self, metrics: Mapping[str, Any]) -> None:
+        """Update from a dict of (possibly device-resident) scalars with ONE pull.
+
+        A per-key ``float(device_scalar)`` pays a full synchronous host<->device
+        round-trip EACH (~140ms on a tunneled TPU; a 13-metric train dict cost
+        ~1.8s per iteration, measured via jax.profiler). Stacking on device and
+        fetching once makes metric logging O(1) round-trips.
+        """
+        if self.disabled or not metrics:
+            return
+        keys = [k for k in metrics if k in self.metrics]
+        if not keys:
+            return
+        vals = [metrics[k] for k in keys]
+        import jax
+
+        if any(isinstance(v, jax.Array) for v in vals):
+            import jax.numpy as jnp
+
+            host = np.asarray(jnp.stack([jnp.asarray(v, dtype=jnp.float32) for v in vals]))
+            vals = host.tolist()
+        for k, v in zip(keys, vals):
+            self.metrics[k].update(float(v))
+
     def __contains__(self, name: str) -> bool:
         return name in self.metrics
 
