@@ -14,6 +14,7 @@ from typing import Any, Dict, Iterable, Mapping, Optional, Sequence
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 
 def _to_float(value) -> float:
@@ -146,15 +147,15 @@ class MetricAggregator:
         """
         if self.disabled or not metrics:
             return
+        if self._raise_on_missing:
+            missing = [k for k in metrics if k not in self.metrics]
+            if missing:
+                raise KeyError(f"Metrics {missing} not registered")
         keys = [k for k in metrics if k in self.metrics]
         if not keys:
             return
         vals = [metrics[k] for k in keys]
-        import jax
-
         if any(isinstance(v, jax.Array) for v in vals):
-            import jax.numpy as jnp
-
             host = np.asarray(jnp.stack([jnp.asarray(v, dtype=jnp.float32) for v in vals]))
             vals = host.tolist()
         for k, v in zip(keys, vals):

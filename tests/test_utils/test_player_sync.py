@@ -1,0 +1,80 @@
+"""Unit tests for the host-player plumbing: PlayerParamsSync, Runtime.player_device,
+and the TraceProfiler window logic."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.utils.profiler import TraceProfiler
+from sheeprl_tpu.utils.utils import PlayerParamsSync
+
+
+def _params(scale=1.0):
+    return {
+        "enc": {"w": jnp.full((8, 16), scale), "b": jnp.zeros((16,))},
+        "head": {"w": jnp.full((16, 4), 2 * scale)},
+    }
+
+
+def test_player_params_sync_roundtrip():
+    rt = Runtime(accelerator="cpu", devices=2)
+    params = rt.replicate(_params())
+    sync = PlayerParamsSync(rt.to_player(params))
+    flat = jax.jit(sync.ravel)(params)
+    assert flat.ndim == 1 and flat.size == 8 * 16 + 16 + 16 * 4
+    pulled = sync.pull(flat, rt.player_device)
+    for (ka, va), (kb, vb) in zip(
+        jax.tree_util.tree_leaves_with_path(pulled), jax.tree_util.tree_leaves_with_path(params)
+    ):
+        assert ka == kb
+        np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+    # committed to the player device
+    assert next(iter(jax.tree_util.tree_leaves(pulled))).devices() == {rt.player_device}
+
+
+def test_player_params_sync_tracks_updates():
+    rt = Runtime(accelerator="cpu", devices=1)
+    sync = PlayerParamsSync(_params())
+    ravel_jit = jax.jit(sync.ravel)
+    for scale in (1.0, -3.0, 0.25):
+        pulled = sync.pull(ravel_jit(_params(scale)), rt.player_device)
+        np.testing.assert_allclose(np.asarray(pulled["head"]["w"]), 2 * scale)
+
+
+def test_player_device_selection():
+    on_host = Runtime(accelerator="cpu", devices=2, player_on_host=True)
+    assert on_host.player_device == jax.devices("cpu")[0]
+    on_mesh = Runtime(accelerator="cpu", devices=2, player_on_host=False)
+    assert on_mesh.player_device == on_mesh.device
+
+
+def test_trace_profiler_window(monkeypatch, tmp_path):
+    calls = []
+    import jax.profiler as jp
+
+    monkeypatch.setattr(jp, "start_trace", lambda d: calls.append(("start", d)))
+    monkeypatch.setattr(jp, "stop_trace", lambda: calls.append(("stop",)))
+    prof = TraceProfiler({"enabled": True, "start_step": 100, "num_iters": 3}, str(tmp_path))
+    for step in (0, 50, 99):
+        prof.step(step)
+    assert calls == []
+    prof.step(100)  # starts
+    assert calls and calls[0][0] == "start"
+    prof.step(110)
+    prof.step(120)
+    prof.step(130)  # third counted iteration -> stop
+    assert calls[-1] == ("stop",)
+    n_calls = len(calls)
+    prof.step(140)  # done: no restart
+    prof.close()  # idempotent
+    assert len(calls) == n_calls
+
+
+def test_trace_profiler_disabled_noop(tmp_path):
+    prof = TraceProfiler({"enabled": False}, str(tmp_path))
+    prof.step(0)
+    prof.close()
+    prof = TraceProfiler({"enabled": True}, None)  # non-zero rank: no log dir
+    prof.step(0)
+    prof.close()
