@@ -7,7 +7,9 @@ reference anchor is the README PPO wall-clock benchmark: 81.27 s for 65_536 step
 
 from __future__ import annotations
 
+import contextlib
 import json
+import sys
 import time
 
 
@@ -45,4 +47,8 @@ def bench_ppo(total_steps: int = 65536) -> dict:
 
 
 if __name__ == "__main__":
-    print(json.dumps(bench_ppo()))
+    # stdout must carry EXACTLY one JSON line: the CLI's config dump and progress
+    # prints go to stderr instead
+    with contextlib.redirect_stdout(sys.stderr):
+        result = bench_ppo()
+    print(json.dumps(result))

@@ -42,11 +42,25 @@ def test_player_params_sync_tracks_updates():
         np.testing.assert_allclose(np.asarray(pulled["head"]["w"]), 2 * scale)
 
 
-def test_player_device_selection():
+def test_player_device_selection(monkeypatch):
     on_host = Runtime(accelerator="cpu", devices=2, player_on_host=True)
-    assert on_host.player_device == jax.devices("cpu")[0]
     on_mesh = Runtime(accelerator="cpu", devices=2, player_on_host=False)
+    # On the CPU-only test mesh host_device == mesh device 0, which would make
+    # the assertions tautological; pretend the host CPU is a DIFFERENT device so
+    # the player_on_host branch is actually discriminated.
+    fake_host = jax.devices("cpu")[1]
+    real_devices = jax.devices
+
+    def fake_devices(platform=None):
+        if platform == "cpu":
+            return [fake_host]
+        return real_devices(platform)
+
+    monkeypatch.setattr(jax, "devices", fake_devices)
+    assert on_host.player_device == fake_host
+    assert on_host.player_device != on_host.device
     assert on_mesh.player_device == on_mesh.device
+    assert on_mesh.player_device != fake_host
 
 
 def test_trace_profiler_window(monkeypatch, tmp_path):
