@@ -87,6 +87,54 @@ def test_evaluate(tmp_path, monkeypatch):
     evaluation(overrides=[f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
 
 
+TINY_DV3 = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=2",
+    "buffer.size=16",
+    "algo.learning_starts=4",
+    "algo.total_steps=8",
+    "algo.replay_ratio=1",
+    "algo.horizon=4",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+]
+
+
+def test_resume_and_evaluate_dreamer_v3(tmp_path, monkeypatch):
+    """Checkpoint round-trip + eval-from-checkpoint for the flagship world model.
+
+    The first run checkpoints MID-run (checkpoint.every=4 < total_steps=8), so
+    the resume leg really trains iterations 5..8 with the restored optimizer /
+    Moments / Ratio state (resume keeps the sidecar config's total_steps: CLI
+    overrides other than checkpoint/seed/fabric are deliberately dropped on
+    resume, reference cli.py:23-57)."""
+    monkeypatch.chdir(tmp_path)
+    run(overrides=TINY_DV3 + ["checkpoint.save_last=True", "checkpoint.every=4"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts, "DV3 training did not write a checkpoint"
+    mid_ckpt = next(c for c in ckpts if "ckpt_4_" in os.path.basename(c))
+    run(overrides=TINY_DV3 + ["checkpoint.save_last=False", f"checkpoint.resume_from={mid_ckpt}"])
+    evaluation(overrides=[f"checkpoint_path={ckpts[-1]}", "env.capture_video=False"])
+
+
 def test_evaluate_requires_checkpoint_path():
     from sheeprl_tpu.config import ConfigError
 
