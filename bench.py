@@ -23,7 +23,7 @@ def bench_ppo(total_steps: int = 65536) -> dict:
             f"algo.total_steps={total_steps}",
             "algo.rollout_steps=128",
             "algo.per_rank_batch_size=64",
-            "env.num_envs=4",
+            "env.num_envs=8",
             "env.sync_env=True",
             "env.capture_video=False",
             "algo.mlp_keys.encoder=[state]",
