@@ -11,6 +11,7 @@ nothing in this module touches JAX.
 from __future__ import annotations
 
 import copy
+import os
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -325,6 +326,84 @@ class GrayscaleRenderWrapper(gym.Wrapper):
             if frame.ndim == 3 and frame.shape[-1] == 1:
                 frame = frame.repeat(3, axis=-1)
         return frame
+
+
+class FallbackRecordVideo(gym.Wrapper):
+    """Per-episode GIF recorder used when gymnasium's RecordVideo is unavailable.
+
+    gymnasium's recorder needs moviepy (an optional extra); this fallback writes
+    ``episode_<n>.gif`` via PIL — always present — so ``env.capture_video=True``
+    stays functional in minimal images. Same placement in the wrapper stack as
+    RecordVideo (reference sheeprl/utils/env.py:222-228).
+    """
+
+    # RecordVideo's default schedule: episodes 0, 1, 8, 27, ... k^3, then every 1000
+    @staticmethod
+    def _default_trigger(episode: int) -> bool:
+        if episode < 1000:
+            return round(episode ** (1.0 / 3)) ** 3 == episode
+        return episode % 1000 == 0
+
+    def __init__(self, env: gym.Env, video_dir: str, fps: int = 30,
+                 episode_trigger=None, max_frames: int = 5000):
+        super().__init__(env)
+        self._video_dir = video_dir
+        self._fps = fps
+        self._trigger = episode_trigger or self._default_trigger
+        self._max_frames = max_frames
+        self._frames: list = []
+        self._episode = 0
+        self._recording = False
+
+    def _grab(self) -> None:
+        if not self._recording or len(self._frames) >= self._max_frames:
+            return
+        frame = self.env.render()
+        if isinstance(frame, np.ndarray) and frame.ndim == 3:
+            frame = np.asarray(frame, dtype=np.uint8)
+            if frame.shape[-1] == 1:  # PIL cannot convert (H, W, 1)
+                frame = frame.repeat(3, axis=-1)
+            self._frames.append(frame)
+
+    def _flush(self) -> None:
+        frames, self._frames = self._frames, []
+        if not frames:
+            return
+        try:
+            from PIL import Image
+
+            os.makedirs(self._video_dir, exist_ok=True)
+            imgs = [Image.fromarray(f) for f in frames]
+            imgs[0].save(
+                os.path.join(self._video_dir, f"episode_{self._episode}.gif"),
+                save_all=True,
+                append_images=imgs[1:],
+                duration=max(1000 // self._fps, 20),
+                loop=0,
+            )
+        except Exception as e:  # pragma: no cover - best effort
+            gym.logger.warn(f"FallbackRecordVideo failed to write the episode gif: {e}")
+
+    def reset(self, **kwargs):
+        if self._frames:  # partial episode (early reset / crash recovery)
+            self._flush()
+            self._episode += 1  # the partial recording consumed this index
+        out = self.env.reset(**kwargs)
+        self._recording = self._trigger(self._episode)
+        self._grab()
+        return out
+
+    def step(self, action):
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        self._grab()
+        if terminated or truncated:
+            self._flush()
+            self._episode += 1
+        return obs, reward, terminated, truncated, info
+
+    def close(self):
+        self._flush()
+        return self.env.close()
 
 
 class ActionsAsObservationWrapper(gym.Wrapper):

@@ -148,14 +148,22 @@ def make_env(
         if cfg.env.capture_video and rank == 0 and vector_env_idx == 0 and run_name is not None:
             if cfg.env.grayscale:
                 env = GrayscaleRenderWrapper(env)
+            video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
             try:
-                env = gym.wrappers.RecordVideo(
-                    env,
-                    os.path.join(run_name, prefix + "_videos" if prefix else "videos"),
-                    disable_logger=True,
-                )
-            except Exception as e:  # pragma: no cover - video deps are optional
-                warnings.warn(f"Could not enable video capture: {e}")
+                env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
+            except Exception as e:
+                # gymnasium's recorder needs moviepy + an rgb_array render mode;
+                # fall back to the PIL GIF recorder when the env can render at all
+                if getattr(env, "render_mode", None) == "rgb_array":
+                    from sheeprl_tpu.envs.wrappers import FallbackRecordVideo
+
+                    warnings.warn(
+                        f"gymnasium RecordVideo unavailable ({e}); recording per-episode "
+                        "GIFs via the PIL fallback instead"
+                    )
+                    env = FallbackRecordVideo(env, video_dir)
+                else:
+                    warnings.warn(f"Could not enable video capture: {e}")
         return env
 
     return thunk

@@ -8,6 +8,7 @@ import pytest
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     ActionsAsObservationWrapper,
+    FallbackRecordVideo,
     FrameStack,
     RestartOnException,
     RewardAsObservationWrapper,
@@ -141,3 +142,61 @@ def test_actions_as_observation_discrete_one_hot():
 def test_actions_as_observation_rejects_bad_noop():
     with pytest.raises(ValueError):
         ActionsAsObservationWrapper(_CountingEnv(), num_stack=2, noop=[0, 1])
+
+
+class _RenderingEnv(_CountingEnv):
+    render_mode = "rgb_array"
+
+    def render(self):
+        return np.full((8, 8, 3), self._t % 256, dtype=np.uint8)
+
+
+def test_fallback_record_video_writes_gifs(tmp_path):
+    env = FallbackRecordVideo(_RenderingEnv(episode_len=3), str(tmp_path / "vids"), fps=10)
+    env.reset()
+    for ep in range(2):
+        done = False
+        while not done:
+            _, _, terminated, truncated, _ = env.step(0)
+            done = terminated or truncated
+        if ep == 0:
+            env.reset()
+    env.close()
+    gifs = sorted(p.name for p in (tmp_path / "vids").glob("*.gif"))
+    assert gifs == ["episode_0.gif", "episode_1.gif"]
+    assert (tmp_path / "vids" / "episode_0.gif").stat().st_size > 0
+
+
+def test_fallback_record_video_partial_episode_keeps_index(tmp_path):
+    """An early reset flushes the partial recording WITHOUT overwriting it later."""
+    env = FallbackRecordVideo(_RenderingEnv(episode_len=10), str(tmp_path / "vids"), fps=10)
+    env.reset()
+    env.step(0)
+    env.reset()  # mid-episode: partial episode_0.gif, index advances
+    done = False
+    while not done:
+        _, _, terminated, truncated, _ = env.step(0)
+        done = terminated or truncated
+    env.close()
+    gifs = sorted(p.name for p in (tmp_path / "vids").glob("*.gif"))
+    assert gifs == ["episode_0.gif", "episode_1.gif"]
+
+
+def test_fallback_record_video_trigger_and_frame_cap(tmp_path):
+    env = FallbackRecordVideo(
+        _RenderingEnv(episode_len=6),
+        str(tmp_path / "vids"),
+        fps=10,
+        episode_trigger=lambda ep: ep == 1,
+        max_frames=3,
+    )
+    for _ in range(2):
+        env.reset()
+        done = False
+        while not done:
+            _, _, terminated, truncated, _ = env.step(0)
+            done = terminated or truncated
+    env.close()
+    gifs = sorted(p.name for p in (tmp_path / "vids").glob("*.gif"))
+    assert gifs == ["episode_1.gif"]  # episode 0 skipped by the trigger
+    assert len(env._frames) == 0
