@@ -1,0 +1,30 @@
+"""Every shipped experiment recipe must compose (config-rot guard).
+
+P2E finetuning recipes intentionally require checkpoint.exploration_ckpt_path
+(mandatory ``???``), so they compose only with it supplied.
+"""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.config import compose
+
+_EXP_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "sheeprl_tpu",
+    "configs",
+    "exp",
+)
+ALL_EXPS = sorted(f[:-5] for f in os.listdir(_EXP_DIR) if f.endswith(".yaml") and f != "default.yaml")
+
+
+@pytest.mark.parametrize("exp", ALL_EXPS)
+def test_exp_recipe_composes(exp):
+    overrides = [f"exp={exp}"]
+    if "fntn" in exp or "finetuning" in exp:
+        overrides.append("checkpoint.exploration_ckpt_path=/tmp/placeholder.ckpt")
+    cfg = compose(overrides=overrides)
+    assert cfg.algo.name
+    assert cfg.env.wrapper.get("_target_")
+    assert cfg.fabric.precision in ("32-true", "32", "bf16-mixed", "bf16-true", "16-mixed")
