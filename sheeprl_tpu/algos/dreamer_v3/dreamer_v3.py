@@ -27,6 +27,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput, DV3Modules, build_agent
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
+    get_action_masks,
     MomentsState,
     compute_lambda_values,
     init_moments,
@@ -535,7 +536,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+                mask = get_action_masks(jax_obs)
                 rng, act_key = jax.random.split(rng)
                 actions_list = player.get_actions(jax_obs, act_key, mask=mask)
                 actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)

@@ -86,6 +86,15 @@ def compute_lambda_values(
     return out[::-1]
 
 
+def get_action_masks(jax_obs: Dict[str, jax.Array]):
+    """MineDojo-style per-step action masks from the obs dict, or None.
+
+    Single source for the ``mask_*``-key convention consumed by MinedojoActor
+    sampling (reference dreamer_v3.py:616-628).
+    """
+    return {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+
+
 def prepare_obs(
     runtime, obs: Dict[str, np.ndarray], *, cnn_keys: Sequence[str] = (), num_envs: int = 1, **kwargs
 ) -> Dict[str, jax.Array]:
@@ -115,7 +124,7 @@ def test(player, runtime, cfg, log_dir: str, test_name: str = "", greedy: bool =
     while not done:
         key, step_key = jax.random.split(key)
         jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
-        mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+        mask = get_action_masks(jax_obs)
         actions_list = player.get_actions(jax_obs, step_key, greedy=greedy, mask=mask)
         if player.actor.is_continuous:
             real_actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)

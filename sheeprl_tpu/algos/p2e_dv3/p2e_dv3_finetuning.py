@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import DV3OptStates, make_train_fn
-from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test, get_action_masks
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
@@ -225,7 +225,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
         with timer("Time/env_interaction_time", SumMetric()):
             jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-            mask = {k: v for k, v in jax_obs.items() if k.startswith("mask")} or None
+            mask = get_action_masks(jax_obs)
             rng, act_key = jax.random.split(rng)
             actions_list = player.get_actions(jax_obs, act_key, mask=mask)
             actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
