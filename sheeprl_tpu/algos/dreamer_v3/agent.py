@@ -400,6 +400,9 @@ class Actor(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
+    # rollout-time masked sampling is an actor property, not a player branch
+    uses_action_mask: bool = False
+
     def resolved_distribution(self) -> str:
         dist = self.distribution.lower()
         if dist not in ("auto", "normal", "tanh_normal", "discrete", "scaled_normal"):
@@ -412,6 +415,10 @@ class Actor(nn.Module):
         if dist == "auto":
             dist = "scaled_normal" if self.is_continuous else "discrete"
         return dist
+
+    def sample(self, pre_dist: List[jax.Array], key: jax.Array, greedy: bool = False, mask=None) -> List[jax.Array]:
+        """Turn raw head outputs into env actions; subclasses may consume ``mask``."""
+        return ActorOutput(self, pre_dist).sample_actions(key, greedy=greedy)
 
     @nn.compact
     def __call__(self, state: jax.Array) -> List[jax.Array]:
@@ -453,6 +460,11 @@ class MinedojoActor(Actor):
     """DV3 actor for MineDojo (reference agent.py:848-934): same parameters as
     `Actor`, but rollout-time sampling applies the env-provided action masks —
     see `sample_minedojo_actions`. Selected via ``cfg.algo.actor.cls``."""
+
+    uses_action_mask: bool = True
+
+    def sample(self, pre_dist: List[jax.Array], key: jax.Array, greedy: bool = False, mask=None) -> List[jax.Array]:
+        return sample_minedojo_actions(self, pre_dist, mask, key, greedy=greedy)
 
 
 def sample_minedojo_actions(
@@ -749,12 +761,10 @@ class PlayerDV3:
 
     def _actor_step(self, actor_params, latent, key, greedy: bool = False, mask=None):
         """Sample actions from the latent; subclasses override to change how the
-        actor is queried (e.g. PonderNet inference-mode halting in PlayerDAP).
-        The mask only matters for the MinedojoActor (reference agent.py:710-744)."""
+        actor is queried (e.g. PonderNet inference-mode halting in PlayerDAP);
+        mask consumption is the actor's own concern (Actor.sample)."""
         pre_dist = self.actor.apply(actor_params, latent)
-        if isinstance(self.actor, MinedojoActor):
-            return sample_minedojo_actions(self.actor, pre_dist, mask, key, greedy=greedy)
-        return ActorOutput(self.actor, pre_dist).sample_actions(key, greedy=greedy)
+        return self.actor.sample(pre_dist, key, greedy=greedy, mask=mask)
 
     def _raw_step(self, wm_params, actor_params, state, obs, key, greedy: bool = False, mask=None):
         recurrent_state, stochastic_state, actions = state
@@ -789,8 +799,9 @@ class PlayerDV3:
             )
 
     def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
-        if not isinstance(self.actor, MinedojoActor):
-            mask = None  # action masking only used by MinedojoActor
+        # getattr: custom actors (e.g. PonderActor) aren't Actor subclasses
+        if not getattr(self.actor, "uses_action_mask", False):
+            mask = None  # avoids re-tracing _step on mask presence for mask-free actors
         actions_list, self.state = self._step(
             self.wm_params, self.actor_params, self.state, obs, key, greedy=greedy, mask=mask
         )
