@@ -430,6 +430,9 @@ class ActorDV2(nn.Module):
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
+    # rollout-time masked sampling is an actor property, not a player branch
+    uses_action_mask: bool = False
+
     def resolved_distribution(self) -> str:
         dist = self.distribution.lower()
         if dist not in ("auto", "normal", "tanh_normal", "discrete", "trunc_normal"):
@@ -442,6 +445,15 @@ class ActorDV2(nn.Module):
         if dist == "auto":
             dist = "trunc_normal" if self.is_continuous else "discrete"
         return dist
+
+    def sample(self, pre_dist: List[jax.Array], key: jax.Array, greedy: bool = False, mask=None) -> List[jax.Array]:
+        """Turn raw head outputs into env actions; subclasses may consume ``mask``."""
+        return ActorOutputDV2(self, pre_dist).sample_actions(key, greedy=greedy)
+
+    def exploration_noise(
+        self, actions: List[jax.Array], expl_amount: jax.Array, key: jax.Array, mask=None
+    ) -> List[jax.Array]:
+        return add_exploration_noise(actions, expl_amount, self.is_continuous, self.actions_dim, key)
 
     @nn.compact
     def __call__(self, state: jax.Array) -> List[jax.Array]:
@@ -557,6 +569,62 @@ def add_exploration_noise(
     return out
 
 
+def add_exploration_noise_minedojo(
+    actions: List[jax.Array], expl_amount: jax.Array, key: jax.Array, mask: Dict[str, jax.Array]
+) -> List[jax.Array]:
+    """Mask-respecting epsilon-random exploration for the three MineDojo heads.
+
+    Reference MinedojoActor.add_exploration_noise (dreamer_v2/agent.py:720-776):
+    exploratory actions are drawn uniformly over the VALID actions, and when
+    exploration flips head 0 onto a functional macro (15-18), heads 1-2 are
+    forcibly resampled so the triple satisfies the env constraints. (The
+    reference samples its replacement from unmasked uniform logits despite
+    building the masked logits first — here the masked logits are actually
+    used, which is the documented intent.)
+    """
+    from sheeprl_tpu.algos.dreamer_v3.agent import minedojo_mask_logits
+
+    expl: List[jax.Array] = []
+    functional_action = actions[0].argmax(axis=-1)
+    for i, act in enumerate(actions):
+        k_sample, k_replace, key = jax.random.split(key, 3)
+        logits = minedojo_mask_logits(jnp.zeros_like(act), i, mask, functional_action)
+        random_act = OneHotCategorical(logits=logits).sample(k_sample)
+        replace = jax.random.uniform(k_replace, act.shape[:-1]) < expl_amount
+        if i > 0:
+            # head 0 was flipped onto a functional macro -> heads 1/2 must follow
+            forced = (actions[0].argmax(axis=-1) != functional_action) & (
+                (functional_action >= 15) & (functional_action <= 18)
+            )
+            replace = replace | forced
+        expl.append(jnp.where(replace[..., None], random_act, act))
+        if i == 0:
+            functional_action = expl[0].argmax(axis=-1)
+    return expl
+
+
+class MinedojoActorDV2(ActorDV2):
+    """DV2 actor for MineDojo (reference dreamer_v2/agent.py:626-776): same
+    parameters as `ActorDV2`, with mask-aware rollout sampling and exploration
+    noise. Selected via ``cfg.algo.actor.cls``."""
+
+    uses_action_mask: bool = True
+
+    def sample(self, pre_dist: List[jax.Array], key: jax.Array, greedy: bool = False, mask=None) -> List[jax.Array]:
+        if mask is None:
+            return super().sample(pre_dist, key, greedy=greedy)
+        from sheeprl_tpu.algos.dreamer_v3.agent import sample_minedojo_actions
+
+        return sample_minedojo_actions(self, pre_dist, mask, key, greedy=greedy)
+
+    def exploration_noise(
+        self, actions: List[jax.Array], expl_amount: jax.Array, key: jax.Array, mask=None
+    ) -> List[jax.Array]:
+        if mask is None:
+            return super().exploration_noise(actions, expl_amount, key)
+        return add_exploration_noise_minedojo(actions, expl_amount, key, mask)
+
+
 class PlayerDV2:
     """Stateful host-side rollout policy over a single jitted step (reference agent.py:804-914)."""
 
@@ -586,7 +654,7 @@ class PlayerDV2:
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
 
-    def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False):
+    def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False, mask=None):
         recurrent_state, stochastic_state, actions = state
         k_rep, k_act, k_expl = jax.random.split(key, 3)
         embedded = self.encoder.apply(wm_params["encoder"], obs)
@@ -594,12 +662,10 @@ class PlayerDV2:
         _, stoch = self.rssm._representation(wm_params, recurrent_state, embedded, k_rep)
         stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
         latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
-        out = ActorOutputDV2(self.actor, self.actor.apply(actor_params, latent))
-        actions_list = out.sample_actions(k_act, greedy=greedy)
+        pre_dist = self.actor.apply(actor_params, latent)
+        actions_list = self.actor.sample(pre_dist, k_act, greedy=greedy, mask=mask)
         if not greedy:  # exploration noise is a training-only behavior (reference get_actions adds none)
-            actions_list = add_exploration_noise(
-                actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
-            )
+            actions_list = self.actor.exploration_noise(actions_list, expl_amount, k_expl, mask=mask)
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 
@@ -622,7 +688,8 @@ class PlayerDV2:
             )
 
     def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False, mask=None):
-        del mask
+        if not getattr(self.actor, "uses_action_mask", False):
+            mask = None  # avoids re-tracing _step on mask presence for mask-free actors
         actions_list, self.state = self._step(
             self.wm_params,
             self.actor_params,
@@ -631,6 +698,7 @@ class PlayerDV2:
             key,
             jnp.float32(self.expl_amount),
             greedy=greedy,
+            mask=mask,
         )
         return actions_list
 
@@ -797,7 +865,10 @@ def build_agent(
         else None
     )
 
-    actor = ActorDV2(
+    # Config-selected actor class (reference hydra.utils.get_class on
+    # cfg.algo.actor.cls, agent.py:1022): MinedojoActorDV2 adds masked sampling
+    actor_cls = MinedojoActorDV2 if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else ActorDV2
+    actor = actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,

@@ -29,6 +29,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sheeprl_tpu.algos.dreamer_v2.agent import ActorOutputDV2, DV2Modules, build_agent, expl_amount_schedule
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v3.utils import get_action_masks
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
@@ -535,13 +536,12 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
             else:
                 jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                mask = get_action_masks(jax_obs)
                 rng, act_key = jax.random.split(rng)
                 player.expl_amount = expl_amount_schedule(
                     base_expl_amount, expl_decay, expl_min, policy_step
                 )
-                # NOTE: DV2 has no mask-consuming actor here (the reference's DV2
-                # MinedojoActor, agent.py:626, is a PARITY.md gap)
-                actions_list = player.get_actions(jax_obs, act_key)
+                actions_list = player.get_actions(jax_obs, act_key, mask=mask)
                 actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
                 if is_continuous:
                     real_actions = actions

@@ -12,6 +12,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.algos.dreamer_v3.utils import get_action_masks
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",
@@ -87,7 +89,8 @@ def test(player, runtime, cfg, log_dir: str, test_name: str = "", greedy: bool =
     while not done:
         key, step_key = jax.random.split(key)
         jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder)
-        actions_list = player.get_actions(jax_obs, step_key, greedy=greedy)
+        mask = get_action_masks(jax_obs)
+        actions_list = player.get_actions(jax_obs, step_key, greedy=greedy, mask=mask)
         if player.actor.is_continuous:
             real_actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
         else:

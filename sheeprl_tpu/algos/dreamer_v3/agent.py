@@ -468,7 +468,7 @@ class MinedojoActor(Actor):
 
 
 def sample_minedojo_actions(
-    actor: Actor,
+    actor,
     pre_dist: List[jax.Array],
     mask: Optional[Dict[str, jax.Array]],
     key: jax.Array,
@@ -487,31 +487,42 @@ def sample_minedojo_actions(
     if mask is None:
         return ActorOutput(actor, pre_dist).sample_actions(key, greedy=greedy)
 
-    def masked(logits, m):
-        m = jnp.broadcast_to(jnp.asarray(m, dtype=bool), logits.shape)
-        return jnp.where(m, logits, -jnp.inf)
-
     keys = jax.random.split(key, len(pre_dist))
     actions: List[jax.Array] = []
     functional_action = None
     for i, logits in enumerate(pre_dist):
-        logits = uniform_mix(logits, logits.shape[-1], actor.unimix)
-        if i == 0:
-            logits = masked(logits, mask["mask_action_type"])
-        elif i == 1:
-            craft_masked = masked(logits, mask["mask_craft_smelt"])
-            logits = jnp.where((functional_action == 15)[..., None], craft_masked, logits)
-        elif i == 2:
-            equip_masked = masked(logits, mask["mask_equip_place"])
-            destroy_masked = masked(logits, mask["mask_destroy"])
-            is_equip_place = ((functional_action == 16) | (functional_action == 17))[..., None]
-            logits = jnp.where(is_equip_place, equip_masked, logits)
-            logits = jnp.where((functional_action == 18)[..., None], destroy_masked, logits)
+        logits = uniform_mix(logits, logits.shape[-1], getattr(actor, "unimix", 0.0))
+        logits = minedojo_mask_logits(logits, i, mask, functional_action)
         dist = OneHotCategoricalStraightThrough(logits=logits)
         actions.append(dist.mode if greedy else dist.rsample(keys[i]))
         if functional_action is None:
             functional_action = actions[0].argmax(axis=-1)
     return actions
+
+
+def minedojo_mask_logits(
+    logits: jax.Array, head: int, mask: Dict[str, jax.Array], functional_action: Optional[jax.Array]
+) -> jax.Array:
+    """-inf-mask one MineDojo head's logits per the env constraints.
+
+    Head 0: ``mask_action_type``. Head 1: ``mask_craft_smelt`` when the sampled
+    macro is 15 (craft). Head 2: ``mask_equip_place`` for macros 16/17,
+    ``mask_destroy`` for macro 18. Single source for the macro->mask mapping
+    (used by DV3/DV2 sampling AND the DV2 masked exploration noise); batched
+    `jnp.where` instead of the reference's per-[t,b] Python loops.
+    """
+
+    def masked(m):
+        m = jnp.broadcast_to(jnp.asarray(m, dtype=bool), logits.shape)
+        return jnp.where(m, logits, -jnp.inf)
+
+    if head == 0:
+        return masked(mask["mask_action_type"])
+    if head == 1:
+        return jnp.where((functional_action == 15)[..., None], masked(mask["mask_craft_smelt"]), logits)
+    is_equip_place = ((functional_action == 16) | (functional_action == 17))[..., None]
+    out = jnp.where(is_equip_place, masked(mask["mask_equip_place"]), logits)
+    return jnp.where((functional_action == 18)[..., None], masked(mask["mask_destroy"]), out)
 
 
 class ActorOutput:
@@ -538,7 +549,7 @@ class ActorOutput:
                 self.dists = [Independent(Normal(jnp.tanh(mean), std), 1)]
         else:
             self.dists = [
-                OneHotCategoricalStraightThrough(logits=uniform_mix(logits, logits.shape[-1], actor.unimix))
+                OneHotCategoricalStraightThrough(logits=uniform_mix(logits, logits.shape[-1], getattr(actor, "unimix", 0.0)))
                 for logits in pre_dist
             ]
 

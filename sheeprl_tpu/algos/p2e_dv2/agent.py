@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from sheeprl_tpu.algos.dreamer_v2.agent import (
     ActorDV2,
+    MinedojoActorDV2,
     DV2Modules,
     MLPWithHeadDV2,
     MultiDecoderDV2,
@@ -102,7 +103,9 @@ def build_agent(
     )
     player.actor_type = cfg.algo.player.actor_type
 
-    actor_task = ActorDV2(
+    # Config-selected actor class (MinedojoActorDV2 adds masked sampling)
+    actor_cls = MinedojoActorDV2 if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else ActorDV2
+    actor_task = actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,

@@ -244,6 +244,45 @@ def test_minedojo_actor_masked_sampling():
     assert (actions[1].argmax(-1) == 2).all()  # craft head masked because macro==15
 
 
+def test_minedojo_actor_dv2_masked_sampling_and_exploration():
+    """DV2-level MineDojo actor: masked sampling + mask-respecting exploration
+    noise (reference dreamer_v2/agent.py:626-776)."""
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.dreamer_v2.agent import MinedojoActorDV2, add_exploration_noise_minedojo
+
+    actor = MinedojoActorDV2(
+        latent_state_size=8,
+        actions_dim=(19, 4, 6),
+        is_continuous=False,
+        dense_units=8,
+        mlp_layers=1,
+    )
+    assert actor.uses_action_mask
+    params = actor.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    pre_dist = actor.apply(params, jnp.zeros((3, 8)))
+    mask = {
+        "mask_action_type": jnp.zeros((3, 19), bool).at[:, 15].set(True),
+        "mask_craft_smelt": jnp.zeros((3, 4), bool).at[:, 2].set(True),
+        "mask_equip_place": jnp.ones((3, 6), bool),
+        "mask_destroy": jnp.ones((3, 6), bool),
+    }
+    actions = actor.sample(pre_dist, jax.random.PRNGKey(1), mask=mask)
+    assert (actions[0].argmax(-1) == 15).all()
+    assert (actions[1].argmax(-1) == 2).all()
+
+    # exploration with amount=1 must still respect the masks: every exploratory
+    # macro is 15 and every exploratory craft target is 2
+    expl = add_exploration_noise_minedojo(actions, jnp.float32(1.0), jax.random.PRNGKey(2), mask)
+    assert (expl[0].argmax(-1) == 15).all()
+    assert (expl[1].argmax(-1) == 2).all()
+    # amount=0 leaves the actions untouched
+    same = add_exploration_noise_minedojo(actions, jnp.float32(0.0), jax.random.PRNGKey(3), mask)
+    for a, b in zip(actions, same):
+        assert (jnp.asarray(a) == jnp.asarray(b)).all()
+
+
 @pytest.mark.skipif(not imports_mod._IS_DMC_AVAILABLE, reason="dm_control not installed")
 def test_dmc_wrapper_real_env(monkeypatch):
     """dm_control is present in the image: exercise the real adapter (headless EGL)."""
