@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     xavier_normal_init,
 )
 from sheeprl_tpu.models.models import MLP
+from sheeprl_tpu.utils.utils import host_float32
 
 
 def compute_stochastic_state(
@@ -198,6 +199,7 @@ class PlayerDV1:
             actions_list = add_exploration_noise(
                 actions_list, expl_amount, self.actor.is_continuous, self.actions_dim, k_expl
             )
+        actions_list = host_float32(actions_list)
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 

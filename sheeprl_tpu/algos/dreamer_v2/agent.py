@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import MLP, CNN, DeCNN, LayerNormGRUCell
+from sheeprl_tpu.utils.utils import host_float32, resolve_actor_cls
 from sheeprl_tpu.ops.distributions import (
     Independent,
     Normal,
@@ -666,6 +667,7 @@ class PlayerDV2:
         actions_list = self.actor.sample(pre_dist, k_act, greedy=greedy, mask=mask)
         if not greedy:  # exploration noise is a training-only behavior (reference get_actions adds none)
             actions_list = self.actor.exploration_noise(actions_list, expl_amount, k_expl, mask=mask)
+        actions_list = host_float32(actions_list)
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 
@@ -867,7 +869,7 @@ def build_agent(
 
     # Config-selected actor class (reference hydra.utils.get_class on
     # cfg.algo.actor.cls, agent.py:1022): MinedojoActorDV2 adds masked sampling
-    actor_cls = MinedojoActorDV2 if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else ActorDV2
+    actor_cls = resolve_actor_cls(actor_cfg.get("cls"), ActorDV2, MinedojoActorDV2)
     actor = actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),

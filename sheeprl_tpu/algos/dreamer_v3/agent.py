@@ -38,7 +38,7 @@ from sheeprl_tpu.ops.distributions import (
     OneHotCategoricalStraightThrough,
     TanhNormal,
 )
-from sheeprl_tpu.utils.utils import symlog
+from sheeprl_tpu.utils.utils import host_float32, resolve_actor_cls, symlog
 
 # Hafner initializers (reference dreamer_v3/utils.py:init_weights / uniform_init_weights):
 # trunc-normal with std = sqrt(1/fan_avg)/0.8796...  == variance_scaling truncated_normal;
@@ -325,6 +325,7 @@ class RecurrentModel(nn.Module):
             hidden_size=self.recurrent_state_size,
             bias=False,
             layer_norm=True,
+            layer_norm_eps=self.layer_norm_eps,
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=hafner_trunc_init,
@@ -788,7 +789,7 @@ class PlayerDV3:
             _, stoch = self.rssm._representation(wm_params, embedded, k_rep, recurrent_state=recurrent_state)
         stochastic_state = stoch.reshape(*stoch.shape[:-2], self.stochastic_size * self.discrete_size)
         latent = jnp.concatenate([stochastic_state, recurrent_state], axis=-1)
-        actions_list = self._actor_step(actor_params, latent, k_act, greedy=greedy, mask=mask)
+        actions_list = host_float32(self._actor_step(actor_params, latent, k_act, greedy=greedy, mask=mask))
         actions = jnp.concatenate(actions_list, axis=-1)
         return tuple(actions_list), (recurrent_state, stochastic_state, actions)
 
@@ -1029,7 +1030,7 @@ def build_agent(
     actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
     # Config-selected actor class (reference uses hydra.utils.get_class on
     # cfg.algo.actor.cls, agent.py:1184): MinedojoActor adds rollout-time masking
-    actor_cls = MinedojoActor if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else Actor
+    actor_cls = resolve_actor_cls(actor_cfg.get("cls"), Actor, MinedojoActor)
     actor = None if not build_actor else actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),

@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
     BernoulliSafeMode,
@@ -495,6 +496,13 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
+    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train call
+    # is sampled + device_put while the current train step still occupies the chip
+    # (reference counterpart: sample_tensors' pinned-memory non_blocking path,
+    # sheeprl/data/buffers.py:290-326).
+    batch_sharding = NamedSharding(runtime.mesh, P(None, None, "data"))
+    prefetcher = DevicePrefetcher(rb.sample, device=batch_sharding)
+
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
@@ -548,7 +556,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     )
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's concurrent sample
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -608,7 +617,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
@@ -621,17 +631,20 @@ def main(runtime, cfg: Dict[str, Any]):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                # steady-state: this consumes the batch prefetched during the previous
+                # train step and immediately starts speculating the next one
+                batches = prefetcher.get(
+                    batch_size=cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric()):
-                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, moments_state, counter, train_metrics = train_fn(
                         params, opt_states, moments_state, counter, batches, train_key
                     )
+                    # keep Time/train_time honest (async dispatch returns instantly);
+                    # the prefetch worker overlaps the next sample+transfer regardless
                     jax.block_until_ready(params)
                     player.wm_params = params["world_model"]
                     player.actor_params = params["actor"]
@@ -698,6 +711,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    prefetcher.close()
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

@@ -28,6 +28,7 @@ from sheeprl_tpu.algos.sac.sac import SACOptStates
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -266,6 +267,28 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
+    def sample_critic_batches(g: int):
+        bs = cfg.algo.per_rank_batch_size * world_size
+        sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
+        return {k: np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]) for k, v in sample.items()}
+
+    def sample_actor_batch():
+        sample = rb.sample(batch_size=cfg.algo.per_rank_batch_size * world_size)
+        return {k: np.asarray(v[0], dtype=np.float32) for k, v in sample.items()}
+
+    # Double-buffered host->HBM pipelines (see sheeprl_tpu/data/prefetch.py); the
+    # shared io_lock serializes the two workers' samples (one np.random.Generator)
+    # and the loop's rb.add against both.
+    import threading
+
+    buffer_io_lock = threading.Lock()
+    critic_prefetcher = DevicePrefetcher(
+        sample_critic_batches, device=NamedSharding(runtime.mesh, P(None, "data")), io_lock=buffer_io_lock
+    )
+    actor_prefetcher = DevicePrefetcher(
+        sample_actor_batch, device=NamedSharding(runtime.mesh, P("data")), io_lock=buffer_io_lock
+    )
+
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     mlp_keys = cfg.algo.mlp_keys.encoder
@@ -304,7 +327,8 @@ def main(runtime, cfg: Dict[str, Any]):
         }
         if not cfg.buffer.sample_next_obs:
             step_data["next_observations"] = real_next_obs[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        with critic_prefetcher.guard():  # shared io_lock with actor_prefetcher
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs_vec = next_obs_vec
 
         if cfg.metric.log_level > 0:
@@ -318,22 +342,17 @@ def main(runtime, cfg: Dict[str, Any]):
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
             if per_rank_gradient_steps > 0:
+                g = per_rank_gradient_steps
+                # both batches prefetched during the previous train step (see
+                # sheeprl_tpu/data/prefetch.py); kwargs change -> sync fallback
+                critic_batches = critic_prefetcher.get(g=g)
+                actor_batch = actor_prefetcher.get()
                 with timer("Time/train_time", SumMetric()):
-                    g = per_rank_gradient_steps
-                    bs = cfg.algo.per_rank_batch_size * world_size
-                    critic_sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
-                    critic_batches = {
-                        k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]))
-                        for k, v in critic_sample.items()
-                    }
-                    actor_sample = rb.sample(batch_size=bs)
-                    actor_batch = {
-                        k: jnp.asarray(np.asarray(v[0], dtype=np.float32)) for k, v in actor_sample.items()
-                    }
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, train_metrics = train_fn(
                         params, opt_states, critic_batches, actor_batch, train_key
                     )
+                    # keep Time/train_time honest; the prefetch workers overlap anyway
                     jax.block_until_ready(params.actor)
                     player.params = params.actor
                 train_step += world_size * g
@@ -385,6 +404,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    critic_prefetcher.close()
+    actor_prefetcher.close()
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.dreamer_v2.agent import (
     build_agent as dv2_build_agent,
 )
 from sheeprl_tpu.algos.p2e_dv1.agent import Ensembles
+from sheeprl_tpu.utils.utils import resolve_actor_cls
 
 # Exposed for config-driven class selection (reference p2e_dv2/agent.py:23-24).
 Actor = ActorDV2
@@ -104,7 +105,7 @@ def build_agent(
     player.actor_type = cfg.algo.player.actor_type
 
     # Config-selected actor class (MinedojoActorDV2 adds masked sampling)
-    actor_cls = MinedojoActorDV2 if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else ActorDV2
+    actor_cls = resolve_actor_cls(actor_cfg.get("cls"), ActorDV2, MinedojoActorDV2)
     actor_task = actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),

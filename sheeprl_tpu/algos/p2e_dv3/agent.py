@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
 )
 from sheeprl_tpu.algos.dreamer_v3.agent import _ln_enabled
 from sheeprl_tpu.algos.p2e_dv1.agent import Ensembles
+from sheeprl_tpu.utils.utils import resolve_actor_cls
 
 # Exposed for config-driven class selection (reference p2e_dv3/agent.py:23-24).
 Actor = DV3Actor
@@ -121,7 +122,7 @@ def build_agent(
     player.actor_type = cfg.algo.player.actor_type
 
     actor_ln, actor_eps = _ln_enabled(actor_cfg.get("layer_norm"))
-    expl_actor_cls = MinedojoActor if str(actor_cfg.get("cls", "")).endswith("MinedojoActor") else Actor
+    expl_actor_cls = resolve_actor_cls(actor_cfg.get("cls"), Actor, MinedojoActor)
     actor_exploration = expl_actor_cls(
         latent_state_size=latent_state_size,
         actions_dim=tuple(actions_dim),

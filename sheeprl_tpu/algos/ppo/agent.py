@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from sheeprl_tpu.models.models import MLP, MultiEncoder, NatureCNN
 from sheeprl_tpu.ops.distributions import Independent, Normal, OneHotCategorical
-from sheeprl_tpu.utils.utils import safeatanh, safetanh
+from sheeprl_tpu.utils.utils import host_float32, safeatanh, safetanh
 
 
 class CNNEncoder(nn.Module):
@@ -231,17 +231,19 @@ class PPOPlayer:
             actor_outs, values = agent.apply(params, obs)
             actions = sample_actions(actor_outs, sub, agent.is_continuous, agent.distribution)
             logp, _ = evaluate_actions(actor_outs, actions, agent.is_continuous, agent.distribution)
-            return jnp.concatenate(actions, -1), _env_actions(actions), logp, values, key
+            # host_float32: rollout products are pulled to host / stored f32 (bf16
+            # degrades to |V2 through the remote-TPU tunnel)
+            return host_float32((jnp.concatenate(actions, -1), _env_actions(actions), logp, values)) + (key,)
 
         def _greedy(params, obs, key):
             key, sub = jax.random.split(key)
             actor_outs, _ = agent.apply(params, obs)
             actions = sample_actions(actor_outs, sub, agent.is_continuous, agent.distribution, greedy=True)
-            return _env_actions(actions), key
+            return host_float32(_env_actions(actions)), key
 
         def _values(params, obs):
             _, values = agent.apply(params, obs)
-            return values
+            return host_float32(values)
 
         self._act = jax.jit(_act)
         self._greedy = jax.jit(_greedy)

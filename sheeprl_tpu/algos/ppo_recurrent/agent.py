@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder, evaluate_actions, sample_actions
 from sheeprl_tpu.models.models import MLP, MultiEncoder
+from sheeprl_tpu.utils.utils import host_float32
 
 
 class RecurrentModel(nn.Module):
@@ -178,11 +179,13 @@ class RecurrentPPOPlayer:
                 [a[0] for a in actor_outs], actions, agent.is_continuous, agent.distribution
             )
             cat = jnp.concatenate(actions, -1)
-            return cat[None], _env_actions(actions), logp[None], values, states, key
+            # host_float32: rollout products are pulled to host / stored f32 (bf16
+            # degrades to |V2 through the remote-TPU tunnel); states stay native.
+            return host_float32((cat[None], _env_actions(actions), logp[None], values)) + (states, key)
 
         def _values(params, obs, prev_actions, prev_states):
             _, values, states = agent.apply(params, obs, prev_actions, prev_states)
-            return values[0], states
+            return host_float32(values[0]), states
 
         self._act = jax.jit(_act, static_argnums=(5,))
         self._values = jax.jit(_values)

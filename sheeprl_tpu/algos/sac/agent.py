@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.models.models import MLP
+from sheeprl_tpu.utils.utils import host_float32
 
 LOG_STD_MAX = 2
 LOG_STD_MIN = -5
@@ -128,11 +129,13 @@ class SACPlayer:
         def _act(params, obs, key):
             mean, log_std = actor.apply(params, obs)
             action, _ = actor_action_and_log_prob(mean, log_std, key, action_scale, action_bias)
-            return action
+            # host_float32: actions are pulled to host / stored f32 (bf16 degrades
+            # to |V2 through the remote-TPU tunnel)
+            return host_float32(action)
 
         def _greedy(params, obs):
             mean, _ = actor.apply(params, obs)
-            return actor_greedy_action(mean, action_scale, action_bias)
+            return host_float32(actor_greedy_action(mean, action_scale, action_bias))
 
         self._act = jax.jit(_act)
         self._greedy = jax.jit(_greedy)

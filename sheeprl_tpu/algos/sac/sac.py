@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -240,6 +241,15 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
+    def sample_batches(g: int):
+        bs = cfg.algo.per_rank_batch_size * world_size
+        sample = rb.sample(batch_size=g * bs, sample_next_obs=cfg.buffer.sample_next_obs)
+        return {k: np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]) for k, v in sample.items()}
+
+    # Double-buffered host->HBM pipeline (see sheeprl_tpu/data/prefetch.py): the
+    # [G, B] batch for the next train call transfers while the chip is still busy.
+    prefetcher = DevicePrefetcher(sample_batches, device=NamedSharding(runtime.mesh, P(None, "data")))
+
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
             f"The metric.log_every parameter ({cfg.metric.log_every}) is not a multiple of the "
@@ -293,7 +303,8 @@ def main(runtime, cfg: Dict[str, Any]):
         }
         if not cfg.buffer.sample_next_obs:
             step_data["next_observations"] = real_next_obs[np.newaxis]
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        with prefetcher.guard():  # no torn rows under the worker's concurrent sample
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
         obs_vec = next_obs_vec
 
         if cfg.metric.log_level > 0:
@@ -308,21 +319,16 @@ def main(runtime, cfg: Dict[str, Any]):
         if iter_num >= learning_starts:
             per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
             if per_rank_gradient_steps > 0:
+                g = per_rank_gradient_steps
+                # prefetched during the previous train step (sample + async device_put
+                # overlap compute); kwargs change -> synchronous fallback inside get()
+                batches = prefetcher.get(g=g)
                 with timer("Time/train_time", SumMetric()):
-                    sample = rb.sample(
-                        batch_size=per_rank_gradient_steps * cfg.algo.per_rank_batch_size * world_size,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                    )
-                    g = per_rank_gradient_steps
-                    bs = cfg.algo.per_rank_batch_size * world_size
-                    batches = {
-                        k: jnp.asarray(np.asarray(v, dtype=np.float32).reshape(g, bs, *v.shape[2:]))
-                        for k, v in sample.items()
-                    }
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, update_counter, train_metrics = train_fn(
                         params, opt_states, batches, train_key, update_counter
                     )
+                    # keep Time/train_time honest; the prefetch worker overlaps anyway
                     jax.block_until_ready(params.actor)
                     player.params = params.actor
                     cumulative_grad_steps += g
@@ -383,6 +389,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    prefetcher.close()
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:

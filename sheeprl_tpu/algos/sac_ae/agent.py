@@ -25,6 +25,7 @@ import numpy as np
 
 from sheeprl_tpu.algos.sac.agent import actor_action_and_log_prob
 from sheeprl_tpu.models.models import CNN, MLP, DeCNN, LayerNorm
+from sheeprl_tpu.utils.utils import host_float32
 
 LOG_STD_MAX = 2
 LOG_STD_MIN = -10
@@ -249,12 +250,14 @@ class SACAEPlayer:
             feats = encoder.apply(enc_params, obs)
             mean, log_std = actor_head.apply(actor_params, feats)
             action, _ = actor_action_and_log_prob(mean, log_std, key, action_scale, action_bias)
-            return action
+            # host_float32: actions are pulled to host / stored f32 (bf16 degrades
+            # to |V2 through the remote-TPU tunnel)
+            return host_float32(action)
 
         def _greedy(enc_params, actor_params, obs):
             feats = encoder.apply(enc_params, obs)
             mean, _ = actor_head.apply(actor_params, feats)
-            return jnp.tanh(mean) * action_scale + action_bias
+            return host_float32(jnp.tanh(mean) * action_scale + action_bias)
 
         self._act = jax.jit(_act)
         self._greedy = jax.jit(_greedy)

@@ -37,6 +37,19 @@ _PRECISIONS = {
 }
 
 
+def _distributed_initialized() -> bool:
+    """Whether jax.distributed.initialize() has already run in this process."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client is not None
+    except Exception:  # pragma: no cover - private-API drift fallback
+        # Must stay backend-free (jax.process_count() would initialize the backend
+        # and break a subsequent initialize()); a duplicate initialize() attempt is
+        # tolerated in __post_init__ instead.
+        return False
+
+
 def seed_everything(seed: int) -> int:
     """Seed python/numpy; JAX randomness is explicit via PRNG keys derived from the seed.
 
@@ -63,11 +76,27 @@ class Runtime:
     player_on_host: bool = True
 
     def __post_init__(self):
-        if self.multihost and jax.process_count() == 1:  # pragma: no cover - multihost only
+        if self.multihost and not _distributed_initialized():  # pragma: no cover - multihost only
+            # The guard must NOT probe jax.process_count(): that initializes the local
+            # backend, after which jax.distributed.initialize() can no longer run.
+            # Fail loudly: silently proceeding single-host after a botched pod config
+            # wastes the whole allocation (reference Fabric raises on bad cluster env too).
             try:
                 jax.distributed.initialize()
-            except Exception:
-                pass
+            except Exception as e:
+                if "already" in str(e).lower():  # initialized by a launcher/earlier Runtime
+                    pass
+                else:
+                    raise RuntimeError(
+                        "fabric.multihost=True but jax.distributed.initialize() failed. "
+                        "Check the coordinator address / JAX_COORDINATOR_ADDRESS and pod env, "
+                        "and make sure the Runtime is constructed before any JAX computation."
+                    ) from e
+            print(
+                f"[sheeprl_tpu] multihost initialized: process "
+                f"{jax.process_index()}/{jax.process_count()}, "
+                f"{jax.local_device_count()} local / {jax.device_count()} global devices"
+            )
         platform = None if self.accelerator in ("auto", "gpu", "cuda") else self.accelerator
         if self.accelerator in ("tpu", "axon"):
             platform = None  # default platform is already the TPU under axon
@@ -236,4 +265,6 @@ def get_single_device_runtime(runtime: Runtime) -> Runtime:
         strategy="auto",
         precision=runtime.precision,
         callbacks=list(runtime.callbacks),
+        multihost=runtime.multihost,
+        player_on_host=runtime.player_on_host,
     )

@@ -1,0 +1,167 @@
+"""Double-buffered host->HBM prefetch for replay-buffer sampling.
+
+TPU-native counterpart of the reference's ``sample_tensors(..., device=device,
+non_blocking=True)`` pinned-memory path (reference sheeprl/data/buffers.py:290-326):
+instead of pinned host staging, a worker thread runs the (numpy) sample and starts the
+asynchronous ``jax.device_put`` while the accelerator is still busy with the *previous*
+train step, so host gather + PCIe/tunnel transfer overlap compute instead of
+serializing with it.
+
+Semantics note: the speculative batch for iteration ``t+1`` is sampled at the end of
+iteration ``t``, i.e. before the env steps taken between the two iterations land in
+the buffer. For off-policy replay at real buffer sizes this lag of one transition
+batch is statistically irrelevant (the reference's decoupled trainers sample from a
+snapshot that is older still). Whenever the requested sample kwargs change (e.g. the
+Ratio scheduler yields a different ``n_samples``), the stale speculation is discarded
+and the sample runs synchronously — results are always shape-correct.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.data.buffers import get_array
+
+__all__ = ["DevicePrefetcher"]
+
+
+class DevicePrefetcher:
+    """Overlap ``sample_fn(**kwargs)`` + device transfer with accelerator compute.
+
+    Args:
+        sample_fn: returns a dict of numpy arrays (e.g. ``buffer.sample``).
+        device: a ``jax.Device`` or ``jax.sharding.Sharding`` the batch lands on.
+            ``None`` keeps arrays on host (still overlaps the host-side gather).
+        dtype: optional dtype override forwarded to :func:`get_array` per leaf.
+
+    Usage (the train loop calls ``get`` once per iteration)::
+
+        pf = DevicePrefetcher(rb.sample, device=sharding)
+        ...
+        batch = pf.get(batch_size=bs, sequence_length=T, n_samples=g)  # device tree
+        train_fn(..., batch, ...)
+
+    ``get`` consumes the speculative batch when its kwargs match the request
+    (the common steady-state), otherwise samples synchronously; either way it
+    immediately begins speculating the next batch with the same kwargs.
+    """
+
+    def __init__(
+        self,
+        sample_fn: Callable[..., Dict[str, np.ndarray]],
+        device: Optional[Any] = None,
+        dtype: Optional[Any] = None,
+        io_lock: Optional[threading.Lock] = None,
+    ):
+        self._sample_fn = sample_fn
+        self._device = device
+        self._dtype = dtype
+        # Serializes buffer access: the worker's sample vs. the train loop's add
+        # (torn-row reads once the circular write head wraps into the sampled
+        # region) and, with a shared lock, concurrent samples from several
+        # prefetchers racing one np.random.Generator. Train loops wrap their
+        # ``rb.add`` in ``with prefetcher.guard():``.
+        self._io_lock = io_lock or threading.Lock()
+        self._cond = threading.Condition()
+        # job state, all guarded by _cond: a monotonically increasing job id tags
+        # results so a stale (discarded) speculation can never satisfy a newer get()
+        self._job_id = 0
+        self._job_kwargs: Optional[Dict[str, Any]] = None
+        self._done_id = 0
+        self._result: Optional[Dict[str, Any]] = None
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, name="sheeprl-prefetch", daemon=True)
+        self._worker.start()
+
+    # ----- worker --------------------------------------------------------------------
+    def _transfer(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        # device_put returns immediately; the async copy completes while the
+        # consumer is still dispatching/awaiting the previous train step.
+        return {k: get_array(v, dtype=self._dtype, device=self._device) for k, v in batch.items()}
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                # _job_kwargs is None marks a cancelled slot (kwargs mismatch in get):
+                # the id was bumped so a stale publish is impossible, but there is
+                # nothing to compute until the next _launch_locked.
+                while (self._job_id == self._done_id or self._job_kwargs is None) and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    return
+                job_id, kwargs = self._job_id, dict(self._job_kwargs or {})
+            try:
+                with self._io_lock:
+                    batch = self._sample_fn(**kwargs)
+                result: Tuple[Optional[Dict[str, Any]], Optional[BaseException]] = (
+                    self._transfer(batch),
+                    None,
+                )
+            except BaseException as e:  # surfaced on the consumer thread in get()
+                result = (None, e)
+            with self._cond:
+                # a newer job may have been launched meanwhile; only publish if current
+                if job_id == self._job_id:
+                    self._result, self._error = result
+                    self._done_id = job_id
+                    self._cond.notify_all()
+
+    # ----- consumer ------------------------------------------------------------------
+    def _launch_locked(self, kwargs: Dict[str, Any]) -> None:
+        self._job_id += 1
+        self._job_kwargs = dict(kwargs)
+        self._result = None
+        self._error = None
+        self._cond.notify_all()
+
+    def get(self, **kwargs) -> Dict[str, Any]:
+        """Return a (device-resident) batch for ``kwargs``; speculate the next one."""
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("DevicePrefetcher is closed")
+            speculated = self._job_id > 0 and self._job_kwargs == kwargs
+            if speculated:
+                while self._done_id != self._job_id and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    raise RuntimeError("DevicePrefetcher closed while waiting for a batch")
+                result, err = self._result, self._error
+                self._launch_locked(kwargs)
+            else:
+                # mismatch (or first call): bump the job id so an in-flight stale
+                # speculation can never publish, then sample synchronously below
+                self._job_id += 1
+                self._job_kwargs = None
+        if not speculated:
+            try:
+                with self._io_lock:
+                    batch = self._sample_fn(**kwargs)
+                result, err = self._transfer(batch), None
+            except BaseException as e:
+                result, err = None, e
+            with self._cond:
+                if not self._closed:
+                    self._launch_locked(kwargs)
+        if err is not None:
+            raise err
+        return result
+
+    def guard(self) -> threading.Lock:
+        """The IO lock, for the train loop's buffer writes: ``with pf.guard(): rb.add(...)``."""
+        return self._io_lock
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

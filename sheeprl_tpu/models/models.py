@@ -339,6 +339,7 @@ class LayerNormGRUCell(nn.Module):
     hidden_size: int
     bias: bool = True
     layer_norm: bool = False
+    layer_norm_eps: float = 1e-5
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     kernel_init: Optional[Callable] = None
@@ -367,7 +368,9 @@ class LayerNormGRUCell(nn.Module):
             from sheeprl_tpu.ops.pallas import layer_norm_gru, pallas_gru_supported
 
             if pallas_gru_supported(x.shape[0], x.shape[-1], self.hidden_size, self.dtype):
-                return layer_norm_gru(x, h, kernel, ln_scale, ln_bias).astype(self.dtype)
+                return layer_norm_gru(
+                    x, h, kernel, ln_scale, ln_bias, eps=self.layer_norm_eps
+                ).astype(self.dtype)
 
         xh = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1)
         fused = xh @ kernel.astype(self.dtype)
@@ -378,7 +381,7 @@ class LayerNormGRUCell(nn.Module):
             f32 = fused.astype(jnp.float32)
             mu = jnp.mean(f32, axis=-1, keepdims=True)
             var = jnp.var(f32, axis=-1, keepdims=True)
-            f32 = (f32 - mu) * jax.lax.rsqrt(var + 1e-5) * ln_scale + ln_bias
+            f32 = (f32 - mu) * jax.lax.rsqrt(var + self.layer_norm_eps) * ln_scale + ln_bias
             fused = f32.astype(self.dtype)
         reset, cand, update = jnp.split(fused, 3, axis=-1)
         reset = jax.nn.sigmoid(reset)

@@ -95,6 +95,43 @@ def set_nested(cfg: Dict, dotted: str, value, create: bool = True):
     node[parts[-1]] = value
 
 
+def host_float32(tree):
+    """Cast sub-fp32 floating leaves of a pytree to float32 (on device).
+
+    Apply to jitted rollout-step outputs BEFORE they leave the device: pulling a
+    bf16 array through the remote-TPU tunnel degrades it to a raw ``|V2`` numpy
+    array that both numpy and jax reject downstream (buffer adds, ``jnp.asarray``
+    on the sampled batch). Rollout products (actions, log-probs, values) are
+    stored float32 in the replay buffers anyway, matching the reference.
+    """
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(jnp.float32)
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) and x.dtype != jnp.float32
+        else x,
+        tree,
+    )
+
+
+def resolve_actor_cls(cls_path: Any, default_cls: type, minedojo_cls: type) -> type:
+    """Map ``cfg.algo.actor.cls`` (a dotted class path) onto this repo's actor classes.
+
+    The reference resolves the path with ``hydra.utils.get_class`` (e.g.
+    dreamer_v3/agent.py:1184); here the selection is by class *basename* so both
+    the reference's names (``MinedojoActor``) and this repo's (``MinedojoActorDV2``)
+    work. Unrecognized non-default values raise instead of silently building an
+    unmasked actor.
+    """
+    basename = str(cls_path or "").rsplit(".", 1)[-1]
+    if basename in ("", "None", default_cls.__name__, "Actor", "ActorDV2"):
+        return default_cls
+    if "MinedojoActor" in basename:
+        return minedojo_cls
+    raise ValueError(
+        f"Unrecognized actor cls {cls_path!r}: expected a default actor "
+        f"({default_cls.__name__!r}) or a MineDojo actor ({minedojo_cls.__name__!r})"
+    )
+
+
 # --------------------------------------------------------------------------------------
 # Device math (jit-friendly)
 # --------------------------------------------------------------------------------------

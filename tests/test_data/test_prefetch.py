@@ -1,0 +1,128 @@
+"""DevicePrefetcher: overlap semantics, speculation reuse/discard, error paths."""
+
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
+
+
+class CountingSampler:
+    """sample_fn double that records calls and returns identifiable batches."""
+
+    def __init__(self):
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def __call__(self, **kwargs):
+        with self.lock:
+            self.calls.append(dict(kwargs))
+            n = len(self.calls)
+        size = int(kwargs.get("batch_size", 1))
+        return {"x": np.full((size, 2), n, dtype=np.float32)}
+
+
+def test_first_get_is_synchronous_and_speculates():
+    s = CountingSampler()
+    with DevicePrefetcher(s) as pf:
+        out = pf.get(batch_size=3)
+        assert out["x"].shape == (3, 2)
+        # first call: one sync sample; a speculative one is (or will be) in flight
+        assert {"batch_size": 3} in s.calls
+
+
+def test_speculation_consumed_on_matching_kwargs():
+    s = CountingSampler()
+    with DevicePrefetcher(s) as pf:
+        a = pf.get(batch_size=2)
+        b = pf.get(batch_size=2)  # must consume the speculative batch, not resample inline
+        # batches are distinct samples (different fill values)
+        assert not np.array_equal(a["x"], b["x"])
+        # after two gets: 1 sync + at least the consumed speculation
+        assert len([c for c in s.calls if c == {"batch_size": 2}]) >= 2
+
+
+def test_kwargs_change_discards_speculation():
+    s = CountingSampler()
+    with DevicePrefetcher(s) as pf:
+        a = pf.get(batch_size=2)
+        b = pf.get(batch_size=5)  # mismatch: stale speculation must not be returned
+        assert a["x"].shape == (2, 2)
+        assert b["x"].shape == (5, 2)
+        c = pf.get(batch_size=5)  # steady state again
+        assert c["x"].shape == (5, 2)
+
+
+def test_many_iterations_matches_sync_shapes():
+    s = CountingSampler()
+    with DevicePrefetcher(s) as pf:
+        seen = set()
+        for _ in range(20):
+            out = pf.get(batch_size=4)
+            assert out["x"].shape == (4, 2)
+            seen.add(float(out["x"][0, 0]))
+        # each get must return a fresh sample, never a repeated speculation
+        assert len(seen) == 20
+
+
+def test_device_placement():
+    s = CountingSampler()
+    dev = jax.devices()[0]
+    with DevicePrefetcher(s, device=dev) as pf:
+        out = pf.get(batch_size=2)
+        assert isinstance(out["x"], jax.Array)
+        assert out["x"].devices() == {dev}
+        out2 = pf.get(batch_size=2)
+        assert isinstance(out2["x"], jax.Array)
+
+
+def test_sharded_placement():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("data",))
+    sharding = NamedSharding(mesh, P(None, "data"))
+
+    def sample(**kwargs):
+        return {"x": np.zeros((3, 8, 5), dtype=np.float32)}
+
+    with DevicePrefetcher(sample, device=sharding) as pf:
+        out = pf.get()
+        assert out["x"].sharding == sharding
+        out2 = pf.get()
+        assert out2["x"].sharding == sharding
+
+
+def test_error_propagates_sync_and_speculative():
+    calls = {"n": 0}
+
+    def flaky(**kwargs):
+        calls["n"] += 1
+        raise ValueError(f"boom {calls['n']}")
+
+    with DevicePrefetcher(flaky) as pf:
+        with pytest.raises(ValueError, match="boom"):
+            pf.get(batch_size=1)
+        # the speculative job also failed; its error must surface on the next get
+        with pytest.raises(ValueError, match="boom"):
+            pf.get(batch_size=1)
+
+
+def test_dtype_narrowing():
+    def sample(**kwargs):
+        return {"x": np.zeros((2, 2), dtype=np.float64)}
+
+    with DevicePrefetcher(sample, device=jax.devices()[0]) as pf:
+        out = pf.get()
+        assert out["x"].dtype == np.float32  # f64 narrowed to TPU-native width
+
+
+def test_close_idempotent():
+    s = CountingSampler()
+    pf = DevicePrefetcher(s)
+    pf.get(batch_size=1)
+    pf.close()
+    pf.close()
+    with pytest.raises(RuntimeError):
+        pf.get(batch_size=1)

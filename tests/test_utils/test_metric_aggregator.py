@@ -1,0 +1,57 @@
+"""MetricAggregator semantics + actor-class resolution (ADVICE round-1 items)."""
+
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.utils.metric import MeanMetric, MetricAggregator
+from sheeprl_tpu.utils.utils import resolve_actor_cls
+
+
+def test_update_from_device_filters_unregistered_keys():
+    agg = MetricAggregator({"Loss/a": MeanMetric()}, raise_on_missing=True)
+    # Train loops pass the full train-metrics dict; extra keys must be ignored,
+    # not raised on, even with raise_on_missing=True.
+    agg.update_from_device({"Loss/a": jnp.float32(2.0), "Loss/unregistered": jnp.float32(9.0)})
+    out = agg.compute()
+    assert out == {"Loss/a": 2.0}
+
+
+def test_update_raise_on_missing_still_guards_single_key():
+    agg = MetricAggregator({"Loss/a": MeanMetric()}, raise_on_missing=True)
+    with pytest.raises(KeyError):
+        agg.update("Loss/nope", 1.0)
+
+
+def test_update_from_device_mixed_host_device_values():
+    agg = MetricAggregator({"a": MeanMetric(), "b": MeanMetric()})
+    agg.update_from_device({"a": 1.0, "b": jnp.float32(3.0)})
+    assert agg.compute() == {"a": 1.0, "b": 3.0}
+
+
+class _Default:
+    pass
+
+
+class _Minedojo:
+    pass
+
+
+@pytest.mark.parametrize(
+    "path, expected",
+    [
+        (None, _Default),
+        ("", _Default),
+        ("sheeprl_tpu.algos.dreamer_v3.agent.Actor", _Default),
+        ("sheeprl_tpu.algos.dreamer_v2.agent.ActorDV2", _Default),
+        ("sheeprl_tpu.algos.dreamer_v3.agent.MinedojoActor", _Minedojo),
+        ("sheeprl_tpu.algos.dreamer_v2.agent.MinedojoActorDV2", _Minedojo),
+        ("sheeprl.algos.dreamer_v3.agent.MinedojoActor", _Minedojo),
+    ],
+)
+def test_resolve_actor_cls(path, expected):
+    assert resolve_actor_cls(path, _Default, _Minedojo) is expected
+
+
+def test_resolve_actor_cls_rejects_unknown():
+    with pytest.raises(ValueError, match="Unrecognized actor cls"):
+        resolve_actor_cls("some.module.WeirdActor", _Default, _Minedojo)
