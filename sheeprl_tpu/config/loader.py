@@ -163,6 +163,15 @@ def _compose_file(
                 # overrides from overlays replace the *top-level* selection
                 selections[group_rel] = option if option is not None else selections.get(group_rel)
                 continue
+            # CLI group selections win over the file's default option. A
+            # "group@package=option" override matches only the mount whose
+            # effective package (file's mount point + local placement) agrees;
+            # a bare "group=option" selection re-points every mount.
+            local_pkg = placement if placement is not None else group_rel.split("/")[-1]
+            eff_pkg = f"{group_prefix}.{local_pkg}" if group_prefix else local_pkg
+            option = selections.get(
+                f"{group_rel}@{eff_pkg}", selections.get(group_rel, option)
+            )
             if option in (None, "null"):
                 continue
             if option == MISSING:
@@ -274,6 +283,17 @@ def compose(
         key, _, value = ov.partition("=")
         key = key.strip().lstrip("+")
         value = value.strip()
+        if "@" in key:
+            # hydra's "group@package=option" (e.g. logger@metric.logger=mlflow):
+            # selects an option for the group AT THAT PACKAGE ONLY — other mounts
+            # of the same group keep their defaults (selection key carries the
+            # package, consulted by _compose_file against each mount's location)
+            group, package = key.split("@", 1)
+            group = group.lstrip("/")
+            if not group_exists(group, config_dirs):
+                raise ConfigError(f"Override '{ov}': unknown config group '{group}'")
+            selections[f"{group}@{package}"] = value
+            continue
         is_group = ("." not in key) and group_exists(key, config_dirs) and not isinstance(
             _parse_cli_value(value), (dict, list)
         )
@@ -321,7 +341,9 @@ def compose(
         path = _find_yaml(rel, search)
         if path is None:
             raise ConfigError(f"Cannot find config '{rel}'. Available search path: {search}")
-        sub_sel: Dict[str, str] = {}
+        # seed with CLI selections so nested group mounts (e.g. metric/default.yaml's
+        # "/logger@logger") honor "group@package=option" overrides
+        sub_sel: Dict[str, str] = dict(selections)
         cfg_piece = _compose_file(path, search, sub_sel, group)
         overlay_cfgs[group] = cfg_piece
         for g, o in sub_sel.items():
@@ -350,7 +372,7 @@ def compose(
             raise ConfigError(f"Cannot find config '{rel}' for {group}={option}")
         cfg_piece = overlay_cfgs.get(group)
         if cfg_piece is None:
-            cfg_piece = _compose_file(path, search, {}, group)
+            cfg_piece = _compose_file(path, search, dict(selections), group)
         target_key = placement if placement is not None else group.split("/")[-1]
         if _is_global_packaged(path):
             _deep_merge(cfg, cfg_piece)

@@ -218,15 +218,12 @@ class Runtime:
                 fn(runtime=self, **kwargs)
 
     def barrier(self):
-        # Single-controller: nothing to synchronize on host. Multi-controller: sync via
-        # a tiny collective.
-        if jax.process_count() > 1:  # pragma: no cover - multihost only
-            x = jnp.ones(())
-            jax.block_until_ready(
-                jax.pmap(lambda y: jax.lax.psum(y, "i"), axis_name="i")(
-                    jnp.broadcast_to(x, (jax.local_device_count(),))
-                )
-            )
+        # Single-controller: nothing to synchronize on host. Multi-controller: a true
+        # cross-process barrier (a local pmap-psum would only fence local devices).
+        if jax.process_count() > 1:  # pragma: no cover - exercised by test_multihost children
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
 
     def seed_everything(self, seed: int) -> int:
         return seed_everything(seed)

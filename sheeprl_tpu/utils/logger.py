@@ -22,6 +22,13 @@ class TensorBoardLogger:
         os.makedirs(self.log_dir, exist_ok=True)
         self._writer = SummaryWriter(logdir=self.log_dir)
         self._last_values: Dict[str, float] = {}
+        self._run_dir: Optional[str] = None
+
+    def set_run_dir(self, run_dir: str) -> None:
+        """The versioned run dir (version_N) — wired by get_log_dir so the
+        metrics.json sidecar lands NEXT TO the run's checkpoints, where
+        register_best_models ranks runs."""
+        self._run_dir = run_dir
 
     @property
     def name(self) -> str:
@@ -47,15 +54,130 @@ class TensorBoardLogger:
     def finalize(self) -> None:
         # Queryable sidecar of the final scalar values: the model manager ranks runs
         # by these (register_best_models), the analogue of ranking MLflow runs by a
-        # logged metric (reference mlflow.py:214-279).
+        # logged metric (reference mlflow.py:214-279). Written to the versioned run
+        # dir (next to checkpoint/) and to the writer dir.
         try:
             import json
 
-            with open(os.path.join(self.log_dir, "metrics.json"), "w") as f:
-                json.dump(self._last_values, f, indent=2)
+            for d in {self._run_dir, self.log_dir} - {None}:
+                with open(os.path.join(d, "metrics.json"), "w") as f:
+                    json.dump(self._last_values, f, indent=2)
         except Exception:
             pass
         self._writer.close()
+
+    def close(self) -> None:
+        self.finalize()
+
+
+class MLflowLogger:
+    """MLflow tracking backend (reference: lightning MLFlowLogger via
+    sheeprl/configs/logger/mlflow.yaml + sheeprl/utils/logger.py:12-36).
+
+    Thin client over ``mlflow.tracking.MlflowClient``: one run per training,
+    batched metric logging, params on ``log_hyperparams``, terminated on
+    ``finalize``. Requires the optional ``mlflow`` dependency
+    (``sheeprl_tpu.utils.imports._IS_MLFLOW_AVAILABLE``).
+    """
+
+    def __init__(
+        self,
+        experiment_name: str = "sheeprl_tpu",
+        tracking_uri: Optional[str] = None,
+        run_name: Optional[str] = None,
+        tags: Optional[Dict[str, str]] = None,
+    ):
+        from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+        if not _IS_MLFLOW_AVAILABLE:
+            raise ModuleNotFoundError(
+                "logger=mlflow requires the optional dependency mlflow "
+                "(pip install mlflow), or set MLFLOW_TRACKING_URI to a file store"
+            )
+        from mlflow.tracking import MlflowClient
+
+        self._client = MlflowClient(tracking_uri=tracking_uri or os.environ.get("MLFLOW_TRACKING_URI"))
+        exp = self._client.get_experiment_by_name(experiment_name)
+        exp_id = exp.experiment_id if exp is not None else self._client.create_experiment(experiment_name)
+        run = self._client.create_run(exp_id, run_name=run_name, tags=tags or None)
+        self.run_id = run.info.run_id
+        self._last_values: Dict[str, float] = {}
+        self._run_dir: Optional[str] = None
+
+    def set_run_dir(self, run_dir: str) -> None:
+        """Versioned run dir (wired by get_log_dir): finalize drops the metrics.json
+        sidecar there so register_best_models can rank runs for this backend too."""
+        self._run_dir = run_dir
+        try:
+            self._client.set_tag(self.run_id, "sheeprl_tpu.run_dir", run_dir)
+        except Exception:
+            pass
+
+    @property
+    def name(self) -> str:
+        return "mlflow"
+
+    @property
+    def log_dir(self) -> Optional[str]:  # artifacts live in the tracking store
+        return None
+
+    def log_metrics(self, metrics: Dict[str, float], step: Optional[int] = None) -> None:
+        import time as _time
+
+        from mlflow.entities import Metric
+
+        ts = int(_time.time() * 1000)
+        batch = []
+        for key, value in metrics.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            batch.append(Metric(key.replace("/", "_"), value, ts, step or 0))
+            self._last_values[key] = value
+        if batch:
+            self._client.log_batch(self.run_id, metrics=batch)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        def _flatten(prefix: str, node: Any, out: Dict[str, str]) -> None:
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    _flatten(f"{prefix}.{k}" if prefix else str(k), v, out)
+            else:
+                out[prefix] = str(node)[:500]  # mlflow param value limit
+
+        from mlflow.entities import Param
+
+        flat: Dict[str, str] = {}
+        _flatten("", dict(params), flat)
+        batch = [Param(k.replace("/", "_"), v) for k, v in flat.items()]
+        # one store round-trip for the whole config; mlflow params are immutable,
+        # so a re-log (resume) conflict is ignored rather than fatal
+        for start in range(0, len(batch), 100):  # mlflow caps log_batch at 100 params
+            try:
+                self._client.log_batch(self.run_id, params=batch[start : start + 100])
+            except Exception:
+                pass
+
+    def log_artifact(self, local_path: str, artifact_path: Optional[str] = None) -> None:
+        self._client.log_artifact(self.run_id, local_path, artifact_path)
+
+    def add_video(self, tag: str, video, step: Optional[int] = None, fps: int = 30) -> None:
+        pass  # video tensors are a TensorBoard concept; mlflow stores file artifacts
+
+    def finalize(self) -> None:
+        if self._run_dir is not None:
+            try:
+                import json
+
+                with open(os.path.join(self._run_dir, "metrics.json"), "w") as f:
+                    json.dump(self._last_values, f, indent=2)
+            except Exception:
+                pass
+        try:
+            self._client.set_terminated(self.run_id)
+        except Exception:
+            pass
 
     def close(self) -> None:
         self.finalize()
@@ -90,23 +212,55 @@ def _next_version(base: str) -> int:
     return max(versions) + 1 if versions else 0
 
 
+_LOG_DIR_WIRE_BYTES = 1024
+
+
+def _broadcast_str(value: Optional[str]) -> str:
+    """Share rank-0's string with every process (fixed-size uint8 wire format:
+    ``broadcast_one_to_all`` moves arrays, not Python objects)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    buf = np.zeros((_LOG_DIR_WIRE_BYTES,), dtype=np.uint8)
+    if value is not None:
+        raw = value.encode("utf-8")
+        if len(raw) > _LOG_DIR_WIRE_BYTES:
+            raise ValueError(f"string too long to broadcast ({len(raw)} bytes): {value!r}")
+        buf[: len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+    out = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return bytes(out[: int(np.max(np.nonzero(out)[0], initial=-1)) + 1]).decode("utf-8")
+
+
+# The logger created by get_logger; get_log_dir (always called right after in every
+# train loop) hands it the versioned run dir so backend sidecars land next to the
+# run's checkpoints. Single-process training state, reset on each get_logger call.
+_active_logger: Optional[Any] = None
+
+
 def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> str:
-    """Versioned run dir: logs/runs/<root_dir>/<run_name>/version_N."""
+    """Versioned run dir: logs/runs/<root_dir>/<run_name>/version_N.
+
+    Rank 0 creates it; under multi-controller every process receives rank-0's
+    path via a collective broadcast (reference: sheeprl/utils/logger.py:52-88
+    broadcasts the dir over the process group).
+    """
     base = os.path.join("logs", "runs", root_dir, run_name)
     if runtime is None or runtime.is_global_zero:
         log_dir = os.path.join(base, f"version_{_next_version(base)}")
         os.makedirs(log_dir, exist_ok=True)
-    else:  # pragma: no cover - multihost only
+    else:  # pragma: no cover - exercised by tests/test_utils/test_multihost.py children
         log_dir = None
-    if share and jax.process_count() > 1:  # pragma: no cover - multihost only
-        from jax.experimental import multihost_utils
-
-        log_dir = multihost_utils.broadcast_one_to_all(log_dir)
+    if share and jax.process_count() > 1:  # pragma: no cover - idem
+        log_dir = _broadcast_str(log_dir)
+    if log_dir is not None and _active_logger is not None and hasattr(_active_logger, "set_run_dir"):
+        _active_logger.set_run_dir(log_dir)
     return log_dir
 
 
 def get_logger(runtime, cfg) -> Optional[Any]:
     """Rank-0 logger instantiation from cfg.metric.logger (``_target_`` style)."""
+    global _active_logger
+    _active_logger = None
     if runtime is not None and not runtime.is_global_zero:
         return NullLogger()
     if cfg.metric.log_level == 0 or not getattr(cfg.metric, "logger", None):
@@ -114,4 +268,6 @@ def get_logger(runtime, cfg) -> Optional[Any]:
     from sheeprl_tpu.config import instantiate
 
     spec = dict(cfg.metric.logger)
-    return instantiate(spec)
+    logger = instantiate(spec)
+    _active_logger = logger
+    return logger

@@ -307,9 +307,17 @@ class LocalModelManager:
             return pickle.load(f)
 
 
-def build_model_manager(runtime, cfg) -> LocalModelManager:
-    backend = str(cfg.model_manager.get("backend", "local")).lower() if "model_manager" in cfg else "local"
-    if backend == "mlflow":  # pragma: no cover - optional dependency
+class MlflowModelManager:
+    """MLflow-registry backend with the same surface as :class:`LocalModelManager`
+    (reference MlflowModelManager, sheeprl/utils/mlflow.py:73-295). Model artifacts
+    are the same pickled pytrees the local backend stores, uploaded to the tracking
+    store's artifact repository; versions/stages live in mlflow's model registry
+    behind ``MLFLOW_TRACKING_URI``. Stage transitions use the registry-stage API,
+    which mlflow 3.x removed in favor of aliases — this backend targets mlflow<3
+    (the reference's era; CI pins accordingly).
+    """
+
+    def __init__(self, runtime, tracking_uri: Optional[str] = None):
         from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
 
         if not _IS_MLFLOW_AVAILABLE:
@@ -317,10 +325,113 @@ def build_model_manager(runtime, cfg) -> LocalModelManager:
                 "model_manager.backend=mlflow requires mlflow, which is not installed; "
                 "use the default local backend instead"
             )
-        raise NotImplementedError(
-            "The MLflow backend is delegated to mlflow's own registry; point MLFLOW_TRACKING_URI "
-            "at your server and use mlflow.register_model on the logged artifacts."
+        from mlflow.tracking import MlflowClient
+
+        self.runtime = runtime
+        self._client = MlflowClient(tracking_uri=tracking_uri or os.environ.get("MLFLOW_TRACKING_URI"))
+        self._artifacts_run_id: Optional[str] = None
+
+    def _artifacts_run(self) -> str:
+        """A per-manager mlflow run that owns the uploaded model artifacts (callers
+        delete their local copies right after register_model, so the bytes must live
+        in the tracking store's artifact repository, not behind a file path)."""
+        if self._artifacts_run_id is None:
+            exp_name = "sheeprl_tpu_model_artifacts"
+            exp = self._client.get_experiment_by_name(exp_name)
+            exp_id = exp.experiment_id if exp is not None else self._client.create_experiment(exp_name)
+            self._artifacts_run_id = self._client.create_run(exp_id, run_name="artifacts").info.run_id
+        return self._artifacts_run_id
+
+    def register_model(
+        self,
+        model_location: str,
+        model_name: str,
+        description: Optional[str] = None,
+        tags: Optional[Dict[str, Any]] = None,
+    ) -> ModelVersion:
+        import uuid
+
+        from mlflow.exceptions import MlflowException
+
+        try:
+            self._client.create_registered_model(model_name)
+        except MlflowException:  # already registered
+            pass
+        run_id = self._artifacts_run()
+        artifact_path = f"{model_name}/{uuid.uuid4().hex[:8]}"
+        self._client.log_artifact(run_id, os.path.abspath(model_location), artifact_path)
+        source = f"runs:/{run_id}/{artifact_path}/{os.path.basename(model_location)}"
+        mv = self._client.create_model_version(
+            name=model_name,
+            source=source,
+            run_id=run_id,
+            description=description,
+            tags={str(k): str(v) for k, v in (tags or {}).items()} or None,
         )
+        if self.runtime is not None:
+            self.runtime.print(f"Registered model {model_name} with version {mv.version}")
+        return ModelVersion(
+            name=model_name, version=int(mv.version), path=source, description=description or ""
+        )
+
+    def get_latest_version(self, model_name: str) -> ModelVersion:
+        versions = self._client.search_model_versions(f"name='{model_name}'")
+        if not versions:
+            raise ValueError(f"Model '{model_name}' is not registered")
+        mv = max(versions, key=lambda v: int(v.version))
+        return ModelVersion(
+            name=model_name,
+            version=int(mv.version),
+            path=mv.source,
+            stage=mv.current_stage or "None",
+            description=mv.description or "",
+        )
+
+    def transition_model(
+        self, model_name: str, version: int, stage: str, description: Optional[str] = None
+    ) -> ModelVersion:
+        mv = self._client.transition_model_version_stage(model_name, str(version), stage)
+        if description:
+            self._client.update_model_version(model_name, str(version), description)
+        return ModelVersion(
+            name=model_name, version=int(mv.version), path=mv.source, stage=mv.current_stage or stage
+        )
+
+    def delete_model(self, model_name: str, version: int, description: Optional[str] = None) -> None:
+        del description  # mlflow keeps its own audit trail
+        self._client.delete_model_version(model_name, str(version))
+
+    def download_model(self, model_name: str, version: int, output_path: str) -> None:
+        mv = self._client.get_model_version(model_name, str(version))
+        os.makedirs(output_path, exist_ok=True)
+        src = mv.source
+        if os.path.isfile(src):  # plain-path source (externally registered)
+            shutil.copy2(src, output_path)
+        else:  # runs:/ or remote artifact store
+            from mlflow.artifacts import download_artifacts
+
+            download_artifacts(artifact_uri=src, dst_path=output_path)
+
+    def load_model(self, model_name: str, version: Optional[int] = None) -> Any:
+        if version is None:
+            version = self.get_latest_version(model_name).version
+        with tempfile.TemporaryDirectory(prefix="sheeprl_tpu_mlflow_") as tmp:
+            self.download_model(model_name, version, tmp)
+            for root, _, files in os.walk(tmp):  # artifact may land under subdirs
+                for fname in files:
+                    with open(os.path.join(root, fname), "rb") as f:
+                        return pickle.load(f)
+        raise FileNotFoundError(f"No artifact downloaded for {model_name} v{version}")
+
+    # Run ranking happens on the experiment-dir filesystem layout (metrics.json
+    # sidecars) for both backends; only the registration target differs.
+    register_best_models = LocalModelManager.register_best_models
+
+
+def build_model_manager(runtime, cfg):
+    backend = str(cfg.model_manager.get("backend", "local")).lower() if "model_manager" in cfg else "local"
+    if backend == "mlflow":  # pragma: no cover - optional dependency (tests skip without mlflow)
+        return MlflowModelManager(runtime)
     return LocalModelManager(runtime, default_registry_dir(cfg))
 
 

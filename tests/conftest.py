@@ -29,7 +29,45 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.devices()[0].platform == "cpu", f"tests must run on the CPU mesh, got {jax.devices()}"
 assert jax.device_count() == 8, f"expected 8 virtual CPU devices, got {jax.device_count()}"
 
+import signal  # noqa: E402
+import threading  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Per-test wall-clock limits without the pytest-timeout dependency (reference gates
+# test_algos.py at 60-180 s via pytest-timeout, tests/conftest.py:71-76; the virtual
+# 8-device CPU mesh compiles slower, hence the larger default).
+_ALGO_TEST_DEFAULT_TIMEOUT = 600
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "timeout(seconds): per-test wall-clock limit (SIGALRM)")
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout")
+    seconds = int(marker.args[0]) if marker and marker.args else 0
+    if not seconds and "test_algos.py" in str(getattr(item, "fspath", "")):
+        seconds = _ALGO_TEST_DEFAULT_TIMEOUT
+    use_alarm = (
+        seconds > 0
+        and hasattr(signal, "SIGALRM")
+        and threading.current_thread() is threading.main_thread()
+    )
+    if not use_alarm:
+        return (yield)
+
+    def _on_timeout(signum, frame):
+        raise TimeoutError(f"test exceeded the {seconds}s wall-clock limit")
+
+    old = signal.signal(signal.SIGALRM, _on_timeout)
+    signal.alarm(seconds)
+    try:
+        return (yield)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture(autouse=True)

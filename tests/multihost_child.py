@@ -1,0 +1,93 @@
+"""Child process for the 2-process multi-controller test (test_multihost.py).
+
+Run as: python tests/multihost_child.py <coordinator_port> <process_id> <num_processes> <tmpdir>
+
+Covers, on the CPU backend over localhost (the same jax.distributed machinery a
+TPU pod uses over DCN — reference counterpart: the reference's CPU-Gloo
+multi-process tests, tests/test_algos/test_algos.py):
+- Runtime(multihost=True) boots against an externally-initialized jax.distributed
+  (the launcher case) without raising;
+- get_log_dir: every process ends with rank-0's versioned dir (collective broadcast);
+- DP gradient agreement: per-process local shards, global batch via
+  make_array_from_process_local_data, grads allreduced by XLA -> identical on all
+  processes;
+- checkpoint write-once: only global-zero writes.
+
+Prints one JSON line with the observed values; the parent asserts cross-process
+equality.
+"""
+
+import json
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split() if "host_platform_device_count" not in f]
+flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, pid, nproc, tmpdir = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4]
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc, process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.core.runtime import Runtime
+    from sheeprl_tpu.utils.logger import get_log_dir
+
+    # multihost=True with distributed already initialized (launcher case) must not raise
+    runtime = Runtime(accelerator="cpu", devices=jax.device_count(), multihost=True)
+    assert runtime.world_size == nproc * 2, runtime.world_size
+
+    os.chdir(tmpdir)  # log dirs are relative to cwd
+    log_dir = get_log_dir(runtime, "mh_algo", "mh_run")
+
+    # ---- DP gradient agreement over the global mesh
+    data_sharding = NamedSharding(runtime.mesh, P("data"))
+    w = runtime.replicate(jnp.full((2,), 0.5, jnp.float32))
+    # each process owns a DIFFERENT local slice of the global [4, 2] batch
+    local = np.arange(2 * 2, dtype=np.float32).reshape(2, 2) + 100.0 * pid
+    batch = jax.make_array_from_process_local_data(data_sharding, local, (4, 2))
+
+    @jax.jit
+    def grad_fn(w, x):
+        return jax.grad(lambda w: jnp.mean(jnp.sum(x * w[None, :], axis=-1) ** 2))(w)
+
+    g = grad_fn(w, batch)
+    # replicated output: each process reads its own addressable replica; the parent
+    # asserts the two processes report the SAME value, i.e. XLA inserted the
+    # cross-process reduction (the DDP allreduce equivalent)
+    g_local = np.asarray(jax.device_get(g.addressable_data(0)))
+
+    # ---- checkpoint write-once
+    ckpt = os.path.join(tmpdir, f"ckpt_shared.npz")
+    if runtime.is_global_zero:
+        np.savez(ckpt, w=np.asarray(jax.device_get(w)))
+    runtime.barrier()
+    assert os.path.exists(ckpt)
+
+    print(
+        json.dumps(
+            {
+                "pid": pid,
+                "log_dir": log_dir,
+                "grad": np.asarray(g_local).reshape(-1).round(6).tolist(),
+                "ckpt_exists": os.path.exists(ckpt),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,114 @@
+"""MLflow logger + model-manager backend (skipped when mlflow is not installed).
+
+Reference: sheeprl/utils/logger.py:12-36 (MLFlowLogger selection) and
+sheeprl/utils/mlflow.py:73-295 (MlflowModelManager) — exercised against mlflow's
+local file store.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config import compose
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+
+def test_mlflow_logger_config_selectable():
+    cfg = compose(config_name="config", overrides=["exp=ppo", "logger@metric.logger=mlflow"])
+    assert cfg.metric.logger._target_ == "sheeprl_tpu.utils.logger.MLflowLogger"
+    assert cfg.metric.logger.experiment_name == "ppo_CartPole-v1"
+    # the default selection is untouched
+    cfg2 = compose(config_name="config", overrides=["exp=ppo"])
+    assert cfg2.metric.logger._target_ == "sheeprl_tpu.utils.logger.TensorBoardLogger"
+
+
+def test_mlflow_logger_raises_without_mlflow():
+    if _IS_MLFLOW_AVAILABLE:
+        pytest.skip("mlflow installed: the import guard is exercised by the real tests below")
+    from sheeprl_tpu.utils.logger import MLflowLogger
+
+    with pytest.raises(ModuleNotFoundError, match="mlflow"):
+        MLflowLogger(experiment_name="x", tracking_uri="file:///tmp/none")
+
+
+@pytest.mark.skipif(not _IS_MLFLOW_AVAILABLE, reason="mlflow not installed")
+def test_mlflow_logger_file_store(tmp_path):
+    from sheeprl_tpu.utils.logger import MLflowLogger
+
+    uri = f"file://{tmp_path}/mlruns"
+    logger = MLflowLogger(experiment_name="exp", tracking_uri=uri, run_name="run")
+    logger.log_metrics({"Loss/a": 1.5, "Rewards/rew_avg": 2.0}, step=3)
+    logger.log_hyperparams({"algo": {"name": "ppo", "lr": 1e-3}})
+    logger.finalize()
+
+    from mlflow.tracking import MlflowClient
+
+    client = MlflowClient(tracking_uri=uri)
+    run = client.get_run(logger.run_id)
+    assert run.data.metrics["Loss_a"] == 1.5
+    assert run.data.params["algo.name"] == "ppo"
+    assert run.info.status == "FINISHED"
+
+
+@pytest.mark.skipif(not _IS_MLFLOW_AVAILABLE, reason="mlflow not installed")
+def test_mlflow_model_manager_roundtrip(tmp_path, monkeypatch):
+    from sheeprl_tpu.utils.model_manager import MlflowModelManager
+
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", f"file://{tmp_path}/mlruns")
+    mm = MlflowModelManager(None)
+
+    payload = {"w": np.arange(4, dtype=np.float32)}
+    art = tmp_path / "agent.pkl"
+    with open(art, "wb") as f:
+        pickle.dump(payload, f)
+
+    v1 = mm.register_model(str(art), "agent", description="first")
+    assert v1.version == 1
+    v2 = mm.register_model(str(art), "agent")
+    assert v2.version == 2
+    assert mm.get_latest_version("agent").version == 2
+
+    # registration must have UPLOADED the bytes: callers delete the local artifact
+    # right after registering (register_model_from_checkpoint's temp-dir cleanup)
+    os.remove(art)
+
+    mm.transition_model("agent", 2, "Staging")
+    assert mm.get_latest_version("agent").stage == "Staging"
+
+    out = tmp_path / "dl"
+    mm.download_model("agent", 2, str(out))
+    assert len(os.listdir(out)) == 1
+
+    loaded = mm.load_model("agent")
+    np.testing.assert_array_equal(loaded["w"], payload["w"])
+
+    mm.delete_model("agent", 1)
+    assert mm.get_latest_version("agent").version == 2
+
+
+def test_package_scoped_selection_does_not_leak():
+    from sheeprl_tpu.config.loader import ConfigError
+
+    # the package-scoped override targets metric.logger only; an unknown group errors
+    with pytest.raises(ConfigError, match="unknown config group"):
+        compose(config_name="config", overrides=["exp=ppo", "nosuchgroup@metric.logger=mlflow"])
+
+
+def test_tensorboard_sidecar_lands_in_versioned_run_dir(tmp_path, monkeypatch):
+    """get_log_dir wires the version_N dir into the active logger, so the
+    metrics.json ranking sidecar sits next to the run's checkpoints."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu.utils import logger as logger_mod
+
+    cfg = compose(config_name="config", overrides=["exp=ppo", "metric.log_level=1"])
+    lg = logger_mod.get_logger(None, cfg)
+    run_dir = logger_mod.get_log_dir(None, "algo", "run")
+    assert run_dir.endswith("version_0")
+    lg.log_metrics({"Test/cumulative_reward": 7.0}, step=1)
+    lg.finalize()
+    with open(os.path.join(run_dir, "metrics.json")) as f:
+        import json
+
+        assert json.load(f)["Test/cumulative_reward"] == 7.0
