@@ -32,12 +32,12 @@ _PEAK_BF16 = {
 }
 
 
-def _chip_peak_flops(device) -> float:
+def _chip_peak_flops(device):
     kind = getattr(device, "device_kind", "").lower()
     for key, peak in _PEAK_BF16.items():
         if key in kind:
             return peak
-    return 197e12  # default to v5e if the kind string is unrecognized
+    return None  # unknown chip: report MFU as null rather than fabricate one
 
 
 def bench_ppo(total_steps: int = 65536) -> dict:
@@ -147,7 +147,7 @@ def bench_dv3(batch: int = 16, seq: int = 64, iters: int = 20) -> dict:
     gsteps_per_sec = iters / elapsed
     sec_per_step = elapsed / iters
     peak = _chip_peak_flops(runtime.device)
-    mfu = (step_flops / sec_per_step / peak) if step_flops else None
+    mfu = (step_flops / sec_per_step / peak) if (step_flops and peak) else None
     return {
         "dv3_gsteps_per_sec": round(gsteps_per_sec, 3),
         "dv3_frames_per_sec": round(gsteps_per_sec * batch * seq, 1),

@@ -35,7 +35,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+from sheeprl_tpu.utils.utils import PlayerParamsSync, Ratio, polyak_update, save_configs
 
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
@@ -54,7 +54,7 @@ def _scatter_tree(zeros, grads, i):
     return jax.tree_util.tree_map(lambda z, g: z.at[i].set(g), zeros, grads)
 
 
-def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target_entropy):
+def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, params_sync=None):
     n_critics = int(cfg.algo.critic.n)
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
@@ -161,7 +161,9 @@ def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target
             single_update, (params, opt_states), (critic_batches, actor_batches, keys)
         )
         mean_losses = losses.mean(axis=0)
-        return params, opt_states, {
+        # flat actor for the one-transfer player refresh (see PlayerParamsSync)
+        flat_actor = params_sync.ravel(params.actor) if params_sync is not None else None
+        return params, opt_states, flat_actor, {
             "Loss/value_loss": mean_losses[0],
             "Loss/policy_loss": mean_losses[1],
             "Loss/alpha_loss": mean_losses[2],
@@ -224,7 +226,10 @@ def main(runtime, cfg: Dict[str, Any]):
     action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
     action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
 
-    init_opt, train_fn = make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target_entropy)
+    params_sync = PlayerParamsSync(player.params)
+    init_opt, train_fn = make_train_fn(
+        actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, params_sync
+    )
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
@@ -264,6 +269,7 @@ def main(runtime, cfg: Dict[str, Any]):
         prefill_steps += start_iter
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    player_sync_every = max(1, int(cfg.algo.get("player_sync_every", 1)))
     if state:
         ratio.load_state_dict(state["ratio"])
 
@@ -293,6 +299,7 @@ def main(runtime, cfg: Dict[str, Any]):
     rng = jax.random.PRNGKey(cfg.seed)
     mlp_keys = cfg.algo.mlp_keys.encoder
 
+    last_flat_actor = None
     obs = envs.reset(seed=cfg.seed)[0]
     obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
 
@@ -349,12 +356,19 @@ def main(runtime, cfg: Dict[str, Any]):
                 actor_batch = actor_prefetcher.get()
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, train_metrics = train_fn(
+                    params, opt_states, flat_actor, train_metrics = train_fn(
                         params, opt_states, critic_batches, actor_batch, train_key
                     )
-                    # keep Time/train_time honest; the prefetch workers overlap anyway
-                    jax.block_until_ready(params.actor)
-                    player.params = params.actor
+                    # ONE flat cross-backend transfer refreshes the host player; on
+                    # remote accelerators cfg.algo.player_sync_every amortizes the
+                    # round-trip. The explicit block keeps Time/train_time honest on
+                    # locally-attached backends (async dispatch returns instantly).
+                    last_flat_actor = flat_actor
+                    if iter_num % player_sync_every == 0:
+                        player.params = params_sync.pull(flat_actor, runtime.player_device)
+                        jax.block_until_ready(player.params)
+                    else:
+                        jax.block_until_ready(flat_actor)
                 train_step += world_size * g
                 if cfg.metric.log_level > 0 and aggregator:
                     aggregator.update_from_device(train_metrics)
@@ -408,6 +422,10 @@ def main(runtime, cfg: Dict[str, Any]):
     actor_prefetcher.close()
     profiler.close()
     envs.close()
+    if last_flat_actor is not None:
+        # final refresh: player_sync_every may have skipped the last iterations,
+        # and test()/model registration must see the final policy
+        player.params = params_sync.pull(last_flat_actor, runtime.player_device)
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)
     if logger:

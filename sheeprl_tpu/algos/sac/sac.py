@@ -39,7 +39,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+from sheeprl_tpu.utils.utils import PlayerParamsSync, Ratio, polyak_update, save_configs
 
 
 class SACOptStates(NamedTuple):
@@ -48,7 +48,9 @@ class SACOptStates(NamedTuple):
     alpha: Any
 
 
-def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every: int):
+def make_train_fn(
+    actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every: int, params_sync=None
+):
     n_critics = int(cfg.algo.critic.n)
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
@@ -130,7 +132,11 @@ def make_train_fn(actor, critic, cfg, runtime, action_scale, action_bias, target
             single_update, (params, opt_states, update_start), (batches, keys)
         )
         mean_losses = losses.mean(axis=0)
-        return params, opt_states, update_end, {
+        # flatten the actor for the player refresh INSIDE the jitted step: one
+        # cross-backend transfer instead of a per-leaf round-trip storm (see
+        # PlayerParamsSync)
+        flat_actor = params_sync.ravel(params.actor) if params_sync is not None else None
+        return params, opt_states, update_end, flat_actor, {
             "Loss/value_loss": mean_losses[0],
             "Loss/policy_loss": mean_losses[1],
             "Loss/alpha_loss": mean_losses[2],
@@ -196,8 +202,9 @@ def main(runtime, cfg: Dict[str, Any]):
 
     policy_steps_per_iter = int(n_envs)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1
+    params_sync = PlayerParamsSync(player.params)
     init_opt, train_fn = make_train_fn(
-        actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every
+        actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every, params_sync
     )
     opt_states = init_opt(params)
     if state:
@@ -238,6 +245,7 @@ def main(runtime, cfg: Dict[str, Any]):
         prefill_steps += start_iter
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    player_sync_every = max(1, int(cfg.algo.get("player_sync_every", 1)))
     if state:
         ratio.load_state_dict(state["ratio"])
 
@@ -266,6 +274,7 @@ def main(runtime, cfg: Dict[str, Any]):
     mlp_keys = cfg.algo.mlp_keys.encoder
     cumulative_grad_steps = 0
 
+    last_flat_actor = None
     obs = envs.reset(seed=cfg.seed)[0]
     obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
 
@@ -325,12 +334,19 @@ def main(runtime, cfg: Dict[str, Any]):
                 batches = prefetcher.get(g=g)
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, update_counter, train_metrics = train_fn(
+                    params, opt_states, update_counter, flat_actor, train_metrics = train_fn(
                         params, opt_states, batches, train_key, update_counter
                     )
-                    # keep Time/train_time honest; the prefetch worker overlaps anyway
-                    jax.block_until_ready(params.actor)
-                    player.params = params.actor
+                    # ONE flat cross-backend transfer refreshes the host player; on
+                    # remote accelerators cfg.algo.player_sync_every amortizes the
+                    # round-trip. The explicit block keeps Time/train_time honest on
+                    # locally-attached backends (async dispatch returns instantly).
+                    last_flat_actor = flat_actor
+                    if iter_num % player_sync_every == 0:
+                        player.params = params_sync.pull(flat_actor, runtime.player_device)
+                        jax.block_until_ready(player.params)
+                    else:
+                        jax.block_until_ready(flat_actor)
                     cumulative_grad_steps += g
                 train_step += world_size * g
 
@@ -392,6 +408,10 @@ def main(runtime, cfg: Dict[str, Any]):
     prefetcher.close()
     profiler.close()
     envs.close()
+    if last_flat_actor is not None:
+        # final refresh: player_sync_every may have skipped the last iterations,
+        # and test()/model registration must see the final policy
+        player.params = params_sync.pull(last_flat_actor, runtime.player_device)
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)
     if logger:

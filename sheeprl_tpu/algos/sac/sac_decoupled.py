@@ -167,7 +167,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     def trainer_step(payload):
         batches, train_key = trainer_rt.replicate(payload)
-        new_params, new_opt, update_end, metrics = train_fn(
+        new_params, new_opt, update_end, _flat_actor, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_states"], batches, train_key,
             trainer_state["update_counter"],
         )

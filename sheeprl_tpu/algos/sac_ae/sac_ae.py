@@ -33,7 +33,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+from sheeprl_tpu.utils.utils import PlayerParamsSync, Ratio, polyak_update, save_configs
 
 
 class SACAEOptStates(NamedTuple):
@@ -44,7 +44,7 @@ class SACAEOptStates(NamedTuple):
     decoder: Any
 
 
-def make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entropy):
+def make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entropy, params_sync=None):
     encoder, decoder, qf, actor_head = (
         modules["encoder"],
         modules["decoder"],
@@ -205,7 +205,9 @@ def make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entro
             single_update, (params, opt_states, counter), (batches, keys)
         )
         mean_losses = losses.mean(axis=0)
-        return params, opt_states, counter, {
+        # flat (encoder, actor) for the one-transfer player refresh (PlayerParamsSync)
+        flat_player = params_sync.ravel((params.encoder, params.actor)) if params_sync is not None else None
+        return params, opt_states, counter, flat_player, {
             "Loss/value_loss": mean_losses[0],
             "Loss/policy_loss": mean_losses[1],
             "Loss/alpha_loss": mean_losses[2],
@@ -261,7 +263,10 @@ def main(runtime, cfg: Dict[str, Any]):
     action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
     action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
 
-    init_opt, train_fn = make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entropy)
+    params_sync = PlayerParamsSync((player.encoder_params, player.actor_params))
+    init_opt, train_fn = make_train_fn(
+        modules, cfg, runtime, action_scale, action_bias, target_entropy, params_sync
+    )
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
@@ -302,6 +307,7 @@ def main(runtime, cfg: Dict[str, Any]):
         prefill_steps += start_iter
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    player_sync_every = max(1, int(cfg.algo.get("player_sync_every", 1)))
     if state:
         ratio.load_state_dict(state["ratio"])
 
@@ -314,6 +320,7 @@ def main(runtime, cfg: Dict[str, Any]):
             return arr.reshape(n_envs, -1, *arr.shape[-2:])
         return arr.reshape(n_envs, -1)
 
+    last_flat_player = None
     obs = envs.reset(seed=cfg.seed)[0]
     stored_obs = {k: to_stored(obs, k) for k in obs_keys}
 
@@ -373,12 +380,21 @@ def main(runtime, cfg: Dict[str, Any]):
                         for k, v in sample.items()
                     }
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, update_counter, train_metrics = train_fn(
+                    params, opt_states, update_counter, flat_player, train_metrics = train_fn(
                         params, opt_states, batches, train_key, update_counter
                     )
-                    jax.block_until_ready(params.actor)
-                    player.encoder_params = params.encoder
-                    player.actor_params = params.actor
+                    # ONE flat cross-backend transfer refreshes the host player; on
+                    # remote accelerators cfg.algo.player_sync_every amortizes the
+                    # round-trip. The explicit block keeps Time/train_time honest on
+                    # locally-attached backends (async dispatch returns instantly).
+                    last_flat_player = flat_player
+                    if iter_num % player_sync_every == 0:
+                        player.encoder_params, player.actor_params = params_sync.pull(
+                            flat_player, runtime.player_device
+                        )
+                        jax.block_until_ready(player.actor_params)
+                    else:
+                        jax.block_until_ready(flat_player)
                 train_step += world_size * g
                 if cfg.metric.log_level > 0 and aggregator:
                     aggregator.update_from_device(train_metrics)
@@ -431,6 +447,10 @@ def main(runtime, cfg: Dict[str, Any]):
 
     profiler.close()
     envs.close()
+    if last_flat_player is not None:
+        # final refresh: player_sync_every may have skipped the last iterations,
+        # and test()/model registration must see the final policy
+        player.encoder_params, player.actor_params = params_sync.pull(last_flat_player, runtime.player_device)
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir)
     if logger:

@@ -122,11 +122,15 @@ def _compose_file(
     search: Sequence[str],
     selections: Dict[str, str],
     group_prefix: str = "",
+    consumed: Optional[set] = None,
 ) -> Dict[str, Any]:
     """Compose one yaml file: process its defaults list, then merge its own body.
 
     ``group_prefix`` is the group dir of the file itself, so relative defaults entries
     (e.g. ``- ppo`` inside ``algo/a2c.yaml``) resolve within the same group.
+    ``consumed`` (when given) collects the ``group@package`` selection keys that
+    matched a mount, so compose() can reject typo'd packages instead of silently
+    ignoring them.
     """
     raw = _load_yaml(path)
     defaults = raw.pop("defaults", None)
@@ -156,7 +160,7 @@ def _compose_file(
                 sub_path = _find_yaml(rel, search)
                 if sub_path is None:
                     raise ConfigError(f"Cannot find base config '{rel}' (from {path})")
-                _deep_merge(composed, _compose_file(sub_path, search, selections, group_prefix))
+                _deep_merge(composed, _compose_file(sub_path, search, selections, group_prefix, consumed))
                 continue
             group = group_rel if absolute or not group_prefix else os.path.join(group_prefix, group_rel)
             if is_override:
@@ -169,9 +173,13 @@ def _compose_file(
             # a bare "group=option" selection re-points every mount.
             local_pkg = placement if placement is not None else group_rel.split("/")[-1]
             eff_pkg = f"{group_prefix}.{local_pkg}" if group_prefix else local_pkg
-            option = selections.get(
-                f"{group_rel}@{eff_pkg}", selections.get(group_rel, option)
-            )
+            pkg_key = f"{group_rel}@{eff_pkg}"
+            if pkg_key in selections:
+                option = selections[pkg_key]
+                if consumed is not None:
+                    consumed.add(pkg_key)
+            else:
+                option = selections.get(group_rel, option)
             if option in (None, "null"):
                 continue
             if option == MISSING:
@@ -181,7 +189,7 @@ def _compose_file(
             sub_path = _find_yaml(rel, search)
             if sub_path is None:
                 raise ConfigError(f"Cannot find config '{rel}' referenced from {path}")
-            sub_cfg = _compose_file(sub_path, search, selections, os.path.dirname(rel))
+            sub_cfg = _compose_file(sub_path, search, selections, os.path.dirname(rel), consumed)
             target_key = placement if placement is not None else group_rel.split("/")[-1]
             if target_key in ("_global_", "_here_", ""):
                 _deep_merge(composed, sub_cfg)
@@ -326,6 +334,7 @@ def compose(
     for group, sel in selections.items():
         harvested[group] = sel
 
+    consumed_pkgs: set = set()
     overlay_cfgs: Dict[str, Dict[str, Any]] = {}
     # exp (and any group whose file uses @_global_ packaging) must be able to override
     # other groups, so compose them first.
@@ -344,7 +353,7 @@ def compose(
         # seed with CLI selections so nested group mounts (e.g. metric/default.yaml's
         # "/logger@logger") honor "group@package=option" overrides
         sub_sel: Dict[str, str] = dict(selections)
-        cfg_piece = _compose_file(path, search, sub_sel, group)
+        cfg_piece = _compose_file(path, search, sub_sel, group, consumed_pkgs)
         overlay_cfgs[group] = cfg_piece
         for g, o in sub_sel.items():
             if o is not None and g not in selections:  # CLI wins over overlay overrides
@@ -372,7 +381,7 @@ def compose(
             raise ConfigError(f"Cannot find config '{rel}' for {group}={option}")
         cfg_piece = overlay_cfgs.get(group)
         if cfg_piece is None:
-            cfg_piece = _compose_file(path, search, dict(selections), group)
+            cfg_piece = _compose_file(path, search, dict(selections), group, consumed_pkgs)
         target_key = placement if placement is not None else group.split("/")[-1]
         if _is_global_packaged(path):
             _deep_merge(cfg, cfg_piece)
@@ -386,6 +395,16 @@ def compose(
                 cfg[target_key] = cfg_piece
         # record which option was chosen (useful for checkpoints/debug)
         cfg.setdefault("_groups_", {})[group] = option
+
+    # Reject package-scoped selections that matched no mount (silent typos:
+    # "logger@metric.loger=mlflow" would otherwise leave the default in place).
+    for sel_key in selections:
+        if "@" in sel_key and sel_key not in consumed_pkgs:
+            group, package = sel_key.split("@", 1)
+            raise ConfigError(
+                f"Override '{sel_key}={selections[sel_key]}' matched no mount of group "
+                f"'{group}' at package '{package}' (check the package path)"
+            )
 
     # Dotted overrides, after composition.
     for key, value in dotted:

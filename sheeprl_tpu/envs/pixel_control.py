@@ -1,0 +1,119 @@
+"""PixelTarget: a self-contained learnable pixel-control environment.
+
+The image (Atari/Crafter) dependencies are optional; this env provides a
+dependency-free pixel workload with real visual dynamics for end-to-end learning
+demonstrations and benchmarks of the CNN encoder/decoder path: an agent square
+navigates a 2D arena toward a target square, observing only a rendered RGB frame.
+There is no reference counterpart (the reference leans on Atari for this role,
+reference README.md:44-59); the env follows the gymnasium API like envs/dummy.py.
+
+Dynamics:
+- arena: ``size x size`` pixels (default 64), borders clamp movement;
+- agent: white ``block x block`` square, moved by 5 discrete actions
+  (noop / up / down / left / right, ``step_px`` pixels per move);
+- target: red square, re-sampled each episode at least a quarter-arena away;
+- reward: +1 on reaching the target (episode ends), else a small per-step
+  penalty plus a dense progress shaping term (scaled distance decrease);
+- horizon: ``max_steps`` steps (truncation).
+
+A uniform-random policy rarely reaches the target from a far spawn, while the
+optimal policy takes a few dozen steps, so reward curves separate cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+
+class PixelTargetEnv(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+
+    def __init__(
+        self,
+        size: int = 64,
+        block: int = 8,
+        step_px: int = 4,
+        max_steps: int = 100,
+        shaping: float = 1.0,
+        seed: Optional[int] = None,
+        render_mode: str = "rgb_array",
+    ):
+        self._size = int(size)
+        self._block = int(block)
+        self._step_px = int(step_px)
+        self._max_steps = int(max_steps)
+        self._shaping = float(shaping)
+        self._rng = np.random.default_rng(seed)
+        self.render_mode = render_mode
+
+        self.observation_space = gym.spaces.Dict(
+            {"rgb": gym.spaces.Box(0, 255, shape=(3, self._size, self._size), dtype=np.uint8)}
+        )
+        self.action_space = gym.spaces.Discrete(5)
+        self.reward_range = (-np.inf, 1.0)
+
+        self._agent = np.zeros(2, dtype=np.int32)
+        self._target = np.zeros(2, dtype=np.int32)
+        self._steps = 0
+
+    # ----- helpers -------------------------------------------------------------------
+    def _draw(self) -> np.ndarray:
+        frame = np.zeros((3, self._size, self._size), dtype=np.uint8)
+        b = self._block
+        ty, tx = self._target
+        frame[0, ty : ty + b, tx : tx + b] = 255  # red target
+        ay, ax = self._agent
+        frame[:, ay : ay + b, ax : ax + b] = 255  # white agent (drawn on top)
+        return frame
+
+    def _dist(self) -> float:
+        return float(np.abs(self._agent - self._target).sum())
+
+    def _reached(self) -> bool:
+        return bool(np.all(np.abs(self._agent - self._target) < self._block))
+
+    def get_obs(self):
+        return {"rgb": self._draw()}
+
+    # ----- gym API -------------------------------------------------------------------
+    def reset(self, seed=None, options=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        hi = self._size - self._block
+        self._agent = self._rng.integers(0, hi + 1, size=2).astype(np.int32)
+        # re-sample the target until it spawns at least a quarter-arena away
+        while True:
+            self._target = self._rng.integers(0, hi + 1, size=2).astype(np.int32)
+            if np.abs(self._agent - self._target).sum() >= self._size // 4:
+                break
+        self._steps = 0
+        return self.get_obs(), {}
+
+    def step(self, action):
+        action = int(np.asarray(action).reshape(-1)[0])
+        prev = self._dist()
+        delta = {
+            0: (0, 0),
+            1: (-self._step_px, 0),
+            2: (self._step_px, 0),
+            3: (0, -self._step_px),
+            4: (0, self._step_px),
+        }[action]
+        hi = self._size - self._block
+        self._agent = np.clip(self._agent + np.asarray(delta, dtype=np.int32), 0, hi)
+        self._steps += 1
+
+        terminated = self._reached()
+        truncated = self._steps >= self._max_steps and not terminated
+        progress = (prev - self._dist()) / max(self._step_px, 1)  # in [-1, 1] per step
+        reward = 1.0 if terminated else (-0.01 + 0.01 * self._shaping * progress)
+        return self.get_obs(), float(reward), terminated, truncated, {}
+
+    def render(self):
+        return np.moveaxis(self._draw(), 0, -1)  # HWC for video recorders
+
+    def close(self):
+        pass

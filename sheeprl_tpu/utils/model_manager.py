@@ -5,9 +5,9 @@ TPU-native replacement for the reference's MLflow-backed manager
 registry — models are host-numpy pytrees pickled under
 ``<registry_dir>/<model_name>/v<N>/`` with JSON metadata and a Markdown
 changelog, mirroring MLflow's model-version semantics (register / latest /
-transition-stage / delete / download). A ``model_manager.backend=mlflow``
-selection is reserved but NOT implemented — it raises with a pointer to
-mlflow's own registry; the local backend is the supported path.
+transition-stage / delete / download). ``model_manager.backend=mlflow``
+selects :class:`MlflowModelManager`, the same surface backed by mlflow's
+registry behind ``MLFLOW_TRACKING_URI`` (optional dependency, mlflow<3).
 
 Every algorithm's ``utils.log_models_from_checkpoint`` calls :func:`log_model`
 per model and returns ``{name: ModelInfo}``; the registration CLI

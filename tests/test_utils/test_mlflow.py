@@ -112,3 +112,10 @@ def test_tensorboard_sidecar_lands_in_versioned_run_dir(tmp_path, monkeypatch):
         import json
 
         assert json.load(f)["Test/cumulative_reward"] == 7.0
+
+
+def test_package_typo_rejected():
+    from sheeprl_tpu.config.loader import ConfigError
+
+    with pytest.raises(ConfigError, match="matched no mount"):
+        compose(config_name="config", overrides=["exp=ppo", "logger@metric.loger=mlflow"])
