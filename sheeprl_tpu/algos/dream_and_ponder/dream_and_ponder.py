@@ -64,6 +64,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
     BernoulliSafeMode,
@@ -546,6 +547,13 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
+    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
+    # call is sampled + device_put while the chip still runs the current train step
+    # (see sheeprl_tpu/data/prefetch.py)
+    prefetcher = DevicePrefetcher(
+        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+    )
+
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]
@@ -583,7 +591,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -642,7 +651,8 @@ def main(runtime, cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
@@ -654,13 +664,14 @@ def main(runtime, cfg: Dict[str, Any]):
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                # consumes the batch prefetched during the previous train step and
+                # immediately speculates the next one
+                batches = prefetcher.get(
+                    batch_size=cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric()):
-                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, moments_state, counter, train_metrics = train_fn(
                         params, opt_states, moments_state, counter, batches, train_key
@@ -730,6 +741,7 @@ def main(runtime, cfg: Dict[str, Any]):
             )
 
     profiler.close()
+    prefetcher.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, runtime, cfg, log_dir, greedy=False)

@@ -300,6 +300,7 @@ def main(runtime, cfg: Dict[str, Any]):
     mlp_keys = cfg.algo.mlp_keys.encoder
 
     last_flat_actor = None
+    train_calls = 0
     obs = envs.reset(seed=cfg.seed)[0]
     obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
 
@@ -364,7 +365,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     # round-trip. The explicit block keeps Time/train_time honest on
                     # locally-attached backends (async dispatch returns instantly).
                     last_flat_actor = flat_actor
-                    if iter_num % player_sync_every == 0:
+                    # cadence counts TRAIN calls (iter_num can skip sync forever
+                    # when Ratio grants steps only on a phase-locked subset)
+                    train_calls += 1
+                    if train_calls % player_sync_every == 0:
                         player.params = params_sync.pull(flat_actor, runtime.player_device)
                         jax.block_until_ready(player.params)
                     else:

@@ -18,6 +18,7 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import DV1OptStates, make_train_fn
 from sheeprl_tpu.algos.dreamer_v2.agent import expl_amount_schedule
@@ -25,6 +26,7 @@ from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.utils.checkpoint import load_state
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -194,6 +196,13 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
+    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
+    # call is sampled + device_put while the chip still runs the current train step
+    # (see sheeprl_tpu/data/prefetch.py)
+    prefetcher = DevicePrefetcher(
+        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+    )
+
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]
@@ -202,7 +211,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     step_data["actions"] = np.zeros((1, cfg.env.num_envs, int(np.sum(actions_dim))))
     step_data["rewards"] = np.zeros((1, cfg.env.num_envs, 1))
     step_data["is_first"] = np.ones_like(step_data["terminated"])
-    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+    with prefetcher.guard():  # no torn rows under the worker's sample
+        rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player.init_states()
 
     base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
@@ -259,7 +269,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         step_data["rewards"] = clip_rewards_fn(
             np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
         )
-        rb.add(step_data, validate_args=cfg.buffer.validate_args)
+        with prefetcher.guard():  # no torn rows under the worker's sample
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
         dones_idxes = dones.nonzero()[0].tolist()
         reset_envs = len(dones_idxes)
@@ -272,7 +283,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
             reset_data["rewards"] = np.zeros((1, reset_envs, 1))
             reset_data["is_first"] = np.ones_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
             for d in dones_idxes:
                 step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
                 step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
@@ -288,13 +300,14 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     player.actor_type = "task"
                     player.actor = modules.actor_task
                     player.actor_params = fine_params["actor"]
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                # consumes the batch prefetched during the previous train step and
+                # immediately speculates the next one
+                batches = prefetcher.get(
+                    batch_size=cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric()):
-                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
                     fine_params, opt_states, train_metrics = train_fn(fine_params, opt_states, batches, train_key)
                     jax.block_until_ready(fine_params["actor"])
@@ -364,6 +377,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             )
 
     profiler.close()
+    prefetcher.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor = modules.actor_task

@@ -19,12 +19,14 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import DV3OptStates, make_train_fn
 from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test, get_action_masks
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.utils.checkpoint import load_state
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -209,6 +211,13 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
+    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
+    # call is sampled + device_put while the chip still runs the current train step
+    # (see sheeprl_tpu/data/prefetch.py)
+    prefetcher = DevicePrefetcher(
+        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+    )
+
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]
@@ -235,7 +244,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 real_actions = np.stack([np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1)
 
             step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs, rewards, terminated, truncated, infos = envs.step(
                 real_actions.reshape(envs.action_space.shape)
@@ -280,7 +290,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
             reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
             reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
 
             step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
             step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
@@ -298,13 +309,14 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                     player.actor_type = "task"
                     player.actor = modules.actor_task
                     player.actor_params = fine_params["actor"]
-                local_data = rb.sample(
-                    cfg.algo.per_rank_batch_size * world_size,
+                # consumes the batch prefetched during the previous train step and
+                # immediately speculates the next one
+                batches = prefetcher.get(
+                    batch_size=cfg.algo.per_rank_batch_size * world_size,
                     sequence_length=cfg.algo.per_rank_sequence_length,
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric()):
-                    batches = {k: jnp.asarray(v) for k, v in local_data.items()}
                     rng, train_key = jax.random.split(rng)
                     fine_params, opt_states, moments_state, counter, train_metrics = train_fn(
                         fine_params, opt_states, moments_state, counter, batches, train_key
@@ -375,6 +387,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             )
 
     profiler.close()
+    prefetcher.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor = modules.actor_task

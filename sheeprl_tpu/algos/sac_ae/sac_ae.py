@@ -321,6 +321,7 @@ def main(runtime, cfg: Dict[str, Any]):
         return arr.reshape(n_envs, -1)
 
     last_flat_player = None
+    train_calls = 0
     obs = envs.reset(seed=cfg.seed)[0]
     stored_obs = {k: to_stored(obs, k) for k in obs_keys}
 
@@ -388,7 +389,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     # round-trip. The explicit block keeps Time/train_time honest on
                     # locally-attached backends (async dispatch returns instantly).
                     last_flat_player = flat_player
-                    if iter_num % player_sync_every == 0:
+                    # cadence counts TRAIN calls (iter_num can skip sync forever
+                    # when Ratio grants steps only on a phase-locked subset)
+                    train_calls += 1
+                    if train_calls % player_sync_every == 0:
                         player.encoder_params, player.actor_params = params_sync.pull(
                             flat_player, runtime.player_device
                         )

@@ -335,13 +335,23 @@ def compose(
         harvested[group] = sel
 
     consumed_pkgs: set = set()
+
+    def _root_mount_selection(group: str, placement: Optional[str], current):
+        """Honor (and mark consumed) a package-scoped CLI selection addressing a
+        ROOT-defaults mount of ``group`` (e.g. the Hydra-valid ``algo@algo=sac``)."""
+        pkg_key = f"{group}@{placement if placement is not None else group.split('/')[-1]}"
+        if pkg_key in selections:
+            consumed_pkgs.add(pkg_key)
+            return selections[pkg_key]
+        return current
+
     overlay_cfgs: Dict[str, Dict[str, Any]] = {}
     # exp (and any group whose file uses @_global_ packaging) must be able to override
     # other groups, so compose them first.
     for group, placement in ordered_groups:
         if group == "_self_":
             continue
-        option = harvested.get(group)
+        option = _root_mount_selection(group, placement, harvested.get(group))
         if option in (None, "null"):
             continue
         if option == MISSING:
@@ -368,7 +378,7 @@ def compose(
             body = {k: v for k, v in raw_root.items() if k != "defaults"}
             _deep_merge(cfg, body)
             continue
-        option = harvested.get(group)
+        option = _root_mount_selection(group, placement, harvested.get(group))
         if option in (None, "null"):
             continue
         if option == MISSING:

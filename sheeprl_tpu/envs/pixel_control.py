@@ -45,6 +45,13 @@ class PixelTargetEnv(gym.Env):
         self._block = int(block)
         self._step_px = int(step_px)
         self._max_steps = int(max_steps)
+        # degenerate geometries would make reset()'s separation loop spin forever
+        # (or integers(0, hi+1) raise): fail fast with the actual constraint
+        if self._block >= self._size or 2 * (self._size - self._block) < self._size // 4:
+            raise ValueError(
+                f"size={size}, block={block} cannot place agent and target a quarter-"
+                f"arena apart; need block < size and 2*(size-block) >= size//4"
+            )
         self._shaping = float(shaping)
         self._rng = np.random.default_rng(seed)
         self.render_mode = render_mode

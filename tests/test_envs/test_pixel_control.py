@@ -64,3 +64,10 @@ def test_make_env_factory():
     out = env.step(env.action_space.sample())
     assert len(out) == 5
     env.close()
+
+
+def test_degenerate_geometry_rejected():
+    with pytest.raises(ValueError, match="quarter"):
+        PixelTargetEnv(size=8, block=8)  # no free space at all
+    with pytest.raises(ValueError, match="quarter"):
+        PixelTargetEnv(size=64, block=58)  # free space < required separation

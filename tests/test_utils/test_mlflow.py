@@ -119,3 +119,9 @@ def test_package_typo_rejected():
 
     with pytest.raises(ConfigError, match="matched no mount"):
         compose(config_name="config", overrides=["exp=ppo", "logger@metric.loger=mlflow"])
+
+
+def test_root_mount_package_override():
+    # Hydra-valid spelling addressing a root mount's own package
+    cfg = compose(config_name="config", overrides=["exp=ppo", "algo@algo=a2c"])
+    assert cfg.algo.name == "a2c"
