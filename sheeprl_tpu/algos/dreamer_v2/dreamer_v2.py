@@ -442,6 +442,11 @@ def main(runtime, cfg: Dict[str, Any]):
 
     buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
     buffer_type = str(cfg.buffer.type).lower()
+    if bool(cfg.buffer.get("device", False)):
+        raise ValueError(
+            "buffer.device=True is not supported by this algorithm's buffer layout "
+            "(sequential+episode); use the host buffers"
+        )
     if buffer_type == "sequential":
         rb = EnvIndependentReplayBuffer(
             buffer_size,

@@ -26,7 +26,8 @@ from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv1.agent import build_agent
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import DevicePrefetcher
+from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
 from sheeprl_tpu.utils.checkpoint import load_state
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -157,14 +158,25 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
         aggregator = instantiate(cfg.metric.aggregator)
 
     buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
-    rb = EnvIndependentReplayBuffer(
-        buffer_size,
-        n_envs=cfg.env.num_envs,
-        obs_keys=tuple(obs_keys),
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        buffer_cls=SequentialReplayBuffer,
-    )
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    if use_device_buffer:
+        if world_size > 1:
+            raise ValueError(
+                "buffer.device=True is single-device only (shard the host buffer "
+                "across processes instead for data-parallel runs)"
+            )
+        rb = DeviceSequentialReplayBuffer(
+            buffer_size, n_envs=cfg.env.num_envs, device=runtime.device
+        )
+    else:
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
     if "rb" in state and (resumed or (cfg.buffer.load_from_exploration and exploration_cfg.buffer.checkpoint)):
         rb.load_state_dict(state["rb"])
 
@@ -196,12 +208,16 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
-    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
-    # call is sampled + device_put while the chip still runs the current train step
-    # (see sheeprl_tpu/data/prefetch.py)
-    prefetcher = DevicePrefetcher(
-        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
-    )
+    if use_device_buffer:
+        # storage + sampling already live in HBM: nothing to prefetch
+        prefetcher = InlineSampler(rb.sample)
+    else:
+        # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
+        # call is sampled + device_put while the chip still runs the current train
+        # step (see sheeprl_tpu/data/prefetch.py)
+        prefetcher = DevicePrefetcher(
+            rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+        )
 
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:

@@ -220,6 +220,11 @@ def main(runtime, cfg: Dict[str, Any]):
         aggregator = instantiate(cfg.metric.aggregator)
 
     buffer_size = cfg.buffer.size // n_envs if not cfg.dry_run else 1
+    if bool(cfg.buffer.get("device", False)):
+        raise ValueError(
+            "buffer.device=True is currently supported by the Dreamer-family loops "
+            "only; use the host buffer here"
+        )
     rb = ReplayBuffer(
         buffer_size,
         n_envs,

@@ -46,11 +46,14 @@ class PixelTargetEnv(gym.Env):
         self._step_px = int(step_px)
         self._max_steps = int(max_steps)
         # degenerate geometries would make reset()'s separation loop spin forever
-        # (or integers(0, hi+1) raise): fail fast with the actual constraint
-        if self._block >= self._size or 2 * (self._size - self._block) < self._size // 4:
+        # (or integers(0, hi+1) raise): the worst agent spawn is the center of the
+        # free range [0, size-block], from which the farthest target is L1-distance
+        # (size-block) away — that must still meet the quarter-arena separation
+        if self._block >= self._size or (self._size - self._block) < self._size // 4:
             raise ValueError(
                 f"size={size}, block={block} cannot place agent and target a quarter-"
-                f"arena apart; need block < size and 2*(size-block) >= size//4"
+                f"arena apart from every spawn; need block < size and "
+                f"(size-block) >= size//4"
             )
         self._shaping = float(shaping)
         self._rng = np.random.default_rng(seed)
