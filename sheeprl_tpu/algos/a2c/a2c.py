@@ -149,7 +149,7 @@ def main(runtime, cfg: Dict[str, Any]):
     opt_state = tx.init(params)
     if state:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
-    opt_state = runtime.replicate(opt_state)
+    opt_state = runtime.place_params(opt_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)

@@ -430,8 +430,8 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
     counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
-    params = runtime.replicate(params)
-    opt_states = runtime.replicate(opt_states)
+    params = runtime.place_params(params)
+    opt_states = runtime.place_params(opt_states)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)

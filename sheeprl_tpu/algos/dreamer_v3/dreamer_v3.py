@@ -36,9 +36,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
+from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
     BernoulliSafeMode,
@@ -456,8 +454,8 @@ def main(runtime, cfg: Dict[str, Any]):
     if state and "moments" in state:
         moments_state = MomentsState(*[jnp.asarray(v) for v in state["moments"]])
     counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
-    params = runtime.replicate(params)
-    opt_states = runtime.replicate(opt_states)
+    params = runtime.place_params(params)
+    opt_states = runtime.place_params(opt_states)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -466,26 +464,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
-    use_device_buffer = bool(cfg.buffer.get("device", False))
-    if use_device_buffer:
-        if world_size > 1:
-            raise ValueError(
-                "buffer.device=True is single-device only (shard the host buffer "
-                "across processes instead for data-parallel runs)"
-            )
-        rb = DeviceSequentialReplayBuffer(
-            buffer_size, n_envs=cfg.env.num_envs, device=runtime.device, obs_keys=tuple(obs_keys)
-        )
-    else:
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=cfg.env.num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
+    rb, prefetcher, use_device_buffer = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -508,16 +487,6 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         ratio.load_state_dict(state["ratio"])
 
-    if use_device_buffer:
-        # storage + sampling already live in HBM: nothing to prefetch
-        prefetcher = InlineSampler(rb.sample)
-    else:
-        # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
-        # call is sampled + device_put while the current train step still occupies the
-        # chip (reference counterpart: sample_tensors' pinned-memory non_blocking path,
-        # sheeprl/data/buffers.py:290-326).
-        batch_sharding = NamedSharding(runtime.mesh, P(None, None, "data"))
-        prefetcher = DevicePrefetcher(rb.sample, device=batch_sharding)
 
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
@@ -584,19 +553,9 @@ def main(runtime, cfg: Dict[str, Any]):
         if "restart_on_exception" in infos:
             for i, agent_roe in enumerate(infos["restart_on_exception"]):
                 if agent_roe and not dones[i]:
-                    if use_device_buffer:
-                        rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
-                    else:
-                        last_inserted_idx = (rb.buffer[i]._pos - 1) % rb.buffer[i].buffer_size
-                        rb.buffer[i]["terminated"][last_inserted_idx] = np.zeros_like(
-                            rb.buffer[i]["terminated"][last_inserted_idx]
-                        )
-                        rb.buffer[i]["truncated"][last_inserted_idx] = np.ones_like(
-                            rb.buffer[i]["truncated"][last_inserted_idx]
-                        )
-                        rb.buffer[i]["is_first"][last_inserted_idx] = np.zeros_like(
-                            rb.buffer[i]["is_first"][last_inserted_idx]
-                        )
+                    # crash-restart boundary: the last stored transition becomes a
+                    # truncation (works on host and HBM buffers alike)
+                    rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
 
         if cfg.metric.log_level > 0:

@@ -151,8 +151,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             critic=jax.tree_util.tree_map(jnp.asarray, critic) if critic is not None else opt_states.critic,
         )
     counter = jnp.int32(state["counter"]) if resumed and "counter" in state else jnp.int32(0)
-    fine_params = runtime.replicate(fine_params)
-    opt_states = runtime.replicate(opt_states)
+    fine_params = runtime.place_params(fine_params)
+    opt_states = runtime.place_params(opt_states)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)

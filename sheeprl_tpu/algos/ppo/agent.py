@@ -318,7 +318,7 @@ def build_agent(
     params = agent.init(jax.random.PRNGKey(cfg.seed), sample_obs)
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
-    params = runtime.replicate(params)
+    params = runtime.place_params(params)
     # The player's copy lives on the player device (host CPU by default): per-step
     # policy calls then never pay the accelerator round-trip (reference's
     # get_single_device_fabric split, sheeprl/utils/fabric.py:8-35).

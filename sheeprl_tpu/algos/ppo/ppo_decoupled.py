@@ -45,6 +45,9 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
+    if str(getattr(runtime, "strategy", "auto")).lower() == "fsdp":
+        raise ValueError("fabric.strategy=fsdp is not supported by the decoupled loops; "
+                         "use the coupled trainer")
     if "minedojo" in cfg.env.wrapper._target_.lower():
         raise ValueError(
             "MineDojo is not currently supported by PPO agent, since it does not take "

@@ -179,7 +179,7 @@ def build_agent(
         params = jax.tree_util.tree_map(jnp.asarray, SACParams(*agent_state) if isinstance(agent_state, (tuple, list)) else agent_state)
         if not isinstance(params, SACParams):
             params = SACParams(**params) if isinstance(params, dict) else params
-    params = runtime.replicate(params)
+    params = runtime.place_params(params)
     action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
     action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
     player = SACPlayer(actor, params.actor, action_scale, action_bias)

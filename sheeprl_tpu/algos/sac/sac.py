@@ -206,10 +206,13 @@ def main(runtime, cfg: Dict[str, Any]):
     init_opt, train_fn = make_train_fn(
         actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every, params_sync
     )
+    # the host player must never hold mesh-resident params: its action pulls would
+    # fail/pay per-leaf round-trips, and player_sync_every>1 defers the first refresh
+    player.params = params_sync.pull(jax.jit(params_sync.ravel)(params.actor), runtime.player_device)
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
-    opt_states = runtime.replicate(opt_states)
+    opt_states = runtime.place_params(opt_states)
     update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(0)
 
     if runtime.is_global_zero:

@@ -370,7 +370,7 @@ def build_agent(
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
         if not isinstance(params, SACAEParams):
             params = SACAEParams(*params) if isinstance(params, (tuple, list)) else SACAEParams(**params)
-    params = runtime.replicate(params)
+    params = runtime.place_params(params)
     action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
     action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
     player = SACAEPlayer(encoder, actor_head, params, action_scale, action_bias)

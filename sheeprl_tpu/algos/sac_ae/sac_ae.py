@@ -267,10 +267,14 @@ def main(runtime, cfg: Dict[str, Any]):
     init_opt, train_fn = make_train_fn(
         modules, cfg, runtime, action_scale, action_bias, target_entropy, params_sync
     )
+    # host player starts from host-resident params (see sac.py note)
+    player.encoder_params, player.actor_params = params_sync.pull(
+        jax.jit(params_sync.ravel)((params.encoder, params.actor)), runtime.player_device
+    )
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
-    opt_states = runtime.replicate(opt_states)
+    opt_states = runtime.place_params(opt_states)
     update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(1)
 
     if runtime.is_global_zero:

@@ -558,6 +558,19 @@ class EnvIndependentReplayBuffer:
         for data_col, env_idx in enumerate(indices):
             self._buf[env_idx].add({k: v[:, data_col : data_col + 1] for k, v in data.items()}, validate_args)
 
+    def patch_last(self, env_indices: Sequence[int], values: Dict[str, float]) -> None:
+        """Overwrite scalar keys of the most recent row of the given envs.
+
+        The RestartOnException tail patch (same surface as
+        ``DeviceSequentialReplayBuffer.patch_last``): after an env crash-restart,
+        the last stored transition becomes a truncation boundary.
+        """
+        for i in env_indices:
+            b = self._buf[i]
+            last = (b._pos - 1) % b.buffer_size
+            for k, val in values.items():
+                b[k][last] = np.full_like(b[k][last], val)
+
     def sample(
         self,
         batch_size: int,
@@ -597,6 +610,11 @@ class EnvIndependentReplayBuffer:
         return {"buffers": [b.state_dict() for b in self._buf]}
 
     def load_state_dict(self, state: Dict[str, Any]) -> "EnvIndependentReplayBuffer":
+        if "buffers" not in state:
+            raise ValueError(
+                "This checkpoint's replay buffer was saved by the device (HBM) "
+                "backend; resume with buffer.device=True (or drop buffer.checkpoint)"
+            )
         for b, s in zip(self._buf, state["buffers"]):
             b.load_state_dict(s)
         return self

@@ -48,14 +48,12 @@ class DeviceSequentialReplayBuffer:
         buffer_size: int,
         n_envs: int = 1,
         device: Optional[Any] = None,
-        obs_keys: Sequence[str] = (),
     ):
         if buffer_size <= 0:
             raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
         self._buffer_size = int(buffer_size)
         self._n_envs = int(n_envs)
         self._device = device
-        self._obs_keys = tuple(obs_keys)
         self._buf: Optional[Dict[str, jax.Array]] = None
         # independent circular write head per env (host-side bookkeeping)
         self._pos = np.zeros(self._n_envs, dtype=np.int64)

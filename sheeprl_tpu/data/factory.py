@@ -1,0 +1,67 @@
+"""Shared replay-buffer + sampling-pipeline construction for the Dreamer-family loops.
+
+One place decides between the host path (EnvIndependentReplayBuffer over
+SequentialReplayBuffer + the double-buffered DevicePrefetcher) and the
+HBM-resident path (``buffer.device=True`` -> DeviceSequentialReplayBuffer +
+InlineSampler), so the seven sequential-replay train loops cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
+
+__all__ = ["make_sequential_replay"]
+
+
+def make_sequential_replay(
+    cfg,
+    runtime,
+    log_dir: Optional[str],
+    obs_keys: Sequence[str] = (),
+) -> Tuple[Any, Any, bool]:
+    """Return ``(rb, prefetcher, use_device_buffer)`` for a sequential-replay loop.
+
+    - host path: per-env circular numpy/memmap buffers; a worker thread overlaps
+      sample + async device_put with the previous train step (see
+      sheeprl_tpu/data/prefetch.py); batches land sharded [G, T, B] on the mesh;
+    - ``cfg.buffer.device=True``: storage and sampling live in HBM
+      (sheeprl_tpu/data/device_buffer.py) and the "prefetcher" is a passthrough.
+
+    Train loops use the trio uniformly: ``prefetcher.get(...)`` for batches,
+    ``with prefetcher.guard(): rb.add(...)`` for writes, ``rb.patch_last(...)``
+    for crash-restart boundary patches, ``prefetcher.close()`` at teardown.
+    """
+    buffer_size = (
+        cfg.buffer.size // int(cfg.env.num_envs * runtime.world_size) if not cfg.dry_run else 2
+    )
+    use_device_buffer = bool(cfg.buffer.get("device", False))
+    if use_device_buffer:
+        if runtime.world_size > 1:
+            raise ValueError(
+                "buffer.device=True is single-device only (shard the host buffer "
+                "across processes instead for data-parallel runs)"
+            )
+        rb = DeviceSequentialReplayBuffer(
+            buffer_size, n_envs=cfg.env.num_envs, device=runtime.device
+        )
+        prefetcher = InlineSampler(rb.sample)
+    else:
+        rb = EnvIndependentReplayBuffer(
+            buffer_size,
+            n_envs=cfg.env.num_envs,
+            obs_keys=tuple(obs_keys),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir or ".", "memmap_buffer", f"rank_{runtime.global_rank}"),
+            buffer_cls=SequentialReplayBuffer,
+        )
+        prefetcher = DevicePrefetcher(
+            rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+        )
+    return rb, prefetcher, use_device_buffer

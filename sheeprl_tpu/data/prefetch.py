@@ -103,12 +103,18 @@ class DevicePrefetcher:
         self._worker = threading.Thread(target=self._run, name="sheeprl-prefetch", daemon=True)
         self._worker.start()
 
+    # Batches below this stay unfenced: the fence costs one synchronous round-trip
+    # (expensive on tunneled backends), and small-batch staging residue is bounded
+    # by iteration count, not worth a per-iteration sync.
+    FENCE_BYTES = 4 * 1024 * 1024
+
     # ----- worker --------------------------------------------------------------------
     def _transfer(self, batch: Dict[str, np.ndarray]) -> Dict[str, Any]:
         # device_put returns immediately; the async copy completes while the
         # consumer is still dispatching/awaiting the previous train step.
+        total_bytes = sum(getattr(v, "nbytes", 0) for v in batch.values())
         out = {k: get_array(v, dtype=self._dtype, device=self._device) for k, v in batch.items()}
-        if self._device is not None and out:
+        if self._device is not None and out and total_bytes >= self.FENCE_BYTES:
             # Fence: block THIS worker thread until the batch is device-resident,
             # bounding in-flight transfers to the double-buffer depth. Without it
             # the consumer outruns the copies and the host transfer queue grows

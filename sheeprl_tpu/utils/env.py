@@ -149,6 +149,11 @@ def make_env(
             if cfg.env.grayscale:
                 env = GrayscaleRenderWrapper(env)
             video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
+            if getattr(env, "render_mode", None) is None:
+                # RecordVideo's constructor raises AND leaves a half-built object whose
+                # __del__ spews AttributeErrors; skip it up front for render-less envs
+                warnings.warn("Could not enable video capture: the env has no render_mode")
+                return env
             try:
                 env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
             except Exception as e:
