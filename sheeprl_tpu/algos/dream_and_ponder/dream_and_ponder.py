@@ -501,7 +501,7 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    rb, prefetcher, use_device_buffer = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
+    rb, prefetcher = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -654,7 +654,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     params, opt_states, moments_state, counter, train_metrics = train_fn(
                         params, opt_states, moments_state, counter, batches, train_key
                     )
-                    jax.block_until_ready(params)
+                    if not timer.disabled:
+                        # fence ONLY when timing (Time/train_time honesty); an
+                        # unconditional sync serializes on the dispatch round-trip
+                        jax.block_until_ready(params)
                     player.wm_params = params["world_model"]
                     player.actor_params = params["actor"]
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps

@@ -639,7 +639,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     params, opt_states, counter, train_metrics = train_fn(
                         params, opt_states, counter, batches, train_key
                     )
-                    jax.block_until_ready(params)
+                    if not timer.disabled:
+                        # fence ONLY when timing (Time/train_time honesty); an
+                        # unconditional sync serializes on the dispatch round-trip
+                        jax.block_until_ready(params)
                     player.wm_params = params["world_model"]
                     player.actor_params = params["actor"]
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps

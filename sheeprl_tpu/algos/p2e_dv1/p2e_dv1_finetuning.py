@@ -155,7 +155,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    rb, prefetcher, use_device_buffer = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
+    rb, prefetcher = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
     if "rb" in state and (resumed or (cfg.buffer.load_from_exploration and exploration_cfg.buffer.checkpoint)):
         rb.load_state_dict(state["rb"])
 

@@ -246,7 +246,7 @@ def build_agent(
     params = agent.init(jax.random.PRNGKey(cfg.seed), sample_obs, prev_actions, init_states)
     if agent_state is not None:
         params = jax.tree_util.tree_map(jnp.asarray, agent_state)
-    params = runtime.replicate(params)
+    params = runtime.place_params(params)
     # player copy lives on the player device (host CPU by default): no accelerator
     # round-trip per env step (see sheeprl_tpu.core.runtime.Runtime.player_device)
     player = RecurrentPPOPlayer(agent, runtime.to_player(params), actions_dim, n_envs)

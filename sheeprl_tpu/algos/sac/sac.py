@@ -279,6 +279,10 @@ def main(runtime, cfg: Dict[str, Any]):
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
+    # rollout randomness lives on the PLAYER device: feeding mesh-resident keys/obs
+    # into the host player's jit would silently move the policy step onto the
+    # accelerator and pay a synchronous round-trip per env step
+    player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
     mlp_keys = cfg.algo.mlp_keys.encoder
     cumulative_grad_steps = 0
 
@@ -295,8 +299,10 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num < learning_starts:
                 actions = envs.action_space.sample()
             else:
-                rng, act_key = jax.random.split(rng)
-                actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
+                player_rng, act_key = jax.random.split(player_rng)
+                actions = np.asarray(
+                    player.get_actions(jax.device_put(obs_vec, runtime.player_device), act_key)
+                )
             next_obs, rewards, terminated, truncated, info = envs.step(
                 actions.reshape(envs.action_space.shape)
             )
@@ -356,8 +362,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     train_calls += 1
                     if train_calls % player_sync_every == 0:
                         player.params = params_sync.pull(flat_actor, runtime.player_device)
-                        jax.block_until_ready(player.params)
-                    else:
+                    if not timer.disabled:
+                        # fence ONLY when timing: Time/train_time must include the
+                        # device work, but an unconditional per-iteration sync would
+                        # serialize the loop on the dispatch round-trip
                         jax.block_until_ready(flat_actor)
                     cumulative_grad_steps += g
                 train_step += world_size * g

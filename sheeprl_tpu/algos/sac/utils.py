@@ -19,12 +19,15 @@ MODELS_TO_REGISTER = {"agent"}
 
 
 def prepare_obs(runtime, obs: Dict[str, np.ndarray], *, num_envs: int = 1, **kwargs) -> jax.Array:
-    """Concat mlp keys into the flat `observations` vector (reference utils.py:14-20)."""
+    """Concat mlp keys into the flat `observations` vector (reference utils.py:14-20),
+    committed to the player device (an uncommitted array would let the policy jit
+    follow mesh-resident leaves onto the accelerator, paying a round-trip per step)."""
     mlp_keys = kwargs.get("mlp_keys", list(obs.keys()))
-    with jax.default_device(jax.devices()[0]):
-        return jnp.asarray(
-            np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1)
-        )
+    flat = np.concatenate(
+        [np.asarray(obs[k], dtype=np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+    )
+    device = runtime.player_device if runtime is not None else None
+    return jax.device_put(flat, device) if device is not None else jnp.asarray(flat)
 
 
 def test(player, runtime, cfg, log_dir: str) -> None:

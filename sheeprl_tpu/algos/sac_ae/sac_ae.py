@@ -322,6 +322,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
+    player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
 
     def to_stored(o, k):
         arr = np.asarray(o[k])
@@ -342,7 +343,7 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num < learning_starts:
                 actions = envs.action_space.sample()
             else:
-                rng, act_key = jax.random.split(rng)
+                player_rng, act_key = jax.random.split(player_rng)
                 jax_obs = prepare_obs(runtime, stored_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                 actions = np.asarray(player.get_actions(jax_obs, act_key))
             next_obs, rewards, terminated, truncated, info = envs.step(actions.reshape(envs.action_space.shape))
@@ -405,8 +406,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         player.encoder_params, player.actor_params = params_sync.pull(
                             flat_player, runtime.player_device
                         )
-                        jax.block_until_ready(player.actor_params)
-                    else:
+                    if not timer.disabled:
+                        # fence ONLY when timing (see sac.py note)
                         jax.block_until_ready(flat_player)
                 train_step += world_size * g
                 if cfg.metric.log_level > 0 and aggregator:

@@ -34,6 +34,7 @@ def prepare_obs(
     runtime, obs: Dict[str, np.ndarray], cnn_keys: Sequence[str] = [], num_envs: int = 1, **kwargs
 ) -> Dict[str, jax.Array]:
     """cnn keys -> [0,1] floats with stacked frames folded into channels."""
+    device = runtime.player_device if runtime is not None else None
     out = {}
     for k, v in obs.items():
         arr = np.asarray(v, dtype=np.float32)
@@ -41,7 +42,9 @@ def prepare_obs(
             arr = arr.reshape(num_envs, -1, *arr.shape[-2:]) / 255.0
         else:
             arr = arr.reshape(num_envs, -1)
-        out[k] = jnp.asarray(arr)
+        # committed to the player device: an uncommitted array would let the
+        # policy jit follow mesh-resident leaves onto the accelerator
+        out[k] = jax.device_put(arr, device) if device is not None else jnp.asarray(arr)
     return out
 
 

@@ -25,8 +25,8 @@ def make_sequential_replay(
     runtime,
     log_dir: Optional[str],
     obs_keys: Sequence[str] = (),
-) -> Tuple[Any, Any, bool]:
-    """Return ``(rb, prefetcher, use_device_buffer)`` for a sequential-replay loop.
+) -> Tuple[Any, Any]:
+    """Return ``(rb, prefetcher)`` for a sequential-replay loop.
 
     - host path: per-env circular numpy/memmap buffers; a worker thread overlaps
       sample + async device_put with the previous train step (see
@@ -34,7 +34,7 @@ def make_sequential_replay(
     - ``cfg.buffer.device=True``: storage and sampling live in HBM
       (sheeprl_tpu/data/device_buffer.py) and the "prefetcher" is a passthrough.
 
-    Train loops use the trio uniformly: ``prefetcher.get(...)`` for batches,
+    Train loops use the pair uniformly: ``prefetcher.get(...)`` for batches,
     ``with prefetcher.guard(): rb.add(...)`` for writes, ``rb.patch_last(...)``
     for crash-restart boundary patches, ``prefetcher.close()`` at teardown.
     """
@@ -64,4 +64,4 @@ def make_sequential_replay(
         prefetcher = DevicePrefetcher(
             rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
         )
-    return rb, prefetcher, use_device_buffer
+    return rb, prefetcher
