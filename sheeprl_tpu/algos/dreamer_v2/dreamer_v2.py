@@ -31,8 +31,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v2.utils import compute_lambda_values, prepare_obs, test
 from sheeprl_tpu.algos.dreamer_v3.utils import get_action_masks
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.prefetch import DevicePrefetcher
+from sheeprl_tpu.data.factory import make_episode_replay, make_sequential_replay
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -440,32 +439,12 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    buffer_size = cfg.buffer.size // int(cfg.env.num_envs * world_size) if not cfg.dry_run else 2
     buffer_type = str(cfg.buffer.type).lower()
-    if bool(cfg.buffer.get("device", False)):
-        raise ValueError(
-            "buffer.device=True is not supported by this algorithm's buffer layout "
-            "(sequential+episode); use the host buffers"
-        )
     if buffer_type == "sequential":
-        rb = EnvIndependentReplayBuffer(
-            buffer_size,
-            n_envs=cfg.env.num_envs,
-            obs_keys=tuple(obs_keys),
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-            buffer_cls=SequentialReplayBuffer,
-        )
+        # host or HBM-resident storage + the matching sampling pipeline
+        rb, prefetcher = make_sequential_replay(cfg, runtime, log_dir, obs_keys)
     elif buffer_type == "episode":
-        rb = EpisodeBuffer(
-            buffer_size,
-            minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
-            n_envs=cfg.env.num_envs,
-            obs_keys=tuple(obs_keys),
-            prioritize_ends=cfg.buffer.prioritize_ends,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}"),
-        )
+        rb, prefetcher = make_episode_replay(cfg, runtime, log_dir, obs_keys)
     else:
         raise ValueError(
             f"Unrecognized buffer type: must be one of `sequential` or `episode`, received: {buffer_type}"
@@ -506,13 +485,6 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data: Dict[str, np.ndarray] = {}
-    # Double-buffered host->HBM pipeline: the [G, T, B] batch for the next train
-    # call is sampled + device_put while the chip still runs the current train step
-    # (see sheeprl_tpu/data/prefetch.py)
-    prefetcher = DevicePrefetcher(
-        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
-    )
-
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[np.newaxis]

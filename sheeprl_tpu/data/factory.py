@@ -13,11 +13,11 @@ from typing import Any, Optional, Sequence, Tuple
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
 
-__all__ = ["make_sequential_replay"]
+__all__ = ["make_episode_replay", "make_sequential_replay"]
 
 
 def make_sequential_replay(
@@ -64,4 +64,39 @@ def make_sequential_replay(
         prefetcher = DevicePrefetcher(
             rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
         )
+    return rb, prefetcher
+
+
+def make_episode_replay(
+    cfg,
+    runtime,
+    log_dir: Optional[str],
+    obs_keys: Sequence[str] = (),
+) -> Tuple[Any, Any]:
+    """Return ``(rb, prefetcher)`` for the episode-layout loops (DV2 family).
+
+    Episode buffers keep whole trajectories host-side (variable-length episodes
+    don't map onto the fixed-slot HBM layout), so ``buffer.device=True`` raises
+    and the pipeline is always the double-buffered host prefetcher.
+    """
+    if bool(cfg.buffer.get("device", False)):
+        raise ValueError(
+            "buffer.device=True supports sequential replay only; "
+            "buffer.type=episode must use the host buffer"
+        )
+    buffer_size = (
+        cfg.buffer.size // int(cfg.env.num_envs * runtime.world_size) if not cfg.dry_run else 2
+    )
+    rb = EpisodeBuffer(
+        buffer_size,
+        minimum_episode_length=1 if cfg.dry_run else cfg.algo.per_rank_sequence_length,
+        n_envs=cfg.env.num_envs,
+        obs_keys=tuple(obs_keys),
+        prioritize_ends=cfg.buffer.prioritize_ends,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir or ".", "memmap_buffer", f"rank_{runtime.global_rank}"),
+    )
+    prefetcher = DevicePrefetcher(
+        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+    )
     return rb, prefetcher
