@@ -127,6 +127,29 @@ def test_sac(standard_args, devices, tmp_path, monkeypatch):
     _run(args)
 
 
+def test_sac_player_sync_every(standard_args, tmp_path, monkeypatch):
+    """Deferred trainer->player refreshes (remote-accelerator amortization) train
+    end-to-end, including the forced final sync before evaluation."""
+    monkeypatch.chdir(tmp_path)
+    args = [a for a in standard_args if a != "dry_run=True"] + [
+        "exp=sac",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=1",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.player_sync_every=3",
+        "algo.total_steps=16",
+        "algo.run_test=True",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
 def test_sac_rejects_discrete(standard_args, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
