@@ -16,7 +16,7 @@ from sheeprl_tpu.utils.registry import register_evaluation
 @register_evaluation(algorithms="a2c")
 def evaluate_a2c(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 

@@ -420,7 +420,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
@@ -590,7 +590,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 if agent_roe and not dones[i]:
                     # crash-restart boundary: the last stored transition becomes a
                     # truncation (works on host and HBM buffers alike)
-                    rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
+                    with prefetcher.guard():  # no torn flags under the worker's sample
+                        rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
                     step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
 
         if cfg.metric.log_level > 0:
@@ -719,6 +720,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 ckpt_path=ckpt_path,
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
+                io_lock=prefetcher.guard(),
             )
 
     profiler.close()

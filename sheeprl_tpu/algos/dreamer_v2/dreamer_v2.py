@@ -359,7 +359,7 @@ def main(runtime, cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
@@ -677,6 +677,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 ckpt_path=ckpt_path,
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
+                io_lock=prefetcher.guard(),
             )
 
     profiler.close()

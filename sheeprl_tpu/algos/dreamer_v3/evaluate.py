@@ -18,7 +18,7 @@ def evaluate_dreamer_v3(runtime, cfg: Dict[str, Any], state: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 

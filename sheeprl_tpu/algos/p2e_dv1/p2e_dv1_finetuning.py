@@ -18,7 +18,6 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import DV1OptStates, make_train_fn
 from sheeprl_tpu.algos.dreamer_v2.agent import expl_amount_schedule
@@ -70,7 +69,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
@@ -359,6 +358,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 ckpt_path=ckpt_path_out,
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
+                io_lock=prefetcher.guard(),
             )
 
     profiler.close()

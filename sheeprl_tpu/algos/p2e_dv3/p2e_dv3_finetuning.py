@@ -19,7 +19,6 @@ import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import DV3OptStates, make_train_fn
 from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test, get_action_masks
@@ -73,7 +72,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     logger = get_logger(runtime, cfg)
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
-    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name)
+    log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
@@ -369,6 +368,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 ckpt_path=ckpt_path_out,
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
+                io_lock=prefetcher.guard(),
             )
 
     profiler.close()

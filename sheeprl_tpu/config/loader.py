@@ -20,6 +20,7 @@ import copy
 import importlib
 import os
 import re
+import warnings
 from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
 
 import yaml
@@ -123,6 +124,7 @@ def _compose_file(
     selections: Dict[str, str],
     group_prefix: str = "",
     consumed: Optional[set] = None,
+    mounted: Optional[set] = None,
 ) -> Dict[str, Any]:
     """Compose one yaml file: process its defaults list, then merge its own body.
 
@@ -130,7 +132,9 @@ def _compose_file(
     (e.g. ``- ppo`` inside ``algo/a2c.yaml``) resolve within the same group.
     ``consumed`` (when given) collects the ``group@package`` selection keys that
     matched a mount, so compose() can reject typo'd packages instead of silently
-    ignoring them.
+    ignoring them; ``mounted`` collects the group names whose mounts were actually
+    encountered, so a selection addressing a mount that legitimately never composed
+    (enclosing group null/absent) warns instead of erroring.
     """
     raw = _load_yaml(path)
     defaults = raw.pop("defaults", None)
@@ -160,7 +164,7 @@ def _compose_file(
                 sub_path = _find_yaml(rel, search)
                 if sub_path is None:
                     raise ConfigError(f"Cannot find base config '{rel}' (from {path})")
-                _deep_merge(composed, _compose_file(sub_path, search, selections, group_prefix, consumed))
+                _deep_merge(composed, _compose_file(sub_path, search, selections, group_prefix, consumed, mounted))
                 continue
             group = group_rel if absolute or not group_prefix else os.path.join(group_prefix, group_rel)
             if is_override:
@@ -174,6 +178,8 @@ def _compose_file(
             local_pkg = placement if placement is not None else group_rel.split("/")[-1]
             eff_pkg = f"{group_prefix}.{local_pkg}" if group_prefix else local_pkg
             pkg_key = f"{group_rel}@{eff_pkg}"
+            if mounted is not None:
+                mounted.add(group_rel)
             if pkg_key in selections:
                 option = selections[pkg_key]
                 if consumed is not None:
@@ -189,7 +195,7 @@ def _compose_file(
             sub_path = _find_yaml(rel, search)
             if sub_path is None:
                 raise ConfigError(f"Cannot find config '{rel}' referenced from {path}")
-            sub_cfg = _compose_file(sub_path, search, selections, os.path.dirname(rel), consumed)
+            sub_cfg = _compose_file(sub_path, search, selections, os.path.dirname(rel), consumed, mounted)
             target_key = placement if placement is not None else group_rel.split("/")[-1]
             if target_key in ("_global_", "_here_", ""):
                 _deep_merge(composed, sub_cfg)
@@ -335,6 +341,7 @@ def compose(
         harvested[group] = sel
 
     consumed_pkgs: set = set()
+    mounted_groups: set = {g for g, _ in ordered_groups if g != "_self_"}
 
     def _root_mount_selection(group: str, placement: Optional[str], current):
         """Honor (and mark consumed) a package-scoped CLI selection addressing a
@@ -363,7 +370,7 @@ def compose(
         # seed with CLI selections so nested group mounts (e.g. metric/default.yaml's
         # "/logger@logger") honor "group@package=option" overrides
         sub_sel: Dict[str, str] = dict(selections)
-        cfg_piece = _compose_file(path, search, sub_sel, group, consumed_pkgs)
+        cfg_piece = _compose_file(path, search, sub_sel, group, consumed_pkgs, mounted_groups)
         overlay_cfgs[group] = cfg_piece
         for g, o in sub_sel.items():
             if o is not None and g not in selections:  # CLI wins over overlay overrides
@@ -391,7 +398,7 @@ def compose(
             raise ConfigError(f"Cannot find config '{rel}' for {group}={option}")
         cfg_piece = overlay_cfgs.get(group)
         if cfg_piece is None:
-            cfg_piece = _compose_file(path, search, dict(selections), group, consumed_pkgs)
+            cfg_piece = _compose_file(path, search, dict(selections), group, consumed_pkgs, mounted_groups)
         target_key = placement if placement is not None else group.split("/")[-1]
         if _is_global_packaged(path):
             _deep_merge(cfg, cfg_piece)
@@ -408,12 +415,21 @@ def compose(
 
     # Reject package-scoped selections that matched no mount (silent typos:
     # "logger@metric.loger=mlflow" would otherwise leave the default in place).
+    # If NO mount of the group was composed at all, the selection may merely be
+    # inactive (its enclosing group selected to null or an option that omits the
+    # mount) — warn instead of erroring, matching Hydra's tolerance.
     for sel_key in selections:
         if "@" in sel_key and sel_key not in consumed_pkgs:
             group, package = sel_key.split("@", 1)
-            raise ConfigError(
-                f"Override '{sel_key}={selections[sel_key]}' matched no mount of group "
-                f"'{group}' at package '{package}' (check the package path)"
+            if group in mounted_groups:
+                raise ConfigError(
+                    f"Override '{sel_key}={selections[sel_key]}' matched no mount of group "
+                    f"'{group}' at package '{package}' (check the package path)"
+                )
+            warnings.warn(
+                f"Override '{sel_key}={selections[sel_key]}' addressed group '{group}' "
+                f"but no mount of that group was composed (inactive mount?); ignoring",
+                stacklevel=2,
             )
 
     # Dotted overrides, after composition.

@@ -10,6 +10,16 @@ split derived from the TimeStep discount (0 => terminal, 1 => time limit).
 
 from __future__ import annotations
 
+import os
+
+# Headless default: without a display, dm_control's unset-variable resolution picks
+# glfw (it imports fine) and then dies at context creation; EGL creates surfaceless
+# contexts via the device platform. Desktop sessions (DISPLAY set) and explicit
+# MUJOCO_GL choices are left alone. Must run before dm_control binds its backend,
+# i.e. before anything imports dm_control — this adapter is the package's only entry.
+if "DISPLAY" not in os.environ:
+    os.environ.setdefault("MUJOCO_GL", "egl")
+
 from sheeprl_tpu.utils.imports import _IS_DMC_AVAILABLE
 
 if not _IS_DMC_AVAILABLE:

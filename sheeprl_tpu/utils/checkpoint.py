@@ -9,6 +9,7 @@ device-agnostic and resumable on any topology; algorithms re-shard on restore.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 from typing import Any, Dict, List, Optional
@@ -83,21 +84,32 @@ class CheckpointCallback:
         ckpt_path: str,
         state: Dict[str, Any],
         replay_buffer=None,
+        io_lock=None,
         **_: Any,
     ) -> None:
-        if replay_buffer is not None:
-            originals = self._fix_buffer_pre(replay_buffer)
-            state = dict(state)
-            state["rb"] = replay_buffer.state_dict() if hasattr(replay_buffer, "state_dict") else replay_buffer
-        if runtime is None or runtime.is_global_zero:
-            save_state(ckpt_path, state)
-            self._gc(os.path.dirname(ckpt_path))
-        if replay_buffer is not None:
-            self._fix_buffer_post(replay_buffer, originals)
+        # The truncated-flag patch, the buffer read (state_dict returns VIEWS of the
+        # ring storage, so the patch must outlive the pickle), and the unpatch must
+        # not interleave with a prefetch worker's in-flight sample; loops pass their
+        # prefetcher's guard() as io_lock and the worker waits out the write.
+        lock = io_lock if (io_lock is not None and replay_buffer is not None) else contextlib.nullcontext()
+        with lock:
+            if replay_buffer is not None:
+                originals = self._fix_buffer_pre(replay_buffer)
+                state = dict(state)
+                state["rb"] = (
+                    replay_buffer.state_dict() if hasattr(replay_buffer, "state_dict") else replay_buffer
+                )
+            if runtime is None or runtime.is_global_zero:
+                save_state(ckpt_path, state)
+                self._gc(os.path.dirname(ckpt_path))
+            if replay_buffer is not None:
+                self._fix_buffer_post(replay_buffer, originals)
 
     # decoupled variants keep the same surface as the reference callback
-    def on_checkpoint_player(self, runtime, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, **_: Any):
-        self.on_checkpoint_coupled(runtime, ckpt_path, state, replay_buffer)
+    def on_checkpoint_player(
+        self, runtime, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, io_lock=None, **_: Any
+    ):
+        self.on_checkpoint_coupled(runtime, ckpt_path, state, replay_buffer, io_lock)
 
     def on_checkpoint_trainer(self, runtime, player, ckpt_path: str, state: Dict[str, Any], **_: Any):
         self.on_checkpoint_coupled(runtime, ckpt_path, state)

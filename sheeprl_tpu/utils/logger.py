@@ -231,18 +231,15 @@ def _broadcast_str(value: Optional[str]) -> str:
     return bytes(out[: int(np.max(np.nonzero(out)[0], initial=-1)) + 1]).decode("utf-8")
 
 
-# The logger created by get_logger; get_log_dir (always called right after in every
-# train loop) hands it the versioned run dir so backend sidecars land next to the
-# run's checkpoints. Single-process training state, reset on each get_logger call.
-_active_logger: Optional[Any] = None
-
-
-def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> str:
+def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True, logger: Optional[Any] = None) -> str:
     """Versioned run dir: logs/runs/<root_dir>/<run_name>/version_N.
 
     Rank 0 creates it; under multi-controller every process receives rank-0's
     path via a collective broadcast (reference: sheeprl/utils/logger.py:52-88
-    broadcasts the dir over the process group).
+    broadcasts the dir over the process group). Pass the run's ``logger`` so its
+    sidecar (metrics.json, used by register_best_models ranking) lands in THIS
+    run's version_N dir — an explicit argument rather than process-global state,
+    so two runs in one process can't cross-wire each other's dirs.
     """
     base = os.path.join("logs", "runs", root_dir, run_name)
     if runtime is None or runtime.is_global_zero:
@@ -252,15 +249,13 @@ def get_log_dir(runtime, root_dir: str, run_name: str, share: bool = True) -> st
         log_dir = None
     if share and jax.process_count() > 1:  # pragma: no cover - idem
         log_dir = _broadcast_str(log_dir)
-    if log_dir is not None and _active_logger is not None and hasattr(_active_logger, "set_run_dir"):
-        _active_logger.set_run_dir(log_dir)
+    if log_dir is not None and logger is not None and hasattr(logger, "set_run_dir"):
+        logger.set_run_dir(log_dir)
     return log_dir
 
 
 def get_logger(runtime, cfg) -> Optional[Any]:
     """Rank-0 logger instantiation from cfg.metric.logger (``_target_`` style)."""
-    global _active_logger
-    _active_logger = None
     if runtime is not None and not runtime.is_global_zero:
         return NullLogger()
     if cfg.metric.log_level == 0 or not getattr(cfg.metric, "logger", None):
@@ -268,6 +263,4 @@ def get_logger(runtime, cfg) -> Optional[Any]:
     from sheeprl_tpu.config import instantiate
 
     spec = dict(cfg.metric.logger)
-    logger = instantiate(spec)
-    _active_logger = logger
-    return logger
+    return instantiate(spec)

@@ -56,6 +56,21 @@ def test_search_path_env(tmp_path, monkeypatch):
     assert cfg.env.id == "discrete_dummy"
 
 
+def test_unmounted_group_selection_warns_not_errors(tmp_path, monkeypatch):
+    # A packaged selection addressing a group that exists on the search path but is
+    # never mounted in this composition (e.g. its enclosing group selected away) is
+    # inactive, not a typo: composition proceeds with a warning (ConfigError is
+    # reserved for addressing a *composed* group at a wrong package).
+    plugin_dir = tmp_path / "plugin"
+    plugin_dir.mkdir()
+    (plugin_dir / "opt.yaml").write_text("enabled: true\n")
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", f"file://{tmp_path}")
+    with pytest.warns(UserWarning, match="no mount"):
+        cfg = compose(overrides=["exp=ppo", "plugin@algo.plugin=opt"])
+    assert cfg.algo.name == "ppo"
+    assert "plugin" not in cfg.algo
+
+
 def test_dotdict_attribute_access():
     d = dotdict({"a": {"b": {"c": 1}}, "l": [{"x": 2}]})
     assert d.a.b.c == 1

@@ -104,7 +104,7 @@ def test_tensorboard_sidecar_lands_in_versioned_run_dir(tmp_path, monkeypatch):
 
     cfg = compose(config_name="config", overrides=["exp=ppo", "metric.log_level=1"])
     lg = logger_mod.get_logger(None, cfg)
-    run_dir = logger_mod.get_log_dir(None, "algo", "run")
+    run_dir = logger_mod.get_log_dir(None, "algo", "run", logger=lg)
     assert run_dir.endswith("version_0")
     lg.log_metrics({"Test/cumulative_reward": 7.0}, step=1)
     lg.finalize()
