@@ -296,7 +296,11 @@ def main(runtime, cfg: Dict[str, Any]):
 
     buffer_io_lock = threading.Lock()
     critic_prefetcher = DevicePrefetcher(
-        sample_critic_batches, device=NamedSharding(runtime.mesh, P(None, "data")), io_lock=buffer_io_lock
+        sample_critic_batches,
+        device=NamedSharding(runtime.mesh, P(None, "data")),
+        io_lock=buffer_io_lock,
+        chunk=int(cfg.buffer.get("prefetch_batches", 1)),
+        chunk_key="g",
     )
     actor_prefetcher = DevicePrefetcher(
         sample_actor_batch, device=NamedSharding(runtime.mesh, P("data")), io_lock=buffer_io_lock

@@ -254,6 +254,7 @@ def main(runtime, cfg: Dict[str, Any]):
 
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     player_sync_every = max(1, int(cfg.algo.get("player_sync_every", 1)))
+    train_every = max(1, int(cfg.algo.get("train_every", 1)))
     if state:
         ratio.load_state_dict(state["ratio"])
 
@@ -264,7 +265,12 @@ def main(runtime, cfg: Dict[str, Any]):
 
     # Double-buffered host->HBM pipeline (see sheeprl_tpu/data/prefetch.py): the
     # [G, B] batch for the next train call transfers while the chip is still busy.
-    prefetcher = DevicePrefetcher(sample_batches, device=NamedSharding(runtime.mesh, P(None, "data")))
+    prefetcher = DevicePrefetcher(
+        sample_batches,
+        device=NamedSharding(runtime.mesh, P(None, "data")),
+        chunk=int(cfg.buffer.get("prefetch_batches", 1)),
+        chunk_key="g",
+    )
 
     if cfg.metric.log_level > 0 and cfg.metric.log_every % policy_steps_per_iter != 0:
         warnings.warn(
@@ -339,8 +345,14 @@ def main(runtime, cfg: Dict[str, Any]):
                     aggregator.update("Game/ep_len_avg", ep_len)
                 runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # ---- training phase
-        if iter_num >= learning_starts:
+        # ---- training phase. ``algo.train_every > 1`` batches several iterations'
+        # gradient steps into one jitted call (Ratio keeps the step accounting exact):
+        # on remote accelerators every dispatched program costs fixed round-trip
+        # overhead, so fusing N iterations' updates divides that overhead by N at the
+        # price of params being up to N-1 env steps staler for replay writes.
+        if iter_num >= learning_starts and (
+            train_every <= 1 or iter_num % train_every == 0 or iter_num == total_iters
+        ):
             per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps

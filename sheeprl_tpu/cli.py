@@ -124,6 +124,13 @@ def _find_entrypoint(algo_name: str) -> Optional[Dict[str, Any]]:
 def _apply_global_flags(cfg: dotdict) -> None:
     import jax
 
+    from sheeprl_tpu.utils.timer import timer
+
+    # Reference cli.py:161. Critical on remote accelerators: the train loops fence
+    # device work ONLY when timing (block_until_ready costs a full round-trip per
+    # train call through a tunnel), so a miswired flag serializes every iteration.
+    if "metric" in cfg:
+        timer.disabled = cfg.metric.get("log_level", 1) == 0 or bool(cfg.metric.get("disable_timer", False))
     precision_map = {"highest": "highest", "high": "high", "default": "default", "medium": "default"}
     try:
         jax.config.update(

@@ -118,6 +118,17 @@ class Runtime:
             # trailing axes get size 1 unless configured via `devices` being a list
             shape = (n,) + (1,) * (len(axes) - 1)
         self.mesh = Mesh(np.asarray(self._devices).reshape(shape), axes)
+        if platform is not None and self._devices[0].platform != jax.devices()[0].platform:
+            # An explicit non-default accelerator (e.g. fabric.accelerator=cpu on a
+            # TPU host for tiny latency-bound workloads): uncommitted ops
+            # (jnp.asarray, jax.random.*) must land on the chosen backend too, or
+            # every loop iteration silently bounces through the default device.
+            jax.config.update("jax_default_device", self._devices[0])
+        else:
+            # restore the platform default so a cpu-pinned Runtime earlier in this
+            # process (tests, exploration->finetuning chains) cannot leak its
+            # default-device override into this run
+            jax.config.update("jax_default_device", None)
         if self.precision not in _PRECISIONS:
             raise ValueError(f"Unknown precision '{self.precision}'. Choose from {list(_PRECISIONS)}")
         self.param_dtype, self.compute_dtype = _PRECISIONS[self.precision]

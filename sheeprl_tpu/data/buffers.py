@@ -66,6 +66,14 @@ def get_array(
         dtype = NUMPY_TO_JAX_DTYPE.get(np.dtype(array.dtype), None)
     if dtype is not None:
         array = np.asarray(array, dtype=dtype)
+    # Sharded host->device puts run through jax's batched_device_put, which blocks
+    # until the copy lands — a full round-trip per call on remote/tunneled backends.
+    # A 1-device mesh's NamedSharding is equivalent to its single device, and a
+    # plain-device put is fully asynchronous: unwrap so transfers overlap compute.
+    if isinstance(device, jax.sharding.Sharding):
+        device_set = device.device_set
+        if len(device_set) == 1:
+            device = next(iter(device_set))
     return jax.device_put(array, device)
 
 

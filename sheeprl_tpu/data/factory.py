@@ -62,7 +62,10 @@ def make_sequential_replay(
             buffer_cls=SequentialReplayBuffer,
         )
         prefetcher = DevicePrefetcher(
-            rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+            rb.sample,
+            device=NamedSharding(runtime.mesh, P(None, None, "data")),
+            chunk=int(cfg.buffer.get("prefetch_batches", 1)),
+            chunk_key="n_samples",
         )
     return rb, prefetcher
 
@@ -97,6 +100,9 @@ def make_episode_replay(
         memmap_dir=os.path.join(log_dir or ".", "memmap_buffer", f"rank_{runtime.global_rank}"),
     )
     prefetcher = DevicePrefetcher(
-        rb.sample, device=NamedSharding(runtime.mesh, P(None, None, "data"))
+        rb.sample,
+        device=NamedSharding(runtime.mesh, P(None, None, "data")),
+        chunk=int(cfg.buffer.get("prefetch_batches", 1)),
+        chunk_key="n_samples",
     )
     return rb, prefetcher

@@ -21,6 +21,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
+import jax
 import numpy as np
 
 from sheeprl_tpu.data.buffers import get_array
@@ -81,10 +82,27 @@ class DevicePrefetcher:
         device: Optional[Any] = None,
         dtype: Optional[Any] = None,
         io_lock: Optional[threading.Lock] = None,
+        chunk: int = 1,
+        chunk_key: Optional[str] = None,
     ):
         self._sample_fn = sample_fn
         self._device = device
         self._dtype = dtype
+        # Transfer amortization: when ``chunk > 1`` and a get() request carries the
+        # integer kwarg named ``chunk_key`` (the per-call batch count, e.g.
+        # ``n_samples`` for sequential replay or ``g`` for flat replay), the worker
+        # samples ``chunk`` calls' worth in ONE sample_fn call / ONE device transfer
+        # and get() serves device-side slices of it. On remote/tunneled accelerators
+        # each transfer's completion fence costs a full round-trip, so K-way chunking
+        # divides that latency by K. Replay-semantics cost: piece i of a chunk was
+        # sampled i train-calls early (up to chunk-1 calls of staleness) — for
+        # off-policy replay at real buffer sizes this is statistically irrelevant
+        # (see the module docstring's one-batch-lag argument; the lag here is K, not 1).
+        self._chunk = max(1, int(chunk))
+        self._chunk_key = chunk_key
+        self._pieces: list = []
+        self._pieces_kwargs: Optional[Dict[str, Any]] = None
+        self._slice_fns: Dict[Any, Any] = {}
         # Serializes buffer access: the worker's sample vs. the train loop's add
         # (torn-row reads once the circular write head wraps into the sampled
         # region) and, with a shared lock, concurrent samples from several
@@ -164,8 +182,50 @@ class DevicePrefetcher:
         self._error = None
         self._cond.notify_all()
 
+    def _chunkable(self, kwargs: Dict[str, Any]) -> bool:
+        return (
+            self._chunk > 1
+            and self._chunk_key is not None
+            and isinstance(kwargs.get(self._chunk_key), (int, np.integer))
+            and int(kwargs[self._chunk_key]) > 0
+        )
+
+    def _scaled(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(kwargs)
+        out[self._chunk_key] = int(kwargs[self._chunk_key]) * self._chunk
+        return out
+
+    def _slice_pieces(self, superbatch: Dict[str, Any], kwargs: Dict[str, Any]) -> list:
+        """Split one transferred superbatch into ``chunk`` device-side pieces.
+
+        All slices happen in ONE jitted call (cached per shape): eager per-leaf
+        slicing would dispatch a separate device op per leaf per piece, and on
+        remote backends every dispatched op carries fixed execution overhead that
+        would eat the latency the chunking just saved. Host mode (device=None)
+        keeps the documented numpy passthrough: plain views, no jit."""
+        g = int(kwargs[self._chunk_key])
+        if self._device is None:
+            return [
+                jax.tree_util.tree_map(lambda v, i=i: v[i * g : (i + 1) * g], superbatch)
+                for i in range(self._chunk)
+            ]
+        key = (g, self._chunk)
+        fn = self._slice_fns.get(key)
+        if fn is None:
+
+            def split(tree):
+                return [
+                    jax.tree_util.tree_map(lambda v: jax.lax.slice_in_dim(v, i * g, (i + 1) * g, axis=0), tree)
+                    for i in range(self._chunk)
+                ]
+
+            fn = self._slice_fns[key] = jax.jit(split)
+        return fn(superbatch)
+
     def get(self, **kwargs) -> Dict[str, Any]:
         """Return a (device-resident) batch for ``kwargs``; speculate the next one."""
+        if self._chunkable(kwargs):
+            return self._get_chunked(kwargs)
         with self._cond:
             if self._closed:
                 raise RuntimeError("DevicePrefetcher is closed")
@@ -183,18 +243,58 @@ class DevicePrefetcher:
                 self._job_id += 1
                 self._job_kwargs = None
         if not speculated:
-            try:
-                with self._io_lock:
-                    batch = self._sample_fn(**kwargs)
-                result, err = self._transfer(batch), None
-            except BaseException as e:
-                result, err = None, e
-            with self._cond:
-                if not self._closed:
-                    self._launch_locked(kwargs)
+            return self._sample_now(kwargs, kwargs)
         if err is not None:
             raise err
         return result
+
+    def _sample_now(self, kwargs: Dict[str, Any], speculate_kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Sample+transfer synchronously on the consumer thread, then speculate
+        ``speculate_kwargs`` (the scaled kwargs in chunked mode)."""
+        try:
+            with self._io_lock:
+                batch = self._sample_fn(**kwargs)
+            result, err = self._transfer(batch), None
+        except BaseException as e:
+            result, err = None, e
+        with self._cond:
+            if not self._closed:
+                self._launch_locked(speculate_kwargs)
+        if err is not None:
+            raise err
+        return result
+
+    def _get_chunked(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        scaled = self._scaled(kwargs)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("DevicePrefetcher is closed")
+            # steady state: serve a ready piece of the current superbatch
+            if self._pieces and self._pieces_kwargs == kwargs:
+                return self._pieces.pop(0)
+            speculated = self._job_id > 0 and self._job_kwargs == scaled
+            if speculated:
+                while self._done_id != self._job_id and not self._closed:
+                    self._cond.wait()
+                if self._closed:
+                    raise RuntimeError("DevicePrefetcher closed while waiting for a batch")
+                superbatch, err = self._result, self._error
+                if err is None:
+                    self._pieces = self._slice_pieces(superbatch, kwargs)
+                    self._pieces_kwargs = dict(kwargs)
+                    piece = self._pieces.pop(0)
+                # next superbatch transfers while the remaining pieces are consumed
+                self._launch_locked(scaled)
+                if err is not None:
+                    raise err
+                return piece
+            # kwargs changed (or first call): drop stale pieces, cancel the stale
+            # speculation, serve ONE unscaled batch synchronously, speculate scaled
+            self._pieces = []
+            self._pieces_kwargs = None
+            self._job_id += 1
+            self._job_kwargs = None
+        return self._sample_now(kwargs, scaled)
 
     def guard(self) -> threading.Lock:
         """The IO lock, for the train loop's buffer writes: ``with pf.guard(): rb.add(...)``."""

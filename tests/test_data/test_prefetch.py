@@ -118,6 +118,68 @@ def test_dtype_narrowing():
         assert out["x"].dtype == np.float32  # f64 narrowed to TPU-native width
 
 
+class ChunkSampler:
+    """Returns [g, 2] batches whose values identify the sample call."""
+
+    def __init__(self):
+        self.calls = []
+        self.lock = threading.Lock()
+
+    def __call__(self, *, g):
+        with self.lock:
+            self.calls.append(g)
+            n = len(self.calls)
+        return {"x": (np.arange(g, dtype=np.float32)[:, None] + 100 * n) * np.ones((g, 2), np.float32)}
+
+
+def test_chunked_one_sample_serves_chunk_gets():
+    s = ChunkSampler()
+    with DevicePrefetcher(s, chunk=4, chunk_key="g") as pf:
+        outs = [pf.get(g=2) for _ in range(9)]
+        for o in outs:
+            assert np.asarray(o["x"]).shape == (2, 2)
+        # call 1: sync single (g=2); then scaled superbatches (g=8) each serving 4 gets:
+        # 9 gets = 1 sync + 2 consumed superbatches (and a third speculating)
+        assert s.calls[0] == 2
+        assert all(c == 8 for c in s.calls[1:])
+        assert len([c for c in s.calls if c == 8]) <= 4
+        # pieces of one superbatch are distinct slices (offset by the arange)
+        vals = [float(np.asarray(o["x"])[0, 0]) for o in outs]
+        assert len(set(vals)) == len(vals)
+
+
+def test_chunked_kwargs_change_resets():
+    s = ChunkSampler()
+    with DevicePrefetcher(s, chunk=3, chunk_key="g") as pf:
+        a = pf.get(g=2)
+        b = pf.get(g=5)  # g changed: stale pieces/speculation must be discarded
+        assert np.asarray(a["x"]).shape == (2, 2)
+        assert np.asarray(b["x"]).shape == (5, 2)
+        c = pf.get(g=5)
+        assert np.asarray(c["x"]).shape == (5, 2)
+
+
+def test_chunked_error_propagates():
+    def flaky(**kwargs):
+        raise ValueError("boom")
+
+    with DevicePrefetcher(flaky, chunk=2, chunk_key="g") as pf:
+        with pytest.raises(ValueError, match="boom"):
+            pf.get(g=1)
+        with pytest.raises(ValueError, match="boom"):
+            pf.get(g=1)
+
+
+def test_chunked_device_slices():
+    s = ChunkSampler()
+    dev = jax.devices()[0]
+    with DevicePrefetcher(s, device=dev, chunk=2, chunk_key="g") as pf:
+        outs = [pf.get(g=3) for _ in range(4)]
+        for o in outs:
+            assert isinstance(o["x"], jax.Array)
+            assert o["x"].shape == (3, 2)
+
+
 def test_close_idempotent():
     s = CountingSampler()
     pf = DevicePrefetcher(s)
