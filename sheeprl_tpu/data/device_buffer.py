@@ -31,13 +31,15 @@ train loops make, so ``buffer.device=True`` swaps it in transparently.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["DeviceSequentialReplayBuffer"]
+__all__ = ["DeviceSequentialReplayBuffer", "ShardedDeviceSequentialReplayBuffer"]
 
 
 class DeviceSequentialReplayBuffer:
@@ -290,3 +292,253 @@ class DeviceSequentialReplayBuffer:
         self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
         self._full = np.asarray(state["full"], dtype=bool).copy()
         return self
+
+
+class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
+    """HBM replay sharded over a mesh axis: per-device env shards, all-local traffic.
+
+    Data-parallel counterpart of :class:`DeviceSequentialReplayBuffer` (the
+    reference's per-rank host buffers at any world size,
+    sheeprl/data/buffers.py:529-744): the env axis is mapped onto the mesh's
+    ``data`` axis, so each device stores ``n_envs / W`` envs' histories.
+    Every data-path op is a ``shard_map`` whose body touches only the local
+    shard:
+
+    - writes: the incoming ``[T, n_envs, *]`` block is ``device_put`` with the
+      storage sharding (each device receives exactly its envs' columns), then a
+      dense masked scatter lands it at each env's write head — no collectives;
+    - sampling: each device draws ``batch/W`` sequences from ITS envs and
+      gathers them in-shard; the batch comes out already ``[G, T, B]``-sharded
+      on the ``data`` axis, exactly the layout the train steps constrain to —
+      ZERO bulk host->device or device->device transfer.
+
+    Partial-env writes (episode-boundary resets, crash-restart patches) use the
+    same dense write with a per-env mask, so no sparse cross-shard scatter ever
+    forms.
+    """
+
+    def __init__(self, buffer_size: int, n_envs: int, mesh: Mesh, axis: str = "data"):
+        super().__init__(buffer_size, n_envs=n_envs, device=None)
+        world = int(mesh.shape[axis])
+        if n_envs % world != 0:
+            raise ValueError(
+                f"buffer.device=True with a {world}-way '{axis}' mesh axis needs "
+                f"env.num_envs divisible by {world}, got {n_envs}"
+            )
+        self._mesh = mesh
+        self._axis = axis
+        self._world = world
+        self._n_local = n_envs // world
+        self._storage_spec = P(None, axis)
+        self._storage_sharding = NamedSharding(mesh, self._storage_spec)
+        self._vec_sharding = NamedSharding(mesh, P(axis))
+        self._gather_fns: Dict[Any, Any] = {}
+
+    # ----- placement -------------------------------------------------------------------
+    def _to_device(self, v) -> jax.Array:
+        # storage-shaped leaves only ([rows|cap, n_envs, *]): env axis on the mesh
+        return jax.device_put(self._narrow(np.asarray(v)), self._storage_sharding)
+
+    def _to_vec(self, v: np.ndarray) -> jax.Array:
+        return jax.device_put(np.ascontiguousarray(v), self._vec_sharding)
+
+    def _allocate(self, data: Dict[str, np.ndarray]) -> None:
+        buf = {}
+        for k, v in data.items():
+            leaf = self._narrow(np.asarray(v))
+            shape = (self._buffer_size, self._n_envs, *leaf.shape[2:])
+            buf[k] = jax.jit(
+                partial(jnp.zeros, shape, leaf.dtype), out_shardings=self._storage_sharding
+            )()
+        self._buf = buf
+
+    # ----- write path ------------------------------------------------------------------
+    def _write_fn(self, rows: int, keys_sig):
+        """Dense masked writer: every env's column is written (kept envs keep their
+        current value via the mask), so each shard's scatter is purely local."""
+        key = (rows, keys_sig)
+        if key not in self._write_fns:
+            cap = self._buffer_size
+            nl = self._n_local
+
+            def body(store_tree, block_tree, pos, mask):
+                # per-shard views: store [cap, nl, *], block [rows, nl, *], pos/mask [nl]
+                cols = jnp.arange(nl)
+                row_idx = (pos[None, :] + jnp.arange(rows)[:, None]) % cap  # [rows, nl]
+
+                def one(store, new):
+                    cur = store[row_idx, cols[None, :]]  # [rows, nl, *]
+                    m = mask.reshape((1, nl) + (1,) * (cur.ndim - 2))
+                    return store.at[row_idx, cols[None, :]].set(
+                        jnp.where(m, new.astype(store.dtype), cur)
+                    )
+
+                return jax.tree_util.tree_map(one, store_tree, block_tree)
+
+            smapped = jax.shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(self._storage_spec, self._storage_spec, P(self._axis), P(self._axis)),
+                out_specs=self._storage_spec,
+                check_vma=False,
+            )
+            self._write_fns[key] = jax.jit(smapped, donate_argnums=(0,))
+        return self._write_fns[key]
+
+    def _masked_write(self, block: Dict[str, np.ndarray], pos: np.ndarray, mask: np.ndarray) -> None:
+        """Write dense [rows, n_envs, *] host blocks at per-env positions where mask."""
+        rows = int(next(iter(block.values())).shape[0])
+        keys_sig = tuple(sorted(block))
+        sub = {k: self._buf[k] for k in keys_sig}
+        dev_block = {k: self._to_device(v) for k, v in block.items()}
+        out = self._write_fn(rows, keys_sig)(
+            sub, dev_block, self._to_vec(pos.astype(np.int32)), self._to_vec(mask)
+        )
+        self._buf.update(out)
+
+    def add(
+        self,
+        data: Dict[str, np.ndarray],
+        indices: Optional[Sequence[int]] = None,
+        validate_args: bool = False,
+    ) -> None:
+        if validate_args:
+            from sheeprl_tpu.data.buffers import _validate_added_data
+
+            _validate_added_data(data)
+        first = np.asarray(next(iter(data.values())))
+        rows = int(first.shape[0])
+        if self._buf is None:
+            if indices is not None:
+                raise RuntimeError("The first add must cover every env (no partial-env add into an empty buffer)")
+            self._allocate(data)
+        if indices is None:
+            env_idx = np.arange(self._n_envs, dtype=np.int64)
+            block = {k: np.asarray(v) for k, v in data.items()}
+            mask = np.ones(self._n_envs, dtype=bool)
+        else:
+            env_idx = np.asarray(list(indices), dtype=np.int64)
+            mask = np.zeros(self._n_envs, dtype=bool)
+            mask[env_idx] = True
+            block = {}
+            for k, v in data.items():
+                v = self._narrow(np.asarray(v))
+                dense = np.zeros((rows, self._n_envs, *v.shape[2:]), dtype=v.dtype)
+                dense[:, env_idx] = v
+                block[k] = dense
+        self._masked_write(block, self._pos, mask)
+        new_pos = self._pos[env_idx] + rows
+        self._full[env_idx] |= new_pos >= self._buffer_size
+        self._pos[env_idx] = new_pos % self._buffer_size
+
+    def patch_last(self, env_indices: Sequence[int], values: Dict[str, float]) -> None:
+        env_idx = np.asarray(list(env_indices), dtype=np.int64)
+        mask = np.zeros(self._n_envs, dtype=bool)
+        mask[env_idx] = True
+        block = {
+            k: np.full((1, self._n_envs, *self._buf[k].shape[2:]), val, dtype=self._buf[k].dtype)
+            for k, val in values.items()
+        }
+        self._masked_write(block, (self._pos - 1) % self._buffer_size, mask)
+
+    def _patch_truncated(self):
+        if self._buf is None or "truncated" not in self._buf:
+            return None
+        last = ((self._pos - 1) % self._buffer_size).astype(np.int64)
+        envs = np.arange(self._n_envs)
+        # tiny [n_envs, 1] pulls; the masked write keeps the storage sharding intact
+        terminated = np.asarray(jax.device_get(self._buf["terminated"][last, envs]))
+        original = np.asarray(jax.device_get(self._buf["truncated"][last, envs]))
+        patched = np.where(terminated > 0, 0, 1).astype(original.dtype)
+        self._masked_write(
+            {"truncated": patched[None]}, last, np.ones(self._n_envs, dtype=bool)
+        )
+        return (last, original)
+
+    def _unpatch_truncated(self, undo) -> None:
+        if undo is None:
+            return
+        last, original = undo
+        self._masked_write({"truncated": original[None]}, last, np.ones(self._n_envs, dtype=bool))
+
+    # ----- sample path -----------------------------------------------------------------
+    def _sharded_gather_fn(self, seq_len: int, n_samples: int, b_local: int):
+        key = (seq_len, n_samples, b_local)
+        if key not in self._gather_fns:
+            cap = self._buffer_size
+
+            def body(store_tree, starts, env_local):
+                # per-shard: starts/env_local [n_samples * b_local], g-major
+                row_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # [n, T]
+
+                def one(store):
+                    out = store[row_idx, env_local[:, None]]  # [n, T, *]
+                    out = out.reshape(n_samples, b_local, seq_len, *out.shape[2:])
+                    return jnp.swapaxes(out, 1, 2)  # [G, T, b_local, *]
+
+                return jax.tree_util.tree_map(one, store_tree)
+
+            smapped = jax.shard_map(
+                body,
+                mesh=self._mesh,
+                in_specs=(self._storage_spec, P(self._axis), P(self._axis)),
+                out_specs=P(None, None, self._axis),
+                check_vma=False,
+            )
+            self._gather_fns[key] = jax.jit(smapped)
+        return self._gather_fns[key]
+
+    def sample(
+        self,
+        batch_size: int,
+        sample_next_obs: bool = False,
+        clone: bool = False,
+        n_samples: int = 1,
+        sequence_length: int = 1,
+        **kwargs: Any,
+    ) -> Dict[str, jax.Array]:
+        """``{k: [n_samples, sequence_length, batch_size, ...]}``, batch axis sharded.
+
+        Each device contributes ``batch_size / W`` sequences drawn from its own
+        envs, so the gathered batch lands already laid out for the train step's
+        ``P(None, 'data')`` constraint.
+        """
+        del sample_next_obs, clone, kwargs
+        if batch_size <= 0 or n_samples <= 0:
+            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+        if batch_size % self._world != 0:
+            raise ValueError(
+                f"batch_size ({batch_size}) must be divisible by the '{self._axis}' "
+                f"mesh axis size ({self._world})"
+            )
+        if self._buf is None:
+            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: 0")
+        filled = self._filled()
+        b_local = batch_size // self._world
+        n_local = b_local * n_samples
+        starts = np.empty(self._world * n_local, dtype=np.int32)
+        env_local = np.empty(self._world * n_local, dtype=np.int32)
+        for d in range(self._world):
+            lo = d * self._n_local
+            local_filled = filled[lo : lo + self._n_local]
+            valid = np.nonzero(local_filled >= sequence_length)[0]
+            if len(valid) == 0:
+                raise ValueError(
+                    f"Cannot sample a sequence of length {sequence_length}. "
+                    f"Data added so far: {int(local_filled.max())} (device shard {d})"
+                )
+            le = valid[self._rng.integers(0, len(valid), size=(n_local,))]
+            ge = le + lo  # global env ids for anchor/span lookups
+            span = filled[ge] - sequence_length + 1
+            offsets = (self._rng.random(n_local) * span).astype(np.int64)
+            anchor = np.where(self._full[ge], self._pos[ge], 0)
+            sl = slice(d * n_local, (d + 1) * n_local)
+            starts[sl] = (anchor + offsets) % self._buffer_size
+            env_local[sl] = le
+        out = self._sharded_gather_fn(int(sequence_length), int(n_samples), b_local)(
+            self._buf, self._to_vec(starts), self._to_vec(env_local)
+        )
+        return out
+
+    sample_arrays = sample
+    sample_tensors = sample

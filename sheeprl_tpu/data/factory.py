@@ -14,7 +14,7 @@ from typing import Any, Optional, Sequence, Tuple
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
-from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer, ShardedDeviceSequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
 
 __all__ = ["make_episode_replay", "make_sequential_replay"]
@@ -44,13 +44,25 @@ def make_sequential_replay(
     use_device_buffer = bool(cfg.buffer.get("device", False))
     if use_device_buffer:
         if runtime.world_size > 1:
-            raise ValueError(
-                "buffer.device=True is single-device only (shard the host buffer "
-                "across processes instead for data-parallel runs)"
+            import jax
+
+            if jax.process_count() > 1:
+                # the sharded buffer's writes/gathers assume every mesh device is
+                # addressable from this controller; per-process env data against a
+                # global-mesh sharding would silently drop foreign columns
+                raise ValueError(
+                    "buffer.device=True is single-controller only (one process, any "
+                    "number of local devices); use the host buffer for multihost runs"
+                )
+            # env axis mapped onto the mesh's data axis: local writes/gathers,
+            # batches come out already [G, T, B]-sharded for the train step
+            rb = ShardedDeviceSequentialReplayBuffer(
+                buffer_size, n_envs=cfg.env.num_envs, mesh=runtime.mesh
             )
-        rb = DeviceSequentialReplayBuffer(
-            buffer_size, n_envs=cfg.env.num_envs, device=runtime.device
-        )
+        else:
+            rb = DeviceSequentialReplayBuffer(
+                buffer_size, n_envs=cfg.env.num_envs, device=runtime.device
+            )
         prefetcher = InlineSampler(rb.sample)
     else:
         rb = EnvIndependentReplayBuffer(
