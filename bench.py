@@ -73,7 +73,7 @@ def bench_ppo(total_steps: int = 65536) -> dict:
     }
 
 
-def bench_dv3(batch: int = 16, seq: int = 64, iters: int = 20) -> dict:
+def bench_dv3(batch: int = 16, seq: int = 64, iters: int = 20, extra_overrides=()) -> dict:
     """Time the fused DreamerV3-S train step at the Atari-100K replay shape."""
     import gymnasium as gym
     import jax
@@ -97,6 +97,7 @@ def bench_dv3(batch: int = 16, seq: int = 64, iters: int = 20) -> dict:
             "algo.cnn_keys.decoder=[rgb]",
             "algo.mlp_keys.encoder=[]",
             "algo.mlp_keys.decoder=[]",
+            *extra_overrides,
         ]
     )
     runtime = Runtime(accelerator="auto", devices=1, precision=cfg.fabric.precision)

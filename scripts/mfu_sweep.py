@@ -1,0 +1,90 @@
+"""Sweep DV3 train-step configs on the real chip and print a table.
+
+Usage: python scripts/mfu_sweep.py [name ...]
+Each named config reruns bench.bench_dv3 with different batch/unroll/precision.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import sys
+
+sys.path.insert(0, ".")
+from bench import bench_dv3  # noqa: E402
+
+CONFIGS = {
+    "b16": dict(batch=16),
+    "b64": dict(batch=64),
+    "b64_du4": dict(batch=64, extra_overrides=["algo.world_model.dynamic_scan_unroll=4"]),
+    "b64_du8": dict(batch=64, extra_overrides=["algo.world_model.dynamic_scan_unroll=8"]),
+    "b64_iu5": dict(batch=64, extra_overrides=["algo.imagination_scan_unroll=5"]),
+    "b64_du8_iu5": dict(
+        batch=64,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=8",
+            "algo.imagination_scan_unroll=5",
+        ],
+    ),
+    "b64_bf16true": dict(batch=64, extra_overrides=["fabric.precision=bf16-true"]),
+    "b64_du8_iu5_bf16true": dict(
+        batch=64,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=8",
+            "algo.imagination_scan_unroll=5",
+            "fabric.precision=bf16-true",
+        ],
+    ),
+    "b128": dict(batch=128),
+    "b128_du8_iu5": dict(
+        batch=128,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=8",
+            "algo.imagination_scan_unroll=5",
+        ],
+    ),
+    "b128_iu5": dict(batch=128, extra_overrides=["algo.imagination_scan_unroll=5"]),
+    "b128_iu15": dict(batch=128, extra_overrides=["algo.imagination_scan_unroll=15"]),
+    "b128_du4_iu5": dict(
+        batch=128,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=4",
+            "algo.imagination_scan_unroll=5",
+        ],
+    ),
+    "b192_du4_iu5": dict(
+        batch=192,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=4",
+            "algo.imagination_scan_unroll=5",
+        ],
+    ),
+    "b256_du4_iu5": dict(
+        batch=256,
+        extra_overrides=[
+            "algo.world_model.dynamic_scan_unroll=4",
+            "algo.imagination_scan_unroll=5",
+        ],
+    ),
+}
+
+if __name__ == "__main__":
+    names = sys.argv[1:] or list(CONFIGS)
+    rows = []
+    for name in names:
+        kw = CONFIGS[name]
+        with contextlib.redirect_stdout(sys.stderr):
+            try:
+                r = bench_dv3(iters=20, **kw)
+            except Exception as e:
+                r = {"error": f"{type(e).__name__}: {e}"}
+        r["config"] = name
+        rows.append(r)
+        print(json.dumps(r), flush=True)
+    print("\n== summary ==", file=sys.stderr)
+    for r in rows:
+        print(
+            f"{r['config']:>22}: mfu={r.get('dv3_mfu')} gsps={r.get('dv3_gsteps_per_sec')} "
+            f"fps={r.get('dv3_frames_per_sec')} tflops={r.get('dv3_step_tflops')} err={r.get('error')}",
+            file=sys.stderr,
+        )

@@ -598,6 +598,7 @@ class RSSM:
         unimix: float = 0.01,
         learnable_initial_recurrent_state: bool = True,
         decoupled: bool = False,
+        dynamic_scan_unroll: int = 1,
     ):
         self.recurrent_model = recurrent_model
         self.representation_model = representation_model
@@ -607,6 +608,10 @@ class RSSM:
         self.unimix = unimix
         self.learnable_initial_recurrent_state = learnable_initial_recurrent_state
         self.decoupled = decoupled
+        # lax.scan unroll factor for the T-step dynamic scan: the per-step matmuls
+        # ([B,~1.5k]x[~1.5k,512] at the S preset) are small for the MXU, so unrolling
+        # lets XLA overlap/pipeline consecutive steps' HBM reads and MXU work
+        self.dynamic_scan_unroll = int(dynamic_scan_unroll)
 
     @property
     def stoch_state_size(self) -> int:
@@ -705,7 +710,7 @@ class RSSM:
                 return recurrent_state, (recurrent_state, prior_logits)
 
             _, (recurrent_states, priors_logits) = jax.lax.scan(
-                step, init_rec, (prev_posts, actions, is_first, keys)
+                step, init_rec, (prev_posts, actions, is_first, keys), unroll=self.dynamic_scan_unroll
             )
             # logits leave flat [T,B,S*D]; expose factorized [T,B,S,D] (the shape the
             # KL-balance loss and entropy metrics expect, reference loss.py:45-70)
@@ -723,7 +728,7 @@ class RSSM:
             return new_carry, (recurrent_state, posterior, post_logits, prior_logits)
 
         _, (recurrent_states, posteriors, posteriors_logits, priors_logits) = jax.lax.scan(
-            step, (init_rec, init_post), (actions, embedded_obs, is_first, keys)
+            step, (init_rec, init_post), (actions, embedded_obs, is_first, keys), unroll=self.dynamic_scan_unroll
         )
         # factorized logits [T,B,S,D]: categorical_kl and the entropy metrics softmax
         # per-categorical over D, not over the flat S*D vector
@@ -961,6 +966,7 @@ def build_agent(
         unimix=float(cfg.algo.unimix),
         learnable_initial_recurrent_state=bool(world_model_cfg.get("learnable_initial_recurrent_state", True)),
         decoupled=decoupled,
+        dynamic_scan_unroll=int(world_model_cfg.get("dynamic_scan_unroll", 1)),
     )
 
     cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)

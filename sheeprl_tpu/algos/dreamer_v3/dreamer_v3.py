@@ -82,6 +82,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     tau = float(cfg.algo.critic.tau)
     moments_cfg = cfg.algo.actor.moments
+    imagination_unroll = int(cfg.algo.get("imagination_scan_unroll", 1))
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
 
     world_tx = with_clipping(
@@ -205,7 +206,9 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                 new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
                 return (prior_flat, rec_state, new_act), (latent, new_act)
 
-            _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
+            _, (latents, acts) = jax.lax.scan(
+                step, (start_prior, start_recurrent, actions0), keys, unroll=imagination_unroll
+            )
             trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
             im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
             return trajectories, im_actions

@@ -85,12 +85,20 @@ class DMCWrapper(OldGymEnvAdapter):
         channels_first: bool = True,
         visualize_reward: bool = False,
         seed: Optional[int] = None,
+        action_repeat: int = 1,
     ):
         if not (from_vectors or from_pixels):
             raise ValueError(
                 "'from_vectors' and 'from_pixels' must not be both False: "
                 f"got {from_vectors} and {from_pixels} respectively."
             )
+        if action_repeat <= 0:
+            raise ValueError("`action_repeat` should be a positive integer")
+        # In-adapter action repeat (vs the generic ActionRepeat wrapper): pixels are
+        # rendered ONCE per repeated step instead of once per physics sub-step —
+        # rendering dominates dm_control stepping on CPU-rendering hosts (~25 ms vs
+        # ~0.5 ms physics), so the generic wrapper doubles env cost at repeat 2.
+        self._action_repeat = int(action_repeat)
         self._from_pixels = from_pixels
         self._from_vectors = from_vectors
         self._height = height
@@ -128,6 +136,7 @@ class DMCWrapper(OldGymEnvAdapter):
         self.current_state = None
         self._render_mode = "rgb_array"
         self._metadata = {}
+        self._cameras: Dict[int, Any] = {}
         self.seed(seed=seed)
 
     @property
@@ -175,7 +184,13 @@ class DMCWrapper(OldGymEnvAdapter):
         return (action * true_delta + self._true_action_space.low).astype(np.float32)
 
     def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
-        time_step = self.env.step(self._convert_action(action))
+        true_action = self._convert_action(action)
+        total = 0.0
+        for _ in range(self._action_repeat):
+            time_step = self.env.step(true_action)
+            total += time_step.reward or 0.0
+            if time_step.last():
+                break
         obs = self._get_obs(time_step)
         self.current_state = _flatten_obs(time_step.observation)
         info = {
@@ -184,7 +199,7 @@ class DMCWrapper(OldGymEnvAdapter):
         }
         truncated = time_step.last() and time_step.discount == 1
         terminated = False if time_step.first() else (time_step.last() and time_step.discount == 0)
-        return obs, time_step.reward or 0.0, terminated, truncated, info
+        return obs, total, terminated, truncated, info
 
     def reset(
         self, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
@@ -197,6 +212,20 @@ class DMCWrapper(OldGymEnvAdapter):
         return self._get_obs(time_step), {}
 
     def render(self, camera_id: Optional[int] = None) -> np.ndarray:
-        return self.env.physics.render(
-            height=self._height, width=self._width, camera_id=camera_id or self._camera_id
-        )
+        # physics.render builds a fresh Camera (scene + render-context alloc, ~7 ms
+        # of a ~25 ms CPU render) per call; cache one per camera id and re-render it
+        cam_id = camera_id if camera_id is not None else self._camera_id
+        cam = self._cameras.get(cam_id)
+        if cam is None:
+            from dm_control.mujoco.engine import Camera
+
+            cam = Camera(self.env.physics, height=self._height, width=self._width, camera_id=cam_id)
+            self._cameras[cam_id] = cam
+        try:
+            return cam.render().copy()
+        except Exception:
+            # model/scene changed under the cached camera (e.g. env rebuilt): rebuild once
+            self._cameras.pop(cam_id, None)
+            return self.env.physics.render(
+                height=self._height, width=self._width, camera_id=cam_id
+            )

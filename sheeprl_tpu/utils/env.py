@@ -47,6 +47,11 @@ def make_env(
             wrapper_spec["seed"] = seed
         if "rank" in wrapper_spec:
             wrapper_spec["rank"] = rank + vector_env_idx
+        # DMC repeats in-adapter so pixels render once per repeated step (not per
+        # physics sub-step); the generic ActionRepeat wrapper is skipped below.
+        dmc_native_repeat = str(wrapper_spec.get("_target_", "")).endswith("DMCWrapper")
+        if dmc_native_repeat and cfg.env.action_repeat > 1:
+            wrapper_spec["action_repeat"] = int(cfg.env.action_repeat)
         env = instantiate(wrapper_spec)
 
         try:
@@ -61,6 +66,7 @@ def make_env(
             cfg.env.action_repeat > 1
             and "atari" not in env_spec
             and not wrapper_target.endswith("DiambraWrapper")
+            and not dmc_native_repeat
         ):
             env = ActionRepeat(env, cfg.env.action_repeat)
 
