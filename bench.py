@@ -73,8 +73,18 @@ def bench_ppo(total_steps: int = 65536) -> dict:
     }
 
 
-def bench_dv3(batch: int = 16, seq: int = 64, iters: int = 20, extra_overrides=()) -> dict:
-    """Time the fused DreamerV3-S train step at the Atari-100K replay shape."""
+def bench_dv3(
+    batch: int = 128,
+    seq: int = 64,
+    iters: int = 20,
+    extra_overrides=("algo.imagination_scan_unroll=15",),
+) -> dict:
+    """Time the fused DreamerV3-S train step at the measured-best TPU config.
+
+    Defaults follow scripts/mfu_sweep.py on the v5e: batch 128 with the H=15
+    imagination scan fully unrolled measures 29.1% MFU / 75.0k replayed
+    frames/s (batch 16, the Atari-100K recipe shape, measures 44.5k frames/s;
+    batch is a free training-recipe choice at fixed replay_ratio)."""
     import gymnasium as gym
     import jax
     import numpy as np

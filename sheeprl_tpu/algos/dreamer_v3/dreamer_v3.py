@@ -14,6 +14,7 @@ global batch (replacing the reference's fabric.all_gather, utils.py:57).
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from functools import partial
 from typing import Any, Dict, NamedTuple, Sequence
@@ -515,9 +516,18 @@ def main(runtime, cfg: Dict[str, Any]):
     player.init_states()
 
     cumulative_per_rank_gradient_steps = 0
+    heartbeat_t0, heartbeat_iter = time.perf_counter(), start_iter
     for iter_num in range(start_iter, total_iters + 1):
         profiler.step(policy_step)
         policy_step += policy_steps_per_iter
+        if iter_num % 100 == 0 and iter_num > heartbeat_iter:
+            now = time.perf_counter()
+            runtime.print(
+                f"[hb] iter={iter_num}/{total_iters} policy_step={policy_step} "
+                f"({(iter_num - heartbeat_iter) / (now - heartbeat_t0):.2f} it/s)",
+                flush=True,
+            )
+            heartbeat_t0, heartbeat_iter = now, iter_num
 
         with timer("Time/env_interaction_time", SumMetric()):
             if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():

@@ -74,29 +74,48 @@ class Runtime:
     callbacks: Sequence[Any] = field(default_factory=list)
     multihost: bool = False
     player_on_host: bool = True
+    # manual coordinator wiring (fabric.coordinator_address etc.); None = the
+    # launcher's cluster auto-detection. multihost_timeout_s bounds the wait for
+    # an absent/unreachable coordinator instead of jax's 300 s default.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    multihost_timeout_s: Optional[float] = None
 
     def __post_init__(self):
-        if self.multihost and not _distributed_initialized():  # pragma: no cover - multihost only
+        if self.multihost and not _distributed_initialized():
             # The guard must NOT probe jax.process_count(): that initializes the local
             # backend, after which jax.distributed.initialize() can no longer run.
             # Fail loudly: silently proceeding single-host after a botched pod config
             # wastes the whole allocation (reference Fabric raises on bad cluster env too).
+            kwargs: Dict[str, Any] = {}
+            if self.coordinator_address is not None:
+                kwargs.update(
+                    coordinator_address=self.coordinator_address,
+                    num_processes=self.num_processes,
+                    process_id=self.process_id,
+                )
+            if self.multihost_timeout_s is not None:
+                kwargs["initialization_timeout"] = int(self.multihost_timeout_s)
             try:
-                jax.distributed.initialize()
+                jax.distributed.initialize(**kwargs)
             except Exception as e:
                 if "already" in str(e).lower():  # initialized by a launcher/earlier Runtime
                     pass
                 else:
                     raise RuntimeError(
-                        "fabric.multihost=True but jax.distributed.initialize() failed. "
-                        "Check the coordinator address / JAX_COORDINATOR_ADDRESS and pod env, "
-                        "and make sure the Runtime is constructed before any JAX computation."
+                        "fabric.multihost=True but jax.distributed.initialize() failed "
+                        "(coordinator absent/unreachable?). Check the coordinator address / "
+                        "JAX_COORDINATOR_ADDRESS and pod env, and make sure the Runtime is "
+                        "constructed before any JAX computation."
                     ) from e
             print(
                 f"[sheeprl_tpu] multihost initialized: process "
                 f"{jax.process_index()}/{jax.process_count()}, "
                 f"{jax.local_device_count()} local / {jax.device_count()} global devices"
             )
+        if self.multihost:
+            self._validate_homogeneous_devices()
         platform = None if self.accelerator in ("auto", "gpu", "cuda") else self.accelerator
         if self.accelerator in ("tpu", "axon"):
             platform = None  # default platform is already the TPU under axon
@@ -268,6 +287,40 @@ class Runtime:
 
             multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
 
+    def _validate_homogeneous_devices(self) -> None:
+        """Fail fast on heterogeneous per-process device counts.
+
+        DP meshes assume equal per-rank shards (the reference's DDP makes the same
+        assumption per node); a pod booted with uneven visible devices would
+        otherwise fail much later with an opaque sharding error — or worse, train
+        with silently skewed per-rank batches. Exchanged through the coordinator's
+        KV store, NOT a device collective: the whole point is that the device
+        config may be broken.
+        """
+        if jax.process_count() <= 1:
+            return
+        from jax._src import distributed
+
+        client = getattr(distributed.global_state, "client", None)
+        if client is None:  # pragma: no cover - initialize() always sets it
+            return
+        me = jax.process_index()
+        # allow_overwrite: a second Runtime in the same process (launcher case,
+        # exploration->finetuning chains) re-validates against the same keys
+        client.key_value_set(
+            f"sheeprl_tpu/local_devices/{me}", str(jax.local_device_count()), allow_overwrite=True
+        )
+        counts = {
+            p: int(client.blocking_key_value_get(f"sheeprl_tpu/local_devices/{p}", 30_000))
+            for p in range(jax.process_count())
+        }
+        if len(set(counts.values())) > 1:
+            raise RuntimeError(
+                f"Heterogeneous local device counts across processes: {counts}. "
+                "Data-parallel meshes need the same per-process device count — check "
+                "each host's visible accelerators / XLA flags."
+            )
+
     def seed_everything(self, seed: int) -> int:
         return seed_everything(seed)
 
@@ -291,6 +344,10 @@ def build_runtime(cfg_fabric: Dict[str, Any], extra_callbacks: Optional[Sequence
         callbacks=callbacks,
         multihost=bool(cfg_fabric.get("multihost", False)),
         player_on_host=bool(cfg_fabric.get("player_on_host", True)),
+        coordinator_address=cfg_fabric.get("coordinator_address"),
+        num_processes=cfg_fabric.get("num_processes"),
+        process_id=cfg_fabric.get("process_id"),
+        multihost_timeout_s=cfg_fabric.get("multihost_timeout_s"),
     )
 
 

@@ -7,13 +7,30 @@ pixel workloads at the reference's scale (e.g. DreamerV3 Atari-100K: 100k
 frames x 64x64x3 uint8 ~= 1.2 GB) fit comfortably in a single chip's HBM, so
 the whole replay pipeline can live on device:
 
-- storage: a dict of ``[capacity, n_envs, *leaf]`` jax arrays (pixels stay uint8);
+- storage: per-leaf jax arrays in a TILE-AWARE physical layout (see below);
 - add: one donated jitted scatter per step — in-place in HBM, the only
   host->device traffic is the new transition itself (~100 KB/step for 8 pixel
   envs, vs ~25 MB/train-iteration for host-sampled [G,T,B] batches);
 - sample: host draws the (tiny, int32) start/env indices from per-env valid
   ranges, a jitted gather assembles the ``[G, T, B, *]`` batch entirely in HBM —
   the training step consumes it with ZERO bulk host->device transfer.
+
+Physical layout. TPU HBM buffers are tiled over the last two axes (f32 8x128,
+bf16 16x128, uint8 32x128), so the naive logical layout ``[cap, n_envs, *leaf]``
+pads catastrophically: ``[cap, 4, 3, 64, 64]`` uint8 doubles (64 -> 128 lanes)
+and a ``[cap, 4, 1]`` f32 flag pads 4 -> 8 sublanes x 1 -> 128 lanes = 256x
+(0.5 GB for a 2 MB array; a DMC-scale buffer "grew" from 6.3 GB logical to
+17.2 GB physical and OOM'd the chip). Each leaf therefore stores as either
+
+- ``chunk`` (feature size F >= one tile quantum): ``[cap, n_envs, P/128, 128]``
+  with F padded up to the dtype's tile quantum P (u8: 4096, bf16: 2048, f32:
+  1024) — zero padding for 64x64x3 pixels (12288 = 3 u8 quanta); or
+- ``tminor`` (small F): ``[n_envs*F, cap]`` — time is the minor axis, so the
+  array is lane-dense for any F, per-step writes are tiny pointwise scatters,
+  and sequence gathers read stride-1 runs.
+
+Checkpoints store the LOGICAL ``[cap, n_envs, *leaf]`` arrays, so the physical
+layout can evolve without breaking resume.
 
 Each env has its OWN circular write head (mirroring EnvIndependentReplayBuffer):
 episode-boundary patch rows (``add(reset_data, dones_idxes)``) advance only the
@@ -32,7 +49,7 @@ train loops make, so ``buffer.device=True`` swaps it in transparently.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,8 +59,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 __all__ = ["DeviceSequentialReplayBuffer", "ShardedDeviceSequentialReplayBuffer"]
 
 
+class _LeafMeta(NamedTuple):
+    feat: Tuple[int, ...]  # logical per-step feature shape (leaf.shape[2:])
+    flat: int  # prod(feat)
+    padded: int  # chunk layout: flat padded to the tile quantum; tminor: == flat
+    layout: str  # "chunk" | "tminor"
+    dtype: Any
+
+
+def _tile_quantum(dtype) -> int:
+    """Smallest feature size that tiles with zero waste: 128 lanes x the dtype's
+    sublane count (f32 8, bf16 16, u8 32 -> 1024/2048/4096 elements)."""
+    return 128 * max(256 // (np.dtype(dtype).itemsize * 8), 1)
+
+
+def _leaf_meta(feat: Tuple[int, ...], dtype) -> _LeafMeta:
+    flat = int(np.prod(feat)) if feat else 1
+    q = _tile_quantum(dtype)
+    if flat >= q:
+        padded = ((flat + q - 1) // q) * q
+        return _LeafMeta(feat, flat, padded, "chunk", dtype)
+    return _LeafMeta(feat, flat, flat, "tminor", dtype)
+
+
 class DeviceSequentialReplayBuffer:
-    """Circular ``[capacity, n_envs, *]`` buffer living in accelerator memory."""
+    """Circular per-env replay living in accelerator memory (logical
+    ``[capacity, n_envs, *leaf]``; tile-aware physical layout, module docstring)."""
 
     def __init__(
         self,
@@ -57,14 +98,16 @@ class DeviceSequentialReplayBuffer:
         self._n_envs = int(n_envs)
         self._device = device
         self._buf: Optional[Dict[str, jax.Array]] = None
+        self._meta: Dict[str, _LeafMeta] = {}
         # independent circular write head per env (host-side bookkeeping)
         self._pos = np.zeros(self._n_envs, dtype=np.int64)
         self._full = np.zeros(self._n_envs, dtype=bool)
         self._rng: np.random.Generator = np.random.default_rng()
-        # jit caches keyed by (rows, n_cols) so step adds and boundary patches
-        # each compile once
+        # jit caches: writes keyed by (rows, n_envs_written, keys), gathers by
+        # (seq_len, n, keys) — each shape/key-set combination compiles once
         self._write_fns: Dict[Any, Any] = {}
-        self._gather = jax.jit(self._gather_impl, static_argnames=("seq_len",))
+        self._gather_fns: Dict[Any, Any] = {}
+        self._view_fns: Dict[Any, Any] = {}
 
     # ----- properties mirroring the host buffers ---------------------------------------
     @property
@@ -85,7 +128,11 @@ class DeviceSequentialReplayBuffer:
 
     @property
     def buffer(self) -> Optional[Dict[str, jax.Array]]:
-        return self._buf
+        """Materialized LOGICAL ``[cap, n_envs, *leaf]`` view (debug/inspection;
+        the hot paths never build it)."""
+        if self._buf is None:
+            return None
+        return {k: self._logical_view(k) for k in self._buf}
 
     def __len__(self) -> int:
         return self._buffer_size
@@ -96,7 +143,7 @@ class DeviceSequentialReplayBuffer:
     def _filled(self) -> np.ndarray:
         return np.where(self._full, self._buffer_size, self._pos)
 
-    # ----- write path ------------------------------------------------------------------
+    # ----- layout helpers --------------------------------------------------------------
     @staticmethod
     def _narrow(arr: np.ndarray) -> np.ndarray:
         if arr.dtype == np.float64:
@@ -105,35 +152,79 @@ class DeviceSequentialReplayBuffer:
             return arr.astype(np.int32)
         return arr
 
-    def _to_device(self, v) -> jax.Array:
-        return jax.device_put(self._narrow(np.asarray(v)), self._device)
+    def _to_physical(self, key: str, block: np.ndarray) -> np.ndarray:
+        """Host-side: ``[rows, k, *feat]`` -> the physical write-block layout
+        (chunk: ``[rows, k, P/128, 128]``; tminor: ``[k, F, rows]``)."""
+        m = self._meta[key]
+        rows, k = block.shape[:2]
+        flat = np.ascontiguousarray(block).reshape(rows, k, m.flat)
+        if m.layout == "chunk":
+            if m.padded != m.flat:
+                pad = np.zeros((rows, k, m.padded - m.flat), dtype=flat.dtype)
+                flat = np.concatenate([flat, pad], axis=-1)
+            return flat.reshape(rows, k, m.padded // 128, 128)
+        return np.ascontiguousarray(flat.transpose(1, 2, 0))  # [k, F, rows]
+
+    def _storage_shape(self, key: str) -> Tuple[int, ...]:
+        m = self._meta[key]
+        if m.layout == "chunk":
+            return (self._buffer_size, self._n_envs, m.padded // 128, 128)
+        return (self._n_envs * m.flat, self._buffer_size)
+
+    def _logical_view(self, key: str) -> jax.Array:
+        """Jitted physical -> logical [cap, n_envs, *feat] reconstruction."""
+        m = self._meta[key]
+        if key not in self._view_fns:
+
+            def view(store):
+                if m.layout == "chunk":
+                    out = store.reshape(self._buffer_size, self._n_envs, m.padded)[..., : m.flat]
+                else:
+                    out = store.reshape(self._n_envs, m.flat, self._buffer_size).transpose(2, 0, 1)
+                return out.reshape(self._buffer_size, self._n_envs, *m.feat)
+
+            self._view_fns[key] = jax.jit(view)
+        return self._view_fns[key](self._buf[key])
+
+    # ----- write path ------------------------------------------------------------------
+    def _put(self, v: np.ndarray) -> jax.Array:
+        return jax.device_put(v, self._device)
 
     def _allocate(self, data: Dict[str, np.ndarray]) -> None:
         buf = {}
         for k, v in data.items():
             leaf = self._narrow(np.asarray(v))
-            buf[k] = jax.device_put(
-                jnp.zeros((self._buffer_size, self._n_envs, *leaf.shape[2:]), dtype=leaf.dtype),
-                self._device,
-            )
+            self._meta[k] = _leaf_meta(tuple(leaf.shape[2:]), leaf.dtype)
+            buf[k] = jax.jit(
+                partial(jnp.zeros, self._storage_shape(k), leaf.dtype),
+                out_shardings=None if self._device is None else jax.sharding.SingleDeviceSharding(self._device),
+            )()
         self._buf = buf
 
-    def _write_fn(self, rows: int, cols: int):
-        """Donated writer: block [rows, cols, *] lands at per-env head positions."""
-        key = (rows, cols)
-        if key not in self._write_fns:
+    def _write_fn(self, rows: int, k: int, keys_sig):
+        """Donated writer: physical blocks land at per-env head positions."""
+        cache_key = (rows, k, keys_sig)
+        if cache_key not in self._write_fns:
+            cap = self._buffer_size
+            metas = {key: self._meta[key] for key in keys_sig}
 
-            def write(buf, block, pos, env_idx):
-                # row_idx [rows, cols]: each target env writes at ITS head
-                row_idx = (pos[None, :] + jnp.arange(rows)[:, None]) % self._buffer_size
+            def write(buf, blocks, pos, env_idx):
+                row_idx = (pos[None, :] + jnp.arange(rows)[:, None]) % cap  # [rows, k]
 
-                def one(store, new):
-                    return store.at[row_idx, env_idx[None, :]].set(new.astype(store.dtype))
+                def one(key, store, new):
+                    m = metas[key]
+                    if m.layout == "chunk":
+                        # new: [rows, k, C, 128]
+                        return store.at[row_idx, env_idx[None, :]].set(new.astype(store.dtype))
+                    # new: [k, F, rows]; rowsel [k, F]; cols [k, rows]
+                    rowsel = env_idx[:, None] * m.flat + jnp.arange(m.flat)[None, :]
+                    cols = (pos[:, None] + jnp.arange(rows)[None, :]) % cap
+                    return store.at[rowsel[:, :, None], cols[:, None, :]].set(new.astype(store.dtype))
 
-                return jax.tree_util.tree_map(one, buf, block)
+                return {key: one(key, buf[key], blocks[key]) for key in buf}
 
-            self._write_fns[key] = jax.jit(write, donate_argnums=(0,))
-        return self._write_fns[key]
+            self._write_fns[cache_key] = jax.jit(write, donate_argnums=(0,))
+        return self._write_fns[cache_key]
 
     def add(
         self,
@@ -157,17 +248,34 @@ class DeviceSequentialReplayBuffer:
             if indices is None
             else np.asarray(list(indices), dtype=np.int64)
         )
-        block = {k: self._to_device(v) for k, v in data.items()}
+        blocks = {k: self._put(self._to_physical(k, self._narrow(np.asarray(v)))) for k, v in data.items()}
         pos = self._pos[env_idx]
-        self._buf = self._write_fn(rows, len(env_idx))(
+        self._buf = self._write_fn(rows, len(env_idx), tuple(sorted(data)))(
             self._buf,
-            block,
-            jax.device_put(pos.astype(np.int32), self._device),
-            jax.device_put(env_idx.astype(np.int32), self._device),
+            blocks,
+            self._put(pos.astype(np.int32)),
+            self._put(env_idx.astype(np.int32)),
         )
         new_pos = pos + rows
         self._full[env_idx] |= new_pos >= self._buffer_size
         self._pos[env_idx] = new_pos % self._buffer_size
+
+    def _write_rows(self, values: Dict[str, np.ndarray], env_idx: np.ndarray, pos: np.ndarray) -> None:
+        """Overwrite one row of the given envs with host values ``[k, *feat]``."""
+        keys_sig = tuple(sorted(values))
+        sub = {k: self._buf[k] for k in keys_sig}
+        blocks = {k: self._put(self._to_physical(k, self._narrow(np.asarray(v))[None])) for k, v in values.items()}
+        out = self._write_fn(1, len(env_idx), keys_sig)(
+            sub, blocks, self._put(pos.astype(np.int32)), self._put(env_idx.astype(np.int32))
+        )
+        self._buf.update(out)
+
+    def _read_row(self, key: str, env_idx: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        """Host copy of one row per env: ``[k, *feat]`` (tiny; checkpoint/patch path)."""
+        out = self._gather((key,), 1, len(env_idx))(
+            {key: self._buf[key]}, self._put(pos.astype(np.int32)), self._put(env_idx.astype(np.int32))
+        )[key]
+        return np.asarray(jax.device_get(out))[:, 0]  # [k, T=1, *feat] -> [k, *feat]
 
     def _patch_truncated(self):
         """Force the last written step of every env to 'truncated'; return undo state.
@@ -178,54 +286,60 @@ class DeviceSequentialReplayBuffer:
         """
         if self._buf is None or "truncated" not in self._buf:
             return None
-        last_np = ((self._pos - 1) % self._buffer_size).astype(np.int32)
-        last = self._to_device(last_np)
-        envs = self._to_device(np.arange(self._n_envs, dtype=np.int32))
-        original = np.asarray(jax.device_get(self._buf["truncated"][last, envs]))
-        patched = jnp.where(
-            self._buf["terminated"][last, envs] > 0,
-            jnp.zeros_like(self._buf["truncated"][last, envs]),
-            jnp.ones_like(self._buf["truncated"][last, envs]),
-        )
-        self._buf["truncated"] = self._buf["truncated"].at[last, envs].set(patched)
-        return (last_np, original)
+        env_idx = np.arange(self._n_envs, dtype=np.int64)
+        last = ((self._pos - 1) % self._buffer_size).astype(np.int64)
+        terminated = self._read_row("terminated", env_idx, last)
+        original = self._read_row("truncated", env_idx, last)
+        patched = np.where(terminated > 0, 0, 1).astype(original.dtype)
+        self._write_rows({"truncated": patched}, env_idx, last)
+        return (last, original)
 
     def _unpatch_truncated(self, undo) -> None:
         if undo is None:
             return
-        last_np, original = undo
-        last = self._to_device(last_np)
-        envs = self._to_device(np.arange(self._n_envs, dtype=np.int32))
-        self._buf["truncated"] = self._buf["truncated"].at[last, envs].set(
-            self._to_device(original).astype(self._buf["truncated"].dtype)
-        )
+        last, original = undo
+        self._write_rows({"truncated": original}, np.arange(self._n_envs, dtype=np.int64), last)
 
     def patch_last(self, env_indices: Sequence[int], values: Dict[str, float]) -> None:
         """Overwrite scalar keys of the most recent row of the given envs.
 
         The RestartOnException tail patch (reference dreamer_v3.py:559-572 adapted):
         after an env crash-restart, the last stored transition becomes a truncation
-        boundary. Rare event, tiny keys (e.g. ``terminated`` is [cap, n_envs, 1]),
-        so the eager functional update's copy is negligible.
+        boundary. Rare event, tiny keys, so the extra write-fn compile is negligible.
         """
         env_idx = np.asarray(list(env_indices), dtype=np.int64)
-        rows = self._to_device(((self._pos[env_idx] - 1) % self._buffer_size).astype(np.int32))
-        env_d = self._to_device(env_idx.astype(np.int32))
-        for k, val in values.items():
-            store = self._buf[k]
-            self._buf[k] = store.at[rows, env_d].set(
-                jnp.full((len(env_idx), *store.shape[2:]), val, dtype=store.dtype)
-            )
+        pos = (self._pos[env_idx] - 1) % self._buffer_size
+        rows = {
+            k: np.full((len(env_idx), *self._meta[k].feat), val, dtype=self._meta[k].dtype)
+            for k, val in values.items()
+        }
+        self._write_rows(rows, env_idx, pos)
 
     # ----- sample path -----------------------------------------------------------------
-    def _gather_impl(self, buf, starts, env_idx, seq_len: int):
-        """[N] starts/envs -> {k: [N, T, ...]} gathered in HBM."""
-        row_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % self._buffer_size  # [N, T]
+    def _gather(self, keys_sig, seq_len: int, n: int):
+        """[n] starts/envs -> {k: [n, seq_len, *feat]} gathered in HBM."""
+        cache_key = (keys_sig, seq_len, n)
+        if cache_key not in self._gather_fns:
+            cap = self._buffer_size
+            metas = {key: self._meta[key] for key in keys_sig}
 
-        def one(store):
-            return store[row_idx, env_idx[:, None]]  # [N, T, *]
+            def gather(buf, starts, env_idx):
+                row_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # [n, T]
 
-        return jax.tree_util.tree_map(one, buf)
+                def one(key, store):
+                    m = metas[key]
+                    if m.layout == "chunk":
+                        out = store[row_idx, env_idx[:, None]]  # [n, T, C, 128]
+                        out = out.reshape(n, seq_len, m.padded)[..., : m.flat]
+                    else:
+                        rowsel = env_idx[:, None] * m.flat + jnp.arange(m.flat)[None, :]  # [n, F]
+                        out = store[rowsel[:, None, :], row_idx[:, :, None]]  # [n, T, F]
+                    return out.reshape(n, seq_len, *m.feat)
+
+                return {key: one(key, buf[key]) for key in buf}
+
+            self._gather_fns[cache_key] = jax.jit(gather)
+        return self._gather_fns[cache_key]
 
     def sample(
         self,
@@ -256,11 +370,10 @@ class DeviceSequentialReplayBuffer:
         # never cross it (the host SequentialReplayBuffer does the same)
         anchor = np.where(self._full[env_idx], self._pos[env_idx], 0)
         starts = (anchor + offsets) % self._buffer_size
-        out = self._gather(
+        out = self._gather(tuple(sorted(self._buf)), int(sequence_length), n)(
             self._buf,
-            jax.device_put(starts.astype(np.int32), self._device),
-            jax.device_put(env_idx.astype(np.int32), self._device),
-            seq_len=int(sequence_length),
+            self._put(starts.astype(np.int32)),
+            self._put(env_idx.astype(np.int32)),
         )
         # [N, T, *] -> [G, T, B, *] (match the host SequentialReplayBuffer layout)
         return {
@@ -272,9 +385,20 @@ class DeviceSequentialReplayBuffer:
     sample_tensors = sample
 
     # ----- checkpointing ---------------------------------------------------------------
+    def _check_ckpt_shape(self, logical: Dict[str, np.ndarray]) -> None:
+        cap, envs = next(iter(logical.values())).shape[:2]
+        if cap != self._buffer_size or envs != self._n_envs:
+            raise ValueError(
+                f"Checkpointed replay buffer is [{cap} x {envs} envs] but this run is "
+                f"configured for [{self._buffer_size} x {self._n_envs} envs]; resume with "
+                "the same buffer.size and env.num_envs (a silent reshape would corrupt replay)"
+            )
+
     def state_dict(self) -> Dict[str, Any]:
         host = (
-            {k: np.asarray(jax.device_get(v)) for k, v in self._buf.items()} if self._buf is not None else None
+            {k: np.asarray(jax.device_get(self._logical_view(k))) for k in self._buf}
+            if self._buf is not None
+            else None
         )
         return {"buffer": host, "pos": self._pos.copy(), "full": self._full.copy()}
 
@@ -288,7 +412,24 @@ class DeviceSequentialReplayBuffer:
         if host is not None:
             if isinstance(host, dict) and host and not isinstance(next(iter(host.values())), np.ndarray):
                 raise ValueError("Unrecognized device-buffer checkpoint payload")
-            self._buf = {k: self._to_device(v) for k, v in host.items()} if host else None
+            if host:
+                # logical [cap, n_envs, *feat] -> physical storage, via the add
+                # machinery: allocate, then write every row at pos 0
+                self._meta = {}
+                self._buf = None
+                self._write_fns, self._gather_fns, self._view_fns = {}, {}, {}
+                logical = {k: self._narrow(np.asarray(v)) for k, v in host.items()}
+                self._check_ckpt_shape(logical)
+                self._allocate({k: v[:1] for k, v in logical.items()})
+                env_idx = np.arange(self._n_envs, dtype=np.int64)
+                blocks = {k: self._put(self._to_physical(k, v)) for k, v in logical.items()}
+                rows = next(iter(logical.values())).shape[0]
+                self._buf = self._write_fn(rows, self._n_envs, tuple(sorted(logical)))(
+                    self._buf,
+                    blocks,
+                    self._put(np.zeros(self._n_envs, dtype=np.int32)),
+                    self._put(env_idx.astype(np.int32)),
+                )
         self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
         self._full = np.asarray(state["full"], dtype=bool).copy()
         return self
@@ -314,7 +455,8 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
 
     Partial-env writes (episode-boundary resets, crash-restart patches) use the
     same dense write with a per-env mask, so no sparse cross-shard scatter ever
-    forms.
+    forms. Uses the same tile-aware physical layouts as the parent (module
+    docstring); both layouts shard cleanly on their env-major axis.
     """
 
     def __init__(self, buffer_size: int, n_envs: int, mesh: Mesh, axis: str = "data"):
@@ -329,15 +471,27 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
         self._axis = axis
         self._world = world
         self._n_local = n_envs // world
-        self._storage_spec = P(None, axis)
-        self._storage_sharding = NamedSharding(mesh, self._storage_spec)
         self._vec_sharding = NamedSharding(mesh, P(axis))
-        self._gather_fns: Dict[Any, Any] = {}
 
-    # ----- placement -------------------------------------------------------------------
-    def _to_device(self, v) -> jax.Array:
-        # storage-shaped leaves only ([rows|cap, n_envs, *]): env axis on the mesh
-        return jax.device_put(self._narrow(np.asarray(v)), self._storage_sharding)
+    # ----- layout / placement ----------------------------------------------------------
+    def _storage_spec(self, key: str) -> P:
+        # chunk [cap, n_envs, C, 128] shards the env axis; tminor [n_envs*F, cap]
+        # shards its env-major row axis (env blocks are contiguous)
+        if self._meta[key].layout == "chunk":
+            return P(None, self._axis, None, None)
+        return P(self._axis, None)
+
+    def _block_spec(self, key: str) -> P:
+        # write blocks: chunk [rows, k, C, 128]; tminor [k, F, rows]
+        if self._meta[key].layout == "chunk":
+            return P(None, self._axis, None, None)
+        return P(self._axis, None, None)
+
+    def _storage_sharding(self, key: str) -> NamedSharding:
+        return NamedSharding(self._mesh, self._storage_spec(key))
+
+    def _put_block(self, key: str, v: np.ndarray) -> jax.Array:
+        return jax.device_put(v, NamedSharding(self._mesh, self._block_spec(key)))
 
     def _to_vec(self, v: np.ndarray) -> jax.Array:
         return jax.device_put(np.ascontiguousarray(v), self._vec_sharding)
@@ -346,53 +500,85 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
         buf = {}
         for k, v in data.items():
             leaf = self._narrow(np.asarray(v))
-            shape = (self._buffer_size, self._n_envs, *leaf.shape[2:])
+            self._meta[k] = _leaf_meta(tuple(leaf.shape[2:]), leaf.dtype)
             buf[k] = jax.jit(
-                partial(jnp.zeros, shape, leaf.dtype), out_shardings=self._storage_sharding
+                partial(jnp.zeros, self._storage_shape(k), leaf.dtype),
+                out_shardings=self._storage_sharding(k),
             )()
         self._buf = buf
 
+    def _logical_view(self, key: str) -> jax.Array:
+        m = self._meta[key]
+        if key not in self._view_fns:
+
+            def view(store):
+                if m.layout == "chunk":
+                    out = store.reshape(self._buffer_size, self._n_envs, m.padded)[..., : m.flat]
+                else:
+                    out = store.reshape(self._n_envs, m.flat, self._buffer_size).transpose(2, 0, 1)
+                return out.reshape(self._buffer_size, self._n_envs, *m.feat)
+
+            self._view_fns[key] = jax.jit(
+                view, out_shardings=NamedSharding(self._mesh, P(None, self._axis))
+            )
+        return self._view_fns[key](self._buf[key])
+
     # ----- write path ------------------------------------------------------------------
-    def _write_fn(self, rows: int, keys_sig):
-        """Dense masked writer: every env's column is written (kept envs keep their
+    def _write_fn(self, rows: int, k_unused: int, keys_sig):
+        """Dense masked writer: every env column is written (kept envs keep their
         current value via the mask), so each shard's scatter is purely local."""
-        key = (rows, keys_sig)
-        if key not in self._write_fns:
+        cache_key = (rows, keys_sig)
+        if cache_key not in self._write_fns:
             cap = self._buffer_size
             nl = self._n_local
+            metas = {key: self._meta[key] for key in keys_sig}
 
             def body(store_tree, block_tree, pos, mask):
-                # per-shard views: store [cap, nl, *], block [rows, nl, *], pos/mask [nl]
-                cols = jnp.arange(nl)
+                # per-shard: pos/mask [nl]; chunk store [cap, nl, C, 128] + block
+                # [rows, nl, C, 128]; tminor store [nl*F, cap] + block [nl, F, rows]
                 row_idx = (pos[None, :] + jnp.arange(rows)[:, None]) % cap  # [rows, nl]
+                cols = jnp.arange(nl)
 
-                def one(store, new):
-                    cur = store[row_idx, cols[None, :]]  # [rows, nl, *]
-                    m = mask.reshape((1, nl) + (1,) * (cur.ndim - 2))
-                    return store.at[row_idx, cols[None, :]].set(
-                        jnp.where(m, new.astype(store.dtype), cur)
+                def one(key, store, new):
+                    m = metas[key]
+                    if m.layout == "chunk":
+                        cur = store[row_idx, cols[None, :]]  # [rows, nl, C, 128]
+                        sel = mask.reshape(1, nl, 1, 1)
+                        return store.at[row_idx, cols[None, :]].set(
+                            jnp.where(sel, new.astype(store.dtype), cur)
+                        )
+                    rowsel = cols[:, None] * m.flat + jnp.arange(m.flat)[None, :]  # [nl, F]
+                    tcols = (pos[:, None] + jnp.arange(rows)[None, :]) % cap  # [nl, rows]
+                    cur = store[rowsel[:, :, None], tcols[:, None, :]]  # [nl, F, rows]
+                    sel = mask.reshape(nl, 1, 1)
+                    return store.at[rowsel[:, :, None], tcols[:, None, :]].set(
+                        jnp.where(sel, new.astype(store.dtype), cur)
                     )
 
-                return jax.tree_util.tree_map(one, store_tree, block_tree)
+                return {key: one(key, store_tree[key], block_tree[key]) for key in store_tree}
 
             smapped = jax.shard_map(
                 body,
                 mesh=self._mesh,
-                in_specs=(self._storage_spec, self._storage_spec, P(self._axis), P(self._axis)),
-                out_specs=self._storage_spec,
+                in_specs=(
+                    {key: self._storage_spec(key) for key in keys_sig},
+                    {key: self._block_spec(key) for key in keys_sig},
+                    P(self._axis),
+                    P(self._axis),
+                ),
+                out_specs={key: self._storage_spec(key) for key in keys_sig},
                 check_vma=False,
             )
-            self._write_fns[key] = jax.jit(smapped, donate_argnums=(0,))
-        return self._write_fns[key]
+            self._write_fns[cache_key] = jax.jit(smapped, donate_argnums=(0,))
+        return self._write_fns[cache_key]
 
-    def _masked_write(self, block: Dict[str, np.ndarray], pos: np.ndarray, mask: np.ndarray) -> None:
-        """Write dense [rows, n_envs, *] host blocks at per-env positions where mask."""
-        rows = int(next(iter(block.values())).shape[0])
-        keys_sig = tuple(sorted(block))
+    def _masked_write(self, data: Dict[str, np.ndarray], pos: np.ndarray, mask: np.ndarray, rows: int) -> None:
+        """Write dense ``[rows, n_envs, *feat]`` host blocks where mask."""
+        keys_sig = tuple(sorted(data))
         sub = {k: self._buf[k] for k in keys_sig}
-        dev_block = {k: self._to_device(v) for k, v in block.items()}
-        out = self._write_fn(rows, keys_sig)(
-            sub, dev_block, self._to_vec(pos.astype(np.int32)), self._to_vec(mask)
+        blocks = {k: self._put_block(k, self._to_physical(k, self._narrow(np.asarray(v)))) for k, v in data.items()}
+        out = self._write_fn(rows, self._n_envs, keys_sig)(
+            sub, blocks, self._to_vec(pos.astype(np.int32)), self._to_vec(mask)
         )
         self._buf.update(out)
 
@@ -426,67 +612,104 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
                 dense = np.zeros((rows, self._n_envs, *v.shape[2:]), dtype=v.dtype)
                 dense[:, env_idx] = v
                 block[k] = dense
-        self._masked_write(block, self._pos, mask)
+        self._masked_write(block, self._pos, mask, rows)
         new_pos = self._pos[env_idx] + rows
         self._full[env_idx] |= new_pos >= self._buffer_size
         self._pos[env_idx] = new_pos % self._buffer_size
 
-    def patch_last(self, env_indices: Sequence[int], values: Dict[str, float]) -> None:
-        env_idx = np.asarray(list(env_indices), dtype=np.int64)
+    def _write_rows(self, values: Dict[str, np.ndarray], env_idx: np.ndarray, pos: np.ndarray) -> None:
         mask = np.zeros(self._n_envs, dtype=bool)
         mask[env_idx] = True
-        block = {
-            k: np.full((1, self._n_envs, *self._buf[k].shape[2:]), val, dtype=self._buf[k].dtype)
-            for k, val in values.items()
-        }
-        self._masked_write(block, (self._pos - 1) % self._buffer_size, mask)
+        dense_pos = np.zeros(self._n_envs, dtype=np.int64)
+        dense_pos[env_idx] = pos
+        dense = {}
+        for k, v in values.items():
+            v = self._narrow(np.asarray(v))
+            d = np.zeros((1, self._n_envs, *v.shape[1:]), dtype=v.dtype)
+            d[0, env_idx] = v
+            dense[k] = d
+        self._masked_write(dense, dense_pos, mask, 1)
 
-    def _patch_truncated(self):
-        if self._buf is None or "truncated" not in self._buf:
-            return None
-        last = ((self._pos - 1) % self._buffer_size).astype(np.int64)
-        envs = np.arange(self._n_envs)
-        # tiny [n_envs, 1] pulls; the masked write keeps the storage sharding intact
-        terminated = np.asarray(jax.device_get(self._buf["terminated"][last, envs]))
-        original = np.asarray(jax.device_get(self._buf["truncated"][last, envs]))
-        patched = np.where(terminated > 0, 0, 1).astype(original.dtype)
-        self._masked_write(
-            {"truncated": patched[None]}, last, np.ones(self._n_envs, dtype=bool)
-        )
-        return (last, original)
+    def _read_row(self, key: str, env_idx: np.ndarray, pos: np.ndarray) -> np.ndarray:
+        # full-env reads only (the checkpoint truncated-patch path): each device
+        # reads its own envs' rows through the sharded gather
+        if len(env_idx) != self._n_envs or not np.array_equal(env_idx, np.arange(self._n_envs)):
+            raise ValueError("sharded _read_row reads all envs at once")
+        out = self._sharded_gather_fn((key,), 1, 1, self._n_local)(
+            {key: self._buf[key]},
+            self._to_vec(pos.astype(np.int32)),
+            self._to_vec((env_idx % self._n_local).astype(np.int32)),
+        )[key]
+        return np.asarray(jax.device_get(out))[0, 0]  # [1, 1, n_envs, *feat] -> [n_envs, *feat]
 
-    def _unpatch_truncated(self, undo) -> None:
-        if undo is None:
-            return
-        last, original = undo
-        self._masked_write({"truncated": original[None]}, last, np.ones(self._n_envs, dtype=bool))
+    def load_state_dict(self, state: Dict[str, Any]) -> "ShardedDeviceSequentialReplayBuffer":
+        # parent logic re-layouts through _allocate/_write_fn, which here are the
+        # sharded implementations; the masked writer wants the dense path
+        if "buffer" not in state:
+            raise ValueError(
+                "This checkpoint's replay buffer was saved by the host backend; "
+                "resume with buffer.device=False (or drop buffer.checkpoint)"
+            )
+        host = state["buffer"]
+        if host is not None:
+            if isinstance(host, dict) and host and not isinstance(next(iter(host.values())), np.ndarray):
+                raise ValueError("Unrecognized device-buffer checkpoint payload")
+            if host:
+                self._meta = {}
+                self._buf = None
+                self._write_fns, self._gather_fns, self._view_fns = {}, {}, {}
+                logical = {k: self._narrow(np.asarray(v)) for k, v in host.items()}
+                self._check_ckpt_shape(logical)
+                self._allocate({k: v[:1] for k, v in logical.items()})
+                rows = next(iter(logical.values())).shape[0]
+                self._masked_write(
+                    logical, np.zeros(self._n_envs, dtype=np.int64), np.ones(self._n_envs, dtype=bool), rows
+                )
+        self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
+        self._full = np.asarray(state["full"], dtype=bool).copy()
+        return self
 
     # ----- sample path -----------------------------------------------------------------
-    def _sharded_gather_fn(self, seq_len: int, n_samples: int, b_local: int):
-        key = (seq_len, n_samples, b_local)
-        if key not in self._gather_fns:
+    def _sharded_gather_fn(self, keys_sig, seq_len: int, n_samples: int, b_local: int):
+        cache_key = (keys_sig, seq_len, n_samples, b_local)
+        if cache_key not in self._gather_fns:
             cap = self._buffer_size
+            metas = {key: self._meta[key] for key in keys_sig}
 
             def body(store_tree, starts, env_local):
                 # per-shard: starts/env_local [n_samples * b_local], g-major
                 row_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # [n, T]
 
-                def one(store):
-                    out = store[row_idx, env_local[:, None]]  # [n, T, *]
-                    out = out.reshape(n_samples, b_local, seq_len, *out.shape[2:])
-                    return jnp.swapaxes(out, 1, 2)  # [G, T, b_local, *]
+                def one(key, store):
+                    m = metas[key]
+                    if m.layout == "chunk":
+                        out = store[row_idx, env_local[:, None]]  # [n, T, C, 128]
+                        out = out.reshape(-1, seq_len, m.padded)[..., : m.flat]
+                    else:
+                        rowsel = env_local[:, None] * m.flat + jnp.arange(m.flat)[None, :]
+                        out = store[rowsel[:, None, :], row_idx[:, :, None]]  # [n, T, F]
+                    out = out.reshape(n_samples, b_local, seq_len, m.flat)
+                    out = jnp.swapaxes(out, 1, 2)  # [G, T, b_local, F]
+                    return out.reshape(n_samples, seq_len, b_local, *m.feat)
 
-                return jax.tree_util.tree_map(one, store_tree)
+                return {key: one(key, store_tree[key]) for key in store_tree}
 
+            out_rank = {key: 3 + len(metas[key].feat) for key in keys_sig}
             smapped = jax.shard_map(
                 body,
                 mesh=self._mesh,
-                in_specs=(self._storage_spec, P(self._axis), P(self._axis)),
-                out_specs=P(None, None, self._axis),
+                in_specs=(
+                    {key: self._storage_spec(key) for key in keys_sig},
+                    P(self._axis),
+                    P(self._axis),
+                ),
+                out_specs={
+                    key: P(None, None, self._axis, *([None] * (out_rank[key] - 3))) for key in keys_sig
+                },
                 check_vma=False,
             )
-            self._gather_fns[key] = jax.jit(smapped)
-        return self._gather_fns[key]
+            self._gather_fns[cache_key] = jax.jit(smapped)
+        return self._gather_fns[cache_key]
 
     def sample(
         self,
@@ -535,9 +758,9 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
             sl = slice(d * n_local, (d + 1) * n_local)
             starts[sl] = (anchor + offsets) % self._buffer_size
             env_local[sl] = le
-        out = self._sharded_gather_fn(int(sequence_length), int(n_samples), b_local)(
-            self._buf, self._to_vec(starts), self._to_vec(env_local)
-        )
+        out = self._sharded_gather_fn(
+            tuple(sorted(self._buf)), int(sequence_length), int(n_samples), b_local
+        )(self._buf, self._to_vec(starts), self._to_vec(env_local))
         return out
 
     sample_arrays = sample

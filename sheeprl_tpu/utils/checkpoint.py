@@ -57,6 +57,12 @@ class CheckpointCallback:
     def _sub_buffers(rb):
         # EnvIndependentReplayBuffer exposes its per-env sub-buffers via .buffer
         # (a tuple of ReplayBuffers); plain buffers are their own single sub-buffer.
+        # Device buffers are probed WITHOUT touching .buffer: their property
+        # materializes the whole logical storage on device (GBs per call).
+        from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+
+        if isinstance(rb, DeviceSequentialReplayBuffer):
+            return [rb]
         buf = getattr(rb, "buffer", None)
         if isinstance(buf, (list, tuple)) and all(hasattr(b, "_patch_truncated") for b in buf):
             return list(buf)

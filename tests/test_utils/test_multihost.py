@@ -67,3 +67,90 @@ def test_two_process_distributed(tmp_path):
     assert by_pid[0]["ckpt_exists"] and by_pid[1]["ckpt_exists"]
     ckpts = [f for f in os.listdir(tmp_path) if f.startswith("ckpt_")]
     assert len(ckpts) == 1
+
+
+def _run_children(port, nproc, tmp_path, mode, extra_args=None, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _CHILD, str(port), str(pid), str(nproc), str(tmp_path), mode]
+            + (extra_args[pid] if extra_args else []),
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+        )
+        for pid in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"child failed:\n--- stdout ---\n{out}\n--- stderr ---\n{err}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    return {o["pid"]: o for o in outs}
+
+
+@pytest.mark.timeout(120)
+def test_coordinator_absent_times_out_fast(tmp_path):
+    """No coordinator listening: the process must fail within the configured
+    multihost_timeout_s instead of hanging for jax's 300 s default.
+
+    jax's coordination client aborts the process fatally (absl F-log) on a
+    registration deadline rather than raising a catchable exception, so 'fast,
+    loud death' IS the detectable failure mode; Runtime's multihost_timeout_s
+    is what bounds it."""
+    import time
+
+    port = _free_port()  # nobody binds it: process_id=1 waits for a coordinator that never comes
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    t0 = time.monotonic()
+    p = subprocess.Popen(
+        [sys.executable, _CHILD, str(port), "1", "2", str(tmp_path), "timeout"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    out, err = p.communicate(timeout=90)
+    elapsed = time.monotonic() - t0
+    if p.returncode == 0:  # future jax: initialize raises cleanly and Runtime wraps it
+        res = json.loads(out.strip().splitlines()[-1])
+        assert res["raised"], "Runtime must raise when the coordinator is absent"
+        assert "multihost" in res["msg"]
+    else:
+        assert "DEADLINE_EXCEEDED" in err or "Deadline Exceeded" in err, f"unexpected failure:\n{err}"
+    assert elapsed < 60, f"coordinator-absent boot took {elapsed:.0f}s — timeout not applied"
+
+
+@pytest.mark.timeout(300)
+def test_mismatched_device_counts_rejected(tmp_path):
+    """Processes with different local device counts must fail fast with a clear
+    error (DP meshes need equal per-rank shards), not die later in sharding."""
+    by_pid = _run_children(
+        _free_port(), 2, tmp_path, "mismatch", extra_args={0: ["2"], 1: ["4"]}
+    )
+    for pid in (0, 1):
+        assert by_pid[pid]["raised"], f"process {pid} accepted a heterogeneous pod"
+        assert "Heterogeneous local device counts" in by_pid[pid]["msg"]
+
+
+@pytest.mark.timeout(300)
+def test_resume_under_multihost(tmp_path):
+    """Write-once checkpoint -> every process reloads identical state, and the
+    resumed run's log dir version-bumps consistently on all processes."""
+    by_pid = _run_children(_free_port(), 2, tmp_path, "resume")
+    for pid in (0, 1):
+        assert by_pid[pid]["iter_num"] == 123
+        np.testing.assert_array_equal(
+            np.asarray(by_pid[pid]["loaded"]), np.asarray(by_pid[pid]["expected"])
+        )
+        assert "version_0" in by_pid[pid]["log_dir_1"]
+        assert "version_1" in by_pid[pid]["log_dir_2"]
+    assert by_pid[0]["log_dir_2"] == by_pid[1]["log_dir_2"]
