@@ -1,0 +1,122 @@
+"""DDP vs FSDP DV3 train-step comparison on the 8-device virtual CPU mesh.
+
+One real chip is available in this environment, so the absolute times are CPU
+numbers; what this measures is the RELATIVE overhead the FSDP placement adds
+(XLA-inserted weight all-gathers) and the per-device param-memory win — the
+quantities that carry to a real multi-chip mesh.
+
+Usage: python scripts/fsdp_bench.py [--preset S|M] [--iters 5]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split() if "host_platform_device_count" not in f]
+flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import gymnasium as gym  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="S", choices=("S", "M"))
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_fn
+    from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+    from sheeprl_tpu.config.loader import load_config
+    from sheeprl_tpu.core.runtime import Runtime
+
+    cfg = load_config(
+        overrides=[
+            "exp=dreamer_v3",
+            f"algo=dreamer_v3_{args.preset}",
+            "env=dummy",
+            "fabric.precision=32-true",
+            "algo.per_rank_batch_size=16",
+            "algo.per_rank_sequence_length=8",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (6,)
+    rng = np.random.default_rng(0)
+    g, t, b, a = 1, 8, 16, 6
+    batches = {
+        "rgb": rng.integers(0, 255, (g, t, b, 3, 64, 64), dtype=np.uint8),
+        "actions": rng.random((g, t, b, a), dtype=np.float32),
+        "rewards": rng.random((g, t, b, 1), dtype=np.float32),
+        "terminated": np.zeros((g, t, b, 1), dtype=np.float32),
+        "truncated": np.zeros((g, t, b, 1), dtype=np.float32),
+        "is_first": np.zeros((g, t, b, 1), dtype=np.float32),
+    }
+    key = jax.random.PRNGKey(0)
+
+    result = {"preset": args.preset, "devices": jax.device_count()}
+    for strategy in ("auto", "fsdp"):
+        runtime = Runtime(accelerator="cpu", devices=8, strategy=strategy, precision="32-true")
+        modules, params, _ = build_agent(runtime, actions_dim, False, cfg, obs_space)
+        init_opt, train_fn = make_train_fn(modules, cfg, runtime, False, actions_dim)
+        opt_states = runtime.place_params(init_opt(params))
+        params = runtime.place_params(params)
+        moments = init_moments()
+        batch_sh = NamedSharding(runtime.mesh, P(None, None, "data"))
+        dev_batches = {k: jax.device_put(jnp.asarray(v), batch_sh) for k, v in batches.items()}
+
+        # per-device bytes actually held for params+opt (the FSDP memory win)
+        def dev0_bytes(tree):
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                if hasattr(leaf, "addressable_shards"):
+                    for sh in leaf.addressable_shards:
+                        if sh.device == jax.devices()[0]:
+                            total += sh.data.nbytes
+            return total
+
+        dev0_mb = round(dev0_bytes((params, opt_states)) / 1e6, 2)  # before donation
+        counter = jnp.int32(0)
+        # train_fn donates params/opt/moments: continue from the warmup outputs
+        p, o, m, c, _metrics = train_fn(params, opt_states, moments, counter, dev_batches, key)
+        jax.block_until_ready(p)  # compile + first step
+        t0 = time.perf_counter()
+        for _ in range(args.iters):
+            p, o, m, c, _metrics = train_fn(p, o, m, c, dev_batches, key)
+        jax.block_until_ready(p)
+        dt = (time.perf_counter() - t0) / args.iters
+        result[f"{strategy}_step_ms"] = round(dt * 1000, 1)
+        result[f"{strategy}_dev0_param_opt_mb"] = dev0_mb
+
+    result["fsdp_vs_ddp_time"] = round(result["fsdp_step_ms"] / result["auto_step_ms"], 3)
+    result["fsdp_vs_ddp_mem"] = round(
+        result["fsdp_dev0_param_opt_mb"] / result["auto_dev0_param_opt_mb"], 3
+    )
+    return result
+
+
+if __name__ == "__main__":
+    # agent-build banners etc. go to stderr; stdout carries exactly one JSON line
+    with contextlib.redirect_stdout(sys.stderr):
+        result = main()
+    print(json.dumps(result))

@@ -62,6 +62,36 @@ def seed_everything(seed: int) -> int:
     return seed
 
 
+def _fsdp_partition_spec(name: str, shape: Sequence[int], n: int) -> P:
+    """Explicit FSDP spec for one leaf (see Runtime.shard_model_params's table).
+
+    ``name`` is the lowercase tree path (flax module / optax state path), so the
+    rules key on the flax conventions: ``kernel`` for dense/conv weights (output
+    features/channels last), ``bias``/``scale`` for the small vectors.
+    """
+    if not shape:
+        return P()
+    last = len(shape) - 1
+    if "kernel" in name and len(shape) >= 2:
+        if shape[last] % n == 0 and shape[last] >= n:
+            spec = [None] * len(shape)
+            spec[last] = "data"
+            return P(*spec)
+        # indivisible output dim (e.g. small action/value heads): replicate rather
+        # than fall through to a contraction-dim shard, which would trade the tiny
+        # memory win for a per-layer activation all-gather
+        return P()
+    if "bias" in name or "scale" in name:
+        return P()
+    divisible = [(d, s) for d, s in enumerate(shape) if s % n == 0 and s >= n]
+    if not divisible:
+        return P()
+    dim = max(divisible, key=lambda t: t[1])[0]
+    spec = [None] * len(shape)
+    spec[dim] = "data"
+    return P(*spec)
+
+
 @dataclass
 class Runtime:
     """Accelerator + distributed context handed to every algorithm entrypoint."""
@@ -176,9 +206,11 @@ class Runtime:
 
     @property
     def host_device(self):
-        """The host CPU backend device (jax_platforms always includes cpu)."""
+        """THIS process's host CPU backend device (jax_platforms always includes
+        cpu; in a multi-process world ``jax.devices`` leads with process 0's
+        devices, which are non-addressable here)."""
         try:
-            return jax.devices("cpu")[0]
+            return jax.local_devices(backend="cpu")[0]
         except RuntimeError:  # pragma: no cover - cpu backend always exists
             return self._devices[0]
 
@@ -197,9 +229,33 @@ class Runtime:
         return self.host_device
 
     def to_player(self, tree):
-        """Move a pytree to the player device (committed), e.g. post-update params."""
+        """Move a pytree to the player device (committed), e.g. post-update params.
+
+        Values replicated over a cross-process mesh are not fully addressable;
+        this process's own replica is read first, making the put a local D2D
+        transfer (the cross-host decoupled parameter-refresh path). When the
+        player chip belongs to ANOTHER process, the put lands on this process's
+        host device instead — only the player process drives envs, so the
+        shadow copy is inert, but agent construction stays symmetric across
+        the world (every process calls build_agent).
+        """
         dev = self.player_device
-        return jax.tree_util.tree_map(lambda x: jax.device_put(x, dev), tree)
+        if getattr(dev, "process_index", jax.process_index()) != jax.process_index():
+            dev = self.host_device
+
+        def put(x):
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                if not x.sharding.is_fully_replicated:
+                    # addressable_data(0) would be ONE shard, silently truncating
+                    # the leaf (cross-process FSDP params have no local full copy)
+                    raise ValueError(
+                        "Cannot ship cross-process SHARDED params to the player; "
+                        "keep the player copy replicated (DDP placement) or gather first"
+                    )
+                x = x.addressable_data(0)
+            return jax.device_put(x, dev)
+
+        return jax.tree_util.tree_map(put, tree)
 
     # ----- sharding ------------------------------------------------------------------
     @property
@@ -222,29 +278,40 @@ class Runtime:
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), tree)
 
     def shard_model_params(self, tree):
-        """FSDP-style placement: each array leaf is sharded over the ``data`` axis
-        on its largest divisible dimension; indivisible/scalar leaves replicate.
+        """FSDP-style placement over the ``data`` axis, by explicit per-leaf rules.
 
         With the batch sharded on the same axis, XLA's SPMD partitioner inserts
         the all-gathers (forward/backward) and keeps the optimizer update fully
         sharded — the in-graph equivalent of the reference's sharded-DDP/FSDP
         Fabric strategies, and the standard JAX recipe for fitting models larger
         than one chip's HBM. Optimizer state placed with the same function gets
-        identical shardings (same tree shapes).
+        identical shardings (optax state trees embed the param-tree paths).
+
+        Partition-spec table (leaf path -> spec; W = data-axis size):
+
+        | leaf                                             | spec            |
+        |--------------------------------------------------|-----------------|
+        | ``*kernel`` ``[in, out]`` dense (incl. the GRU   | shard ``out``   |
+        |   gate kernels) and ``[.., cin, cout]`` convs    | (last dim)      |
+        | ``*bias`` / ``*scale`` (LayerNorm) / scalars     | replicate       |
+        | anything else with a W-divisible dim             | largest such dim|
+        | indivisible leaves                               | replicate       |
+
+        Sharding a kernel's OUTPUT dim keeps every contraction local: the
+        forward all-gathers weights (ZeRO-3 style) instead of activations, and
+        the previous largest-divisible-dim heuristic could pick a contraction
+        dim and force a per-layer activation all-gather instead.
         """
         n = int(self.mesh.shape["data"])
 
-        def place(x):
+        def place(path, x):
             x = jnp.asarray(x) if not hasattr(x, "shape") else x
-            divisible = [(d, s) for d, s in enumerate(getattr(x, "shape", ())) if s % n == 0 and s >= n]
-            if x.ndim == 0 or not divisible:
-                return jax.device_put(x, self.replicated)
-            dim = max(divisible, key=lambda t: t[1])[0]
-            spec = [None] * x.ndim
-            spec[dim] = "data"
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+            name = jax.tree_util.keystr(path).lower()
+            shape = tuple(getattr(x, "shape", ()))
+            spec = _fsdp_partition_spec(name, shape, n)
+            return jax.device_put(x, NamedSharding(self.mesh, spec))
 
-        return jax.tree_util.tree_map(place, tree)
+        return jax.tree_util.tree_map_with_path(place, tree)
 
     def place_params(self, tree):
         """Param/opt-state placement per ``fabric.strategy``: ``fsdp`` shards over

@@ -171,20 +171,31 @@ class DeviceSequentialReplayBuffer:
             return (self._buffer_size, self._n_envs, m.padded // 128, 128)
         return (self._n_envs * m.flat, self._buffer_size)
 
-    def _logical_view(self, key: str) -> jax.Array:
-        """Jitted physical -> logical [cap, n_envs, *feat] reconstruction."""
+    def _view_closure(self, key: str):
+        """Physical -> logical [cap, n_envs, *feat] reconstruction; pure reshape/
+        slice/transpose math, valid on device (jit) and host (numpy) alike."""
         m = self._meta[key]
+        cap, envs = self._buffer_size, self._n_envs
+
+        def view(store):
+            if m.layout == "chunk":
+                out = store.reshape(cap, envs, m.padded)[..., : m.flat]
+            else:
+                out = store.reshape(envs, m.flat, cap).transpose(2, 0, 1)
+            return out.reshape(cap, envs, *m.feat)
+
+        return view
+
+    def _logical_view(self, key: str) -> jax.Array:
         if key not in self._view_fns:
-
-            def view(store):
-                if m.layout == "chunk":
-                    out = store.reshape(self._buffer_size, self._n_envs, m.padded)[..., : m.flat]
-                else:
-                    out = store.reshape(self._n_envs, m.flat, self._buffer_size).transpose(2, 0, 1)
-                return out.reshape(self._buffer_size, self._n_envs, *m.feat)
-
-            self._view_fns[key] = jax.jit(view)
+            self._view_fns[key] = jax.jit(self._view_closure(key))
         return self._view_fns[key](self._buf[key])
+
+    def _logical_to_host(self, key: str) -> np.ndarray:
+        """Checkpoint path: de-layout HOST-side so no second logical-size HBM
+        allocation forms next to the physical storage (the jitted view would
+        transiently double the buffer's footprint on device)."""
+        return np.ascontiguousarray(self._view_closure(key)(np.asarray(jax.device_get(self._buf[key]))))
 
     # ----- write path ------------------------------------------------------------------
     def _put(self, v: np.ndarray) -> jax.Array:
@@ -395,11 +406,7 @@ class DeviceSequentialReplayBuffer:
             )
 
     def state_dict(self) -> Dict[str, Any]:
-        host = (
-            {k: np.asarray(jax.device_get(self._logical_view(k))) for k in self._buf}
-            if self._buf is not None
-            else None
-        )
+        host = {k: self._logical_to_host(k) for k in self._buf} if self._buf is not None else None
         return {"buffer": host, "pos": self._pos.copy(), "full": self._full.copy()}
 
     def load_state_dict(self, state: Dict[str, Any]) -> "DeviceSequentialReplayBuffer":
@@ -508,18 +515,9 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
         self._buf = buf
 
     def _logical_view(self, key: str) -> jax.Array:
-        m = self._meta[key]
         if key not in self._view_fns:
-
-            def view(store):
-                if m.layout == "chunk":
-                    out = store.reshape(self._buffer_size, self._n_envs, m.padded)[..., : m.flat]
-                else:
-                    out = store.reshape(self._n_envs, m.flat, self._buffer_size).transpose(2, 0, 1)
-                return out.reshape(self._buffer_size, self._n_envs, *m.feat)
-
             self._view_fns[key] = jax.jit(
-                view, out_shardings=NamedSharding(self._mesh, P(None, self._axis))
+                self._view_closure(key), out_shardings=NamedSharding(self._mesh, P(None, self._axis))
             )
         return self._view_fns[key](self._buf[key])
 

@@ -5,4 +5,8 @@
   sheeprl/algos/ppo/ppo_decoupled.py:623-670).
 """
 
-from sheeprl_tpu.parallel.decoupled import split_runtime  # noqa: F401
+from sheeprl_tpu.parallel.decoupled import (  # noqa: F401
+    CrossHostTransport,
+    split_runtime,
+    split_runtime_crosshost,
+)

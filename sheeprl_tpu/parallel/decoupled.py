@@ -22,10 +22,11 @@ DEVICE split rather than a process split:
 from __future__ import annotations
 
 import copy
-from typing import Any, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
+import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from sheeprl_tpu.core.runtime import Runtime
 
@@ -46,6 +47,8 @@ def split_runtime(runtime: Runtime) -> Tuple[Runtime, Runtime]:
     Mirrors the reference's role split (player = rank 0, trainers = the
     ``optimization_pg`` sub-group, ppo_decoupled.py:654-666). Requires >= 2
     devices — the same constraint the reference enforces in ``check_configs``.
+    Single-controller only; multi-process worlds go through
+    :func:`split_runtime_crosshost`.
     """
     devices = list(runtime._devices)
     if len(devices) < 2:
@@ -59,3 +62,90 @@ def split_runtime(runtime: Runtime) -> Tuple[Runtime, Runtime]:
     player_rt.player_on_host = False
     trainer_rt.player_on_host = False
     return player_rt, trainer_rt
+
+
+class CrossHostTransport:
+    """Player-process <-> trainer-mesh bridge for multi-process decoupled runs.
+
+    The reference joins its player and trainer PROCESSES with torch.distributed
+    object pipes (``scatter_object_list`` for rollout chunks, a flattened-vector
+    NCCL broadcast for the parameter refresh,
+    /root/reference/sheeprl/algos/ppo/ppo_decoupled.py:294-310,550-554). The
+    JAX multi-controller equivalents:
+
+    - rollout out: ``broadcast_one_to_all`` moves the player process's host
+      rollout to every process through ONE device collective over ICI/DCN (no
+      host-side object pickling pipes), then each process places it replicated
+      on the trainer mesh with plain local ``device_put``s — the trainer step's
+      in-graph minibatch sharding constraint does the actual split, so the
+      "scatter" rides the same XLA partitioner as everything else;
+    - params back: trainer-step outputs are replicated over the trainer mesh,
+      so the player process already holds an addressable replica — the refresh
+      is a LOCAL device-to-device put onto the player chip, replacing the
+      reference's cross-process broadcast entirely.
+    """
+
+    def __init__(self, trainer_mesh: Mesh, player_device: Any):
+        self.trainer_mesh = trainer_mesh
+        self.player_device = player_device
+        self.is_player_process = jax.process_index() == 0
+
+    def rollout_to_trainers(self, host_tree: Any) -> Any:
+        """Player process's host rollout -> replicated on the trainer mesh.
+
+        Every process must call this each round (it contains a collective); on
+        non-player processes ``host_tree`` is only a shape/dtype template.
+        """
+        from jax.experimental import multihost_utils
+
+        synced = multihost_utils.broadcast_one_to_all(host_tree)
+        return multihost_utils.host_local_array_to_global_array(synced, self.trainer_mesh, P())
+
+    def params_to_player(self, params: Any) -> Optional[Any]:
+        """Trainer-mesh-replicated params -> the player chip (player process only).
+
+        A local D2D transfer of the replica this process already owns; other
+        processes get ``None`` (they hold no player).
+        """
+        if not self.is_player_process:
+            return None
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(a.addressable_data(0), self.player_device), params
+        )
+
+    def pull_replicated(self, tree: Any) -> Any:
+        """Host copy of trainer-mesh-replicated values (metrics, checkpoints):
+        reads this process's own replica, no collective."""
+        return jax.tree_util.tree_map(lambda a: np.asarray(a.addressable_data(0)), tree)
+
+
+def split_runtime_crosshost(runtime: Runtime) -> Tuple[Runtime, Runtime, CrossHostTransport]:
+    """(player_rt, trainer_rt, transport) across a multi-process world.
+
+    Role split over the GLOBAL device set: global device 0 (owned by process 0,
+    the player process) acts; every other device — including the player
+    process's remaining local chips — trains. The reference's equivalent is
+    rank 0 playing while ranks 1..N-1 form the DDP ``optimization_pg``
+    (ppo_decoupled.py:645-666); here the trainer "group" is a cross-process
+    mesh and the pipes are :class:`CrossHostTransport`.
+
+    Every process must execute the trainer step (it spans the trainer mesh);
+    only ``transport.is_player_process`` steps envs / runs the player.
+    """
+    if jax.process_count() < 2:
+        raise RuntimeError(
+            "split_runtime_crosshost needs a multi-process world "
+            "(fabric.multihost=True under a multi-host launcher); "
+            "single-controller runs use split_runtime"
+        )
+    global_devices = sorted(jax.devices(), key=lambda d: d.id)
+    if len(global_devices) < 2:
+        raise RuntimeError(
+            f"The decoupled actor-learner split requires at least 2 devices, got {len(global_devices)}"
+        )
+    player_rt = _sub_runtime(runtime, global_devices[:1])
+    trainer_rt = _sub_runtime(runtime, global_devices[1:])
+    player_rt.player_on_host = False
+    trainer_rt.player_on_host = False
+    transport = CrossHostTransport(trainer_rt.mesh, global_devices[0])
+    return player_rt, trainer_rt, transport

@@ -59,6 +59,35 @@ def test_shard_model_params_layout():
     assert placed["big"].addressable_shards[0].data.shape == (8, 32)
 
 
+def test_fsdp_explicit_kernel_specs():
+    """Kernels shard their OUTPUT dim (contractions stay local); LayerNorm
+    scale/bias replicate even when divisible; optax state paths inherit the
+    same rules (the mu/nu trees embed the param names)."""
+    runtime = Runtime(accelerator="cpu", devices=8, strategy="fsdp")
+    tree = {
+        "recurrent_model": {"gates": {"kernel": jnp.zeros((1040, 1536)), "bias": jnp.zeros((1536,))}},
+        "enc": {"LayerNorm_0": {"scale": jnp.zeros((512,)), "bias": jnp.zeros((512,))}},
+        "conv": {"kernel": jnp.zeros((4, 4, 48, 96))},
+        # contraction dim (0) is the largest divisible dim, but the kernel rule
+        # must still pick the OUTPUT dim (1)
+        "skewed": {"kernel": jnp.zeros((4096, 8))},
+    }
+    placed = runtime.place_params(tree)
+    assert tuple(placed["recurrent_model"]["gates"]["kernel"].sharding.spec) == (None, "data")
+    assert all(a is None for a in placed["recurrent_model"]["gates"]["bias"].sharding.spec)
+    assert all(a is None for a in placed["enc"]["LayerNorm_0"]["scale"].sharding.spec)
+    assert tuple(placed["conv"]["kernel"].sharding.spec) == (None, None, None, "data")
+    assert tuple(placed["skewed"]["kernel"].sharding.spec) == (None, "data")
+    # optax-style nesting still sees the param path
+    import optax
+
+    tx = optax.adam(1e-3)
+    opt_state = tx.init({"dense": {"kernel": jnp.zeros((256, 512))}})
+    placed_opt = runtime.place_params(opt_state)
+    mu = placed_opt[0].mu["dense"]["kernel"]
+    assert tuple(mu.sharding.spec) == (None, "data")
+
+
 def test_fsdp_train_step_matches_ddp():
     from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
     from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_fn

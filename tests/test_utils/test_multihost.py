@@ -69,12 +69,13 @@ def test_two_process_distributed(tmp_path):
     assert len(ckpts) == 1
 
 
-def _run_children(port, nproc, tmp_path, mode, extra_args=None, timeout=240):
+def _run_children(port, nproc, tmp_path, mode=None, extra_args=None, timeout=240, child=_CHILD):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, _CHILD, str(port), str(pid), str(nproc), str(tmp_path), mode]
+            [sys.executable, child, str(port), str(pid), str(nproc), str(tmp_path)]
+            + ([mode] if mode else [])
             + (extra_args[pid] if extra_args else []),
             env=env,
             stdout=subprocess.PIPE,
@@ -139,6 +140,22 @@ def test_mismatched_device_counts_rejected(tmp_path):
     for pid in (0, 1):
         assert by_pid[pid]["raised"], f"process {pid} accepted a heterogeneous pod"
         assert "Heterogeneous local device counts" in by_pid[pid]["msg"]
+
+
+@pytest.mark.timeout(600)
+def test_crosshost_decoupled_ppo_step(tmp_path):
+    """A full decoupled PPO round across 2 processes: global device 0 plays,
+    the other 3 devices form the cross-process trainer mesh. Asserts the real
+    jitted PPO optimization ran (params changed), stayed bit-identical across
+    processes (the XLA allreduce), and the player refresh matches exactly."""
+    child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_child.py")
+    by_pid = _run_children(_free_port(), 2, tmp_path, timeout=540, child=child)
+    for pid in (0, 1):
+        assert by_pid[pid]["changed"], "optimization must actually update params"
+        assert by_pid[pid]["player_matches"]
+    assert by_pid[0]["head"] == by_pid[1]["head"], "post-update params must agree bit-for-bit"
+    assert by_pid[0]["digest"] == by_pid[1]["digest"]
+    assert "id=0" in by_pid[0]["player_device"]  # refresh landed on the player chip
 
 
 @pytest.mark.timeout(300)

@@ -49,14 +49,14 @@ def test_player_device_selection(monkeypatch):
     # the assertions tautological; pretend the host CPU is a DIFFERENT device so
     # the player_on_host branch is actually discriminated.
     fake_host = jax.devices("cpu")[1]
-    real_devices = jax.devices
+    real_local_devices = jax.local_devices
 
-    def fake_devices(platform=None):
-        if platform == "cpu":
+    def fake_local_devices(process_index=None, backend=None, host_id=None):
+        if backend == "cpu":
             return [fake_host]
-        return real_devices(platform)
+        return real_local_devices(process_index=process_index, backend=backend, host_id=host_id)
 
-    monkeypatch.setattr(jax, "devices", fake_devices)
+    monkeypatch.setattr(jax, "local_devices", fake_local_devices)
     assert on_host.player_device == fake_host
     assert on_host.player_device != on_host.device
     assert on_mesh.player_device == on_mesh.device
