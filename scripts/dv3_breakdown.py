@@ -1,0 +1,139 @@
+"""Component-level timing of the DV3-S train step at the bench shape.
+
+Times each phase as its own jit (fusion across phases is lost, so the parts sum
+to more than the fused step — the point is the RATIO between parts).
+Usage: python scripts/dv3_breakdown.py [batch] [seq]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_fn
+from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
+from sheeprl_tpu.config.loader import load_config
+from sheeprl_tpu.core.runtime import Runtime
+
+
+def _fence(out):
+    # tunnel-safe fence: reduce ON DEVICE, pull one scalar (block_until_ready
+    # returns early on the tunnel; np.asarray of the full leaf would pull GBs)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(jax.device_get(leaf.ravel()[0]))
+
+
+def timeit(label, fn, *args, iters=10):
+    out = fn(*args)
+    _fence(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _fence(out)
+    dt = (time.perf_counter() - t0) / iters * 1000
+    print(f"{label:>28}: {dt:8.1f} ms")
+    return dt
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    seq = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    cfg = load_config(
+        overrides=[
+            "exp=dreamer_v3",
+            "algo=dreamer_v3_S",
+            "env=dummy",
+            "fabric.precision=bf16-mixed",
+            f"algo.per_rank_batch_size={batch}",
+            f"algo.per_rank_sequence_length={seq}",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.cnn_keys.decoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "algo.mlp_keys.decoder=[]",
+        ]
+    )
+    runtime = Runtime(accelerator="auto", devices=1, precision=cfg.fabric.precision)
+    obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})
+    actions_dim = (6,)
+    modules, params, _ = build_agent(runtime, actions_dim, False, cfg, obs_space)
+    rssm = modules.rssm
+
+    rng = np.random.default_rng(0)
+    T, B, A = seq, batch, 6
+    obs = jax.device_put((rng.random((T, B, 3, 64, 64), np.float32) - 0.5).astype(np.float32))
+    actions = jax.device_put(rng.random((T, B, A), np.float32).astype(np.float32))
+    is_first = jax.device_put(np.zeros((T, B, 1), np.float32))
+    key = jax.random.PRNGKey(0)
+    wm = params["world_model"]
+
+    enc = jax.jit(lambda p, o: modules.encoder.apply(p["encoder"], {"rgb": o}))
+    embedded = enc(wm, obs)
+    t_enc = timeit("encoder fwd", enc, wm, obs)
+
+    dyn = jax.jit(lambda p, e, a, f, k: rssm.dynamic_scan(p, e, a, f, k))
+    rs, post, pl, ql = dyn(wm, embedded, actions, is_first, key)
+    t_dyn = timeit("dynamic_scan fwd (T=64)", dyn, wm, embedded, actions, is_first, key)
+
+    latents = jnp.concatenate([post.reshape(*post.shape[:-2], -1), rs], axis=-1)
+    dec = jax.jit(lambda p, z: modules.observation_model.apply(p["observation_model"], z))
+    t_dec = timeit("decoder fwd", dec, wm, latents)
+
+    heads = jax.jit(
+        lambda p, z: (
+            modules.reward_model.apply(p["reward_model"], z),
+            modules.continue_model.apply(p["continue_model"], z),
+        )
+    )
+    t_heads = timeit("reward+continue heads fwd", heads, wm, latents)
+
+    # imagination: H steps over TB rows
+    start_prior = post.reshape(1, -1, rssm.stoch_state_size)[0]
+    start_rec = rs.reshape(1, -1, rs.shape[-1])[0]
+    H = int(cfg.algo.horizon)
+
+    def imagine(p, ap, sp, sr, k):
+        def step(carry, kk):
+            pf, rec = carry
+            k1, k2 = jax.random.split(kk)
+            prior, rec = rssm.imagination_step(p, pf, rec, jnp.zeros((sp.shape[0], A), jnp.float32), k1)
+            return (prior.reshape(pf.shape), rec), prior
+
+        return jax.lax.scan(step, (sp, sr), jax.random.split(k, H))[1]
+
+    t_img = timeit("imagination scan (H fwd)", jax.jit(imagine), wm, params["actor"], start_prior, start_rec, key)
+
+    # full fused train step
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, False, actions_dim)
+    opt_states = runtime.replicate(init_opt(params))
+    pr = runtime.replicate(params)
+    moments = init_moments()
+    batches = {
+        "rgb": jax.device_put(rng.integers(0, 255, (1, T, B, 3, 64, 64), dtype=np.uint8)),
+        "actions": jax.device_put(rng.random((1, T, B, A), dtype=np.float32)),
+        "rewards": jax.device_put(rng.random((1, T, B, 1), dtype=np.float32)),
+        "terminated": jax.device_put(np.zeros((1, T, B, 1), dtype=np.float32)),
+        "truncated": jax.device_put(np.zeros((1, T, B, 1), dtype=np.float32)),
+        "is_first": jax.device_put(np.zeros((1, T, B, 1), dtype=np.float32)),
+    }
+
+    state = [pr, opt_states, moments, np.int32(0)]
+
+    def full(batches, key):
+        state[0], state[1], state[2], state[3], m = train_fn(state[0], state[1], state[2], state[3], batches, key)
+        return m
+
+    t_full = timeit("FULL fused train step", full, batches, key, iters=10)
+    fwd_sum = t_enc + t_dyn + t_dec + t_heads + t_img
+    print(f"{'sum of fwd parts':>28}: {fwd_sum:8.1f} ms (full step / fwd-sum = {t_full / fwd_sum:.2f}x)")
+
+
+if __name__ == "__main__":
+    main()

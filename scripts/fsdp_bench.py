@@ -46,18 +46,33 @@ def main() -> None:
     from sheeprl_tpu.config.loader import load_config
     from sheeprl_tpu.core.runtime import Runtime
 
+    # sizes: big enough that kernels shard meaningfully over 8 devices, small
+    # enough that the two CPU-mesh compiles stay in minutes on a 1-core host
+    size_overrides = {
+        "S": [
+            "algo.dense_units=256",
+            "algo.mlp_layers=2",
+            "algo.world_model.encoder.cnn_channels_multiplier=16",
+            "algo.world_model.recurrent_model.recurrent_state_size=512",
+            "algo.world_model.transition_model.hidden_size=256",
+            "algo.world_model.representation_model.hidden_size=256",
+        ],
+        "M": [],  # the real M preset, multi-core hosts only
+    }[args.preset]
     cfg = load_config(
         overrides=[
             "exp=dreamer_v3",
-            f"algo=dreamer_v3_{args.preset}",
+            "algo=dreamer_v3_S" if args.preset == "S" else "algo=dreamer_v3_M",
             "env=dummy",
             "fabric.precision=32-true",
             "algo.per_rank_batch_size=16",
             "algo.per_rank_sequence_length=8",
+            "algo.horizon=8",
             "algo.cnn_keys.encoder=[rgb]",
             "algo.cnn_keys.decoder=[rgb]",
             "algo.mlp_keys.encoder=[]",
             "algo.mlp_keys.decoder=[]",
+            *size_overrides,
         ]
     )
     obs_space = gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8)})

@@ -212,14 +212,51 @@ class DeviceSequentialReplayBuffer:
             )()
         self._buf = buf
 
+    def _phys_block_shape(self, key: str, rows: int, k: int) -> Tuple[int, ...]:
+        m = self._meta[key]
+        if m.layout == "chunk":
+            return (rows, k, m.padded // 128, 128)
+        return (k, m.flat, rows)
+
+    def _pack(self, data: Dict[str, np.ndarray], pos: np.ndarray, env_idx: np.ndarray) -> np.ndarray:
+        """Serialize one write (indices + every leaf's physical block) into a single
+        byte buffer: remote/tunneled transports charge a fixed O(10ms) cost per
+        device_put, so the 8-put add becomes ONE transfer, unpacked in-graph."""
+        parts = [pos.astype("<i4").tobytes(), env_idx.astype("<i4").tobytes()]
+        for key in sorted(data):
+            parts.append(np.ascontiguousarray(self._to_physical(key, self._narrow(np.asarray(data[key])))).tobytes())
+        return np.frombuffer(b"".join(parts), np.uint8)
+
     def _write_fn(self, rows: int, k: int, keys_sig):
-        """Donated writer: physical blocks land at per-env head positions."""
+        """Donated writer: ONE packed uint8 buffer in, blocks land at per-env heads."""
         cache_key = (rows, k, keys_sig)
         if cache_key not in self._write_fns:
             cap = self._buffer_size
             metas = {key: self._meta[key] for key in keys_sig}
+            shapes = {key: self._phys_block_shape(key, rows, k) for key in keys_sig}
 
-            def write(buf, blocks, pos, env_idx):
+            def write(buf, packed):
+                off = 0
+
+                def take(nbytes):
+                    nonlocal off
+                    seg = jax.lax.slice(packed, (off,), (off + nbytes,))
+                    off += nbytes
+                    return seg
+
+                def decode(nelem, dtype, shape):
+                    it = np.dtype(dtype).itemsize
+                    raw = take(nelem * it)
+                    if it == 1:
+                        return jax.lax.bitcast_convert_type(raw, dtype).reshape(shape)
+                    return jax.lax.bitcast_convert_type(raw.reshape(-1, it), dtype).reshape(shape)
+
+                pos = decode(k, jnp.int32, (k,))
+                env_idx = decode(k, jnp.int32, (k,))
+                blocks = {
+                    key: decode(int(np.prod(shapes[key])), metas[key].dtype, shapes[key])
+                    for key in keys_sig
+                }
                 row_idx = (pos[None, :] + jnp.arange(rows)[:, None]) % cap  # [rows, k]
 
                 def one(key, store, new):
@@ -259,13 +296,9 @@ class DeviceSequentialReplayBuffer:
             if indices is None
             else np.asarray(list(indices), dtype=np.int64)
         )
-        blocks = {k: self._put(self._to_physical(k, self._narrow(np.asarray(v)))) for k, v in data.items()}
         pos = self._pos[env_idx]
         self._buf = self._write_fn(rows, len(env_idx), tuple(sorted(data)))(
-            self._buf,
-            blocks,
-            self._put(pos.astype(np.int32)),
-            self._put(env_idx.astype(np.int32)),
+            self._buf, self._put(self._pack(data, pos, env_idx))
         )
         new_pos = pos + rows
         self._full[env_idx] |= new_pos >= self._buffer_size
@@ -275,16 +308,16 @@ class DeviceSequentialReplayBuffer:
         """Overwrite one row of the given envs with host values ``[k, *feat]``."""
         keys_sig = tuple(sorted(values))
         sub = {k: self._buf[k] for k in keys_sig}
-        blocks = {k: self._put(self._to_physical(k, self._narrow(np.asarray(v))[None])) for k, v in values.items()}
+        rows_data = {k: np.asarray(v)[None] for k, v in values.items()}
         out = self._write_fn(1, len(env_idx), keys_sig)(
-            sub, blocks, self._put(pos.astype(np.int32)), self._put(env_idx.astype(np.int32))
+            sub, self._put(self._pack(rows_data, pos, env_idx))
         )
         self._buf.update(out)
 
     def _read_row(self, key: str, env_idx: np.ndarray, pos: np.ndarray) -> np.ndarray:
         """Host copy of one row per env: ``[k, *feat]`` (tiny; checkpoint/patch path)."""
         out = self._gather((key,), 1, len(env_idx))(
-            {key: self._buf[key]}, self._put(pos.astype(np.int32)), self._put(env_idx.astype(np.int32))
+            {key: self._buf[key]}, self._put(np.stack([pos, env_idx]).astype(np.int32))
         )[key]
         return np.asarray(jax.device_get(out))[:, 0]  # [k, T=1, *feat] -> [k, *feat]
 
@@ -328,13 +361,14 @@ class DeviceSequentialReplayBuffer:
 
     # ----- sample path -----------------------------------------------------------------
     def _gather(self, keys_sig, seq_len: int, n: int):
-        """[n] starts/envs -> {k: [n, seq_len, *feat]} gathered in HBM."""
+        """[2, n] (starts; envs) in one transfer -> {k: [n, seq_len, *feat]} in HBM."""
         cache_key = (keys_sig, seq_len, n)
         if cache_key not in self._gather_fns:
             cap = self._buffer_size
             metas = {key: self._meta[key] for key in keys_sig}
 
-            def gather(buf, starts, env_idx):
+            def gather(buf, idx):
+                starts, env_idx = idx[0], idx[1]
                 row_idx = (starts[:, None] + jnp.arange(seq_len)[None, :]) % cap  # [n, T]
 
                 def one(key, store):
@@ -383,8 +417,7 @@ class DeviceSequentialReplayBuffer:
         starts = (anchor + offsets) % self._buffer_size
         out = self._gather(tuple(sorted(self._buf)), int(sequence_length), n)(
             self._buf,
-            self._put(starts.astype(np.int32)),
-            self._put(env_idx.astype(np.int32)),
+            self._put(np.stack([starts, env_idx]).astype(np.int32)),
         )
         # [N, T, *] -> [G, T, B, *] (match the host SequentialReplayBuffer layout)
         return {
@@ -429,13 +462,10 @@ class DeviceSequentialReplayBuffer:
                 self._check_ckpt_shape(logical)
                 self._allocate({k: v[:1] for k, v in logical.items()})
                 env_idx = np.arange(self._n_envs, dtype=np.int64)
-                blocks = {k: self._put(self._to_physical(k, v)) for k, v in logical.items()}
                 rows = next(iter(logical.values())).shape[0]
                 self._buf = self._write_fn(rows, self._n_envs, tuple(sorted(logical)))(
                     self._buf,
-                    blocks,
-                    self._put(np.zeros(self._n_envs, dtype=np.int32)),
-                    self._put(env_idx.astype(np.int32)),
+                    self._put(self._pack(logical, np.zeros(self._n_envs, dtype=np.int64), env_idx)),
                 )
         self._pos = np.asarray(state["pos"], dtype=np.int64).copy()
         self._full = np.asarray(state["full"], dtype=bool).copy()
