@@ -13,6 +13,15 @@ parameter broadcast.
 Per-rank semantics: ``per_rank_batch_size`` applies per TRAINER device, so the
 global minibatch is ``per_rank_batch_size * (num_devices - 1)`` — matching the
 reference where only ranks 1..N-1 optimize (:497-548).
+
+Multi-process worlds (``fabric.multihost=True`` under a multi-host launcher,
+the reference's multi-node ``sheeprl exp=ppo_decoupled`` case, :623-670) take
+the CROSS-HOST path automatically: the role split spans the GLOBAL device set
+(process 0's first chip plays, every other chip in the world trains), rollouts
+ride one device broadcast collective to the cross-process trainer mesh, and the
+trainer processes join every round with zero templates shaped by a one-time
+spec exchange over the coordinator KV store (see
+sheeprl_tpu/parallel/decoupled.py:CrossHostTransport).
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.parallel import split_runtime
+from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -55,7 +64,15 @@ def main(runtime, cfg: Dict[str, Any]):
             "in order to play correctly the game. "
             "As an alternative you can use one of the Dreamers' agents."
         )
-    player_rt, trainer_rt = split_runtime(runtime)
+    # Multi-process world -> the cross-host role split; single controller -> the
+    # local device split (reference: one code path, group membership decides,
+    # ppo_decoupled.py:645-666).
+    if jax.process_count() > 1:
+        player_rt, trainer_rt, transport = split_runtime_crosshost(runtime)
+    else:
+        player_rt, trainer_rt = split_runtime(runtime)
+        transport = None
+    is_player = transport is None or transport.is_player_process
     trainer_world = trainer_rt.world_size
     initial_ent_coef = float(cfg.algo.ent_coef)
     initial_clip_coef = float(cfg.algo.clip_coef)
@@ -77,16 +94,26 @@ def main(runtime, cfg: Dict[str, Any]):
         f"{trainer_world} trainer device(s)"
     )
 
-    # The player drives num_envs envs (reference player, ppo_decoupled.py:56-70)
+    # The player drives num_envs envs (reference player, ppo_decoupled.py:56-70);
+    # trainer processes probe ONE env for the spaces build_agent needs (the
+    # reference ships agent_args to trainers via object broadcast, :114-117)
     n_envs = cfg.env.num_envs
-    envs = vectorized_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
-            for i in range(n_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
-    observation_space = envs.single_observation_space
+    if is_player:
+        envs = vectorized_env(
+            [
+                make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+                for i in range(n_envs)
+            ],
+            sync=cfg.env.sync_env,
+        )
+        observation_space = envs.single_observation_space
+        action_space = envs.single_action_space
+    else:
+        envs = None
+        probe_env = make_env(cfg, cfg.seed, 0, None, "train", vector_env_idx=0)()
+        observation_space = probe_env.observation_space
+        action_space = probe_env.action_space
+        probe_env.close()
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
     if cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder == []:
@@ -97,12 +124,12 @@ def main(runtime, cfg: Dict[str, Any]):
     obs_keys = cfg.algo.cnn_keys.encoder + cfg.algo.mlp_keys.encoder
     cnn_keys = cfg.algo.cnn_keys.encoder
 
-    is_continuous = isinstance(envs.single_action_space, gym.spaces.Box)
-    is_multidiscrete = isinstance(envs.single_action_space, gym.spaces.MultiDiscrete)
+    is_continuous = isinstance(action_space, gym.spaces.Box)
+    is_multidiscrete = isinstance(action_space, gym.spaces.MultiDiscrete)
     actions_dim = tuple(
-        envs.single_action_space.shape
+        action_space.shape
         if is_continuous
-        else (envs.single_action_space.nvec.tolist() if is_multidiscrete else [envs.single_action_space.n])
+        else (action_space.nvec.tolist() if is_multidiscrete else [action_space.n])
     )
     clip_rewards_fn = (lambda r: np.tanh(r)) if cfg.env.clip_rewards else (lambda r: r)
 
@@ -112,7 +139,12 @@ def main(runtime, cfg: Dict[str, Any]):
     agent, params, player = build_agent(
         trainer_rt, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
-    player.params = player_rt.replicate(params)
+    if transport is None:
+        player.params = player_rt.replicate(params)
+    elif is_player:
+        # initial refresh: local D2D put of this process's replica onto the player
+        # chip (reference :126-127, the player receives the weights from rank-1)
+        player.params = transport.params_to_player(params)
 
     policy_steps_per_iter = int(n_envs * cfg.algo.rollout_steps)
     total_iters = int(cfg.algo.total_steps // policy_steps_per_iter) if not cfg.dry_run else 1
@@ -141,12 +173,16 @@ def main(runtime, cfg: Dict[str, Any]):
             f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
             f"than the rollout steps ({cfg.algo.rollout_steps})"
         )
-    rb = ReplayBuffer(
-        cfg.buffer.size,
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-        obs_keys=obs_keys,
+    rb = (
+        ReplayBuffer(
+            cfg.buffer.size,
+            n_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+            obs_keys=obs_keys,
+        )
+        if is_player
+        else None
     )
 
     last_train = 0
@@ -179,7 +215,13 @@ def main(runtime, cfg: Dict[str, Any]):
         # the global minibatch permutation spans it, like the reference's
         # DistributedSampler over the scattered chunks); the per-minibatch
         # sharding constraint inside train_fn splits work across trainers.
-        device_data, next_values, train_key, clip_coef, ent_coef = trainer_rt.replicate(payload)
+        # Cross-host: one broadcast collective replaces the reference's pickled
+        # object scatter (ppo_decoupled.py:294-299).
+        if transport is None:
+            device_data, next_values, train_key, clip_coef, ent_coef = trainer_rt.replicate(payload)
+        else:
+            device_data, next_values, train_key, clip_coef, ent_coef = transport.rollout_to_trainers(payload)
+        train_key = jnp.asarray(train_key).astype(jnp.uint32)
         new_params, new_opt, _flat, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
             clip_coef, ent_coef,
@@ -187,22 +229,30 @@ def main(runtime, cfg: Dict[str, Any]):
         trainer_state["params"] = new_params
         trainer_state["opt_state"] = new_opt
         # Parameter refresh for the player: direct device-to-device resharding
-        # (reference :550-554 does a flattened-vector NCCL broadcast)
-        player_params = jax.device_put(new_params, player_rt.replicated)
+        # (reference :550-554 does a flattened-vector NCCL broadcast); cross-host
+        # it is a LOCAL put of the player process's own replica (None elsewhere).
+        if transport is None:
+            player_params = jax.device_put(new_params, player_rt.replicated)
+        else:
+            player_params = transport.params_to_player(new_params)
         return player_params, metrics
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
-    for k in obs_keys:
-        if k in cnn_keys:
-            next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
-        step_data[k] = next_obs[k][np.newaxis]
+    if is_player:
+        next_obs = envs.reset(seed=cfg.seed)[0]
+        for k in obs_keys:
+            if k in cnn_keys:
+                next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
+            step_data[k] = next_obs[k][np.newaxis]
 
     for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
-            for _ in range(cfg.algo.rollout_steps):
+            # Only the player process steps envs; trainer processes skip straight
+            # to the training collective (their policy_step advances below so the
+            # anneal/bookkeeping arithmetic stays in lockstep with the player).
+            for _ in (range(cfg.algo.rollout_steps) if is_player else ()):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
@@ -265,25 +315,42 @@ def main(runtime, cfg: Dict[str, Any]):
 
             # ---- ship the rollout to the trainer role, block for new params
             # (the reference's scatter_object_list + params broadcast round)
-            local_data = rb.to_arrays(dtype=np.float32)
-            if cfg.buffer.size > cfg.algo.rollout_steps:
-                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-                local_data = {k: v[idx] for k, v in local_data.items()}
+            if not is_player:
+                policy_step += policy_steps_per_iter
+            else:
+                local_data = rb.to_arrays(dtype=np.float32)
+                if cfg.buffer.size > cfg.algo.rollout_steps:
+                    idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                    local_data = {k: v[idx] for k, v in local_data.items()}
             with timer("Time/train_time", SumMetric()):
-                jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                next_values = np.asarray(player.get_values(jax_obs))
+                if is_player:
+                    jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                    next_values = np.asarray(player.get_values(jax_obs))
+                    host_data = {k: v for k, v in local_data.items() if k not in ("returns", "advantages")}
+                    if transport is not None:
+                        transport.sync_payload_spec("ppo_rollout", {**host_data, "__next_values__": next_values})
+                else:
+                    # trainer processes join the broadcast with zero templates
+                    # shaped by the player's one-time payload spec
+                    transport.sync_payload_spec("ppo_rollout")
+                    flat = transport.zeros_payload("ppo_rollout")
+                    next_values = flat.pop("__next_values__")
+                    host_data = flat
                 rng, train_key = jax.random.split(rng)
-                host_data = {k: v for k, v in local_data.items() if k not in ("returns", "advantages")}
                 player_params, train_metrics = trainer_step(
-                    (host_data, next_values, train_key, jnp.float32(cfg.algo.clip_coef), jnp.float32(cfg.algo.ent_coef))
+                    (host_data, next_values, np.asarray(train_key),
+                     np.float32(cfg.algo.clip_coef), np.float32(cfg.algo.ent_coef))
                 )
-                jax.block_until_ready(player_params)
-                player.params = player_params
+                if is_player:
+                    jax.block_until_ready(player_params)
+                    player.params = player_params
             train_step += trainer_world
 
-            if cfg.metric.log_level > 0:
+            if is_player and cfg.metric.log_level > 0:
                 if aggregator:
-                    aggregator.update_from_device(train_metrics)
+                    aggregator.update_from_device(
+                        transport.pull_replicated(train_metrics) if transport is not None else train_metrics
+                    )
                 logger.log_metrics(
                     {"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step
                 )
@@ -321,13 +388,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
                 )
 
-            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-                iter_num == total_iters and cfg.checkpoint.save_last
+            if is_player and (
+                (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+                or (iter_num == total_iters and cfg.checkpoint.save_last)
             ):
                 last_checkpoint = policy_step
+                pull = jax.device_get if transport is None else transport.pull_replicated
                 ckpt_state = {
-                    "agent": jax.device_get(trainer_state["params"]),
-                    "optimizer": jax.device_get(trainer_state["opt_state"]),
+                    "agent": pull(trainer_state["params"]),
+                    "optimizer": pull(trainer_state["opt_state"]),
                     "iter_num": iter_num,
                     "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
                     "last_log": last_log,
@@ -337,8 +406,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
 
     profiler.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, player_rt, cfg, log_dir)
+    if transport is not None:
+        runtime.barrier()  # leave the distributed world together
     if logger:
         logger.finalize()

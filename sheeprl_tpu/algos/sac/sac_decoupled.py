@@ -11,6 +11,15 @@ SAC updates over the trainer mesh and hands the refreshed parameters back
 Per-rank semantics: ``per_rank_batch_size`` applies per TRAINER device and the
 replay ratio is computed against the trainer world size (reference :237:
 ``ratio(ratio_steps / (fabric.world_size - 1))``).
+
+Multi-process worlds take the CROSS-HOST path automatically (reference
+multi-node case, sac_decoupled.py:548-588): global device 0 plays and owns the
+replay buffer, every other chip trains. The per-round gradient-step count is
+pure ``Ratio`` arithmetic over config-derived step counters, so every process
+computes it independently and stays in lockstep WITHOUT the reference's
+explicit count broadcast (:237) — only the sampled batches ride the device
+broadcast collective, with trainer processes joining on zero templates (see
+sheeprl_tpu/parallel/decoupled.py:CrossHostTransport).
 """
 
 from __future__ import annotations
@@ -30,7 +39,7 @@ from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.parallel import split_runtime
+from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -47,7 +56,14 @@ def main(runtime, cfg: Dict[str, Any]):
                          "use the coupled trainer")
     if "minedojo" in cfg.env.wrapper._target_.lower():
         raise ValueError("MineDojo is not currently supported by SAC agent.")
-    player_rt, trainer_rt = split_runtime(runtime)
+    # Multi-process world -> the cross-host role split; single controller -> the
+    # local device split (reference sac_decoupled.py:548-588).
+    if jax.process_count() > 1:
+        player_rt, trainer_rt, transport = split_runtime_crosshost(runtime)
+    else:
+        player_rt, trainer_rt = split_runtime(runtime)
+        transport = None
+    is_player = transport is None or transport.is_player_process
     trainer_world = trainer_rt.world_size
 
     state = None
@@ -72,15 +88,24 @@ def main(runtime, cfg: Dict[str, Any]):
     )
 
     n_envs = cfg.env.num_envs
-    envs = vectorized_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
-            for i in range(n_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
-    action_space = envs.single_action_space
-    observation_space = envs.single_observation_space
+    if is_player:
+        envs = vectorized_env(
+            [
+                make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+                for i in range(n_envs)
+            ],
+            sync=cfg.env.sync_env,
+        )
+        action_space = envs.single_action_space
+        observation_space = envs.single_observation_space
+    else:
+        # trainer processes probe ONE env for the spaces build_agent needs (the
+        # reference ships agent_args via object broadcast, sac_decoupled.py:127)
+        envs = None
+        probe_env = make_env(cfg, cfg.seed, 0, None, "train", vector_env_idx=0)()
+        action_space = probe_env.action_space
+        observation_space = probe_env.observation_space
+        probe_env.close()
     if not isinstance(action_space, gym.spaces.Box):
         raise ValueError("Only continuous action space is supported for the SAC agent")
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -100,7 +125,10 @@ def main(runtime, cfg: Dict[str, Any]):
     actor, critic, params, player = build_agent(
         trainer_rt, cfg, observation_space, action_space, state["agent"] if state else None
     )
-    player.params = player_rt.replicate(params.actor)
+    if transport is None:
+        player.params = player_rt.replicate(params.actor)
+    elif is_player:
+        player.params = transport.params_to_player(params.actor)
     act_dim = prod(action_space.shape)
     target_entropy = jnp.float32(-act_dim)
     action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
@@ -115,7 +143,10 @@ def main(runtime, cfg: Dict[str, Any]):
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
     opt_states = trainer_rt.replicate(opt_states)
-    update_counter = jnp.int32(state["update_counter"]) if state else jnp.int32(0)
+    # trainer-mesh placement: in a multi-process world every train_fn input must
+    # be a global array (a process-local scalar would fail device-assignment
+    # checks alongside the cross-process params)
+    update_counter = trainer_rt.replicate(np.int32(state["update_counter"] if state else 0))
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -126,14 +157,18 @@ def main(runtime, cfg: Dict[str, Any]):
 
     # The PLAYER owns the replay buffer (reference :116-123)
     buffer_size = cfg.buffer.size // n_envs if not cfg.dry_run else 1
-    rb = ReplayBuffer(
-        buffer_size,
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-        obs_keys=("observations",),
+    rb = (
+        ReplayBuffer(
+            buffer_size,
+            n_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
+            obs_keys=("observations",),
+        )
+        if is_player
+        else None
     )
-    if state and cfg.buffer.checkpoint and "rb" in state:
+    if rb is not None and state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
     last_train = 0
@@ -169,7 +204,13 @@ def main(runtime, cfg: Dict[str, Any]):
     trainer_state = {"params": params, "opt_states": opt_states, "update_counter": update_counter}
 
     def trainer_step(payload):
-        batches, train_key = trainer_rt.replicate(payload)
+        # Cross-host: one broadcast collective replaces the reference's pickled
+        # batch scatter (sac_decoupled.py:243-257).
+        if transport is None:
+            batches, train_key = trainer_rt.replicate(payload)
+        else:
+            batches, train_key = transport.rollout_to_trainers(payload)
+        train_key = jnp.asarray(train_key).astype(jnp.uint32)
         new_params, new_opt, update_end, _flat_actor, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_states"], batches, train_key,
             trainer_state["update_counter"],
@@ -178,8 +219,12 @@ def main(runtime, cfg: Dict[str, Any]):
         trainer_state["opt_states"] = new_opt
         trainer_state["update_counter"] = update_end
         # Only the actor goes back to the player (reference :550-554 broadcasts
-        # the actor vector)
-        player_params = jax.device_put(new_params.actor, player_rt.replicated)
+        # the actor vector); cross-host it is a LOCAL put of this process's
+        # replica (None on trainer processes).
+        if transport is None:
+            player_params = jax.device_put(new_params.actor, player_rt.replicated)
+        else:
+            player_params = transport.params_to_player(new_params.actor)
         return player_params, metrics
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
@@ -187,82 +232,102 @@ def main(runtime, cfg: Dict[str, Any]):
     mlp_keys = cfg.algo.mlp_keys.encoder
     cumulative_grad_steps = 0
 
-    obs = envs.reset(seed=cfg.seed)[0]
-    obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
+    if is_player:
+        obs = envs.reset(seed=cfg.seed)[0]
+        obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
 
     for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
             policy_step += n_envs
 
-            with timer("Time/env_interaction_time", SumMetric()):
-                if iter_num < learning_starts:
-                    actions = envs.action_space.sample()
-                else:
-                    rng, act_key = jax.random.split(rng)
-                    actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
-                next_obs, rewards, terminated, truncated, info = envs.step(
-                    actions.reshape(envs.action_space.shape)
-                )
-                next_obs_vec = np.concatenate(
-                    [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
-                )
-                real_next_obs = next_obs_vec.copy()
-                if "final_obs" in info:
-                    for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
-                        if fo is not None:
-                            real_next_obs[idx] = np.concatenate(
-                                [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
-                            )
+            if is_player:
+                with timer("Time/env_interaction_time", SumMetric()):
+                    if iter_num < learning_starts:
+                        actions = envs.action_space.sample()
+                    else:
+                        rng, act_key = jax.random.split(rng)
+                        actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
+                    next_obs, rewards, terminated, truncated, info = envs.step(
+                        actions.reshape(envs.action_space.shape)
+                    )
+                    next_obs_vec = np.concatenate(
+                        [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
+                    )
+                    real_next_obs = next_obs_vec.copy()
+                    if "final_obs" in info:
+                        for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
+                            if fo is not None:
+                                real_next_obs[idx] = np.concatenate(
+                                    [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
+                                )
 
-            if cfg.metric.log_level > 0:
-                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                    if aggregator and "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if aggregator and "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-            step_data = {
-                "observations": obs_vec[np.newaxis],
-                "actions": np.asarray(actions, dtype=np.float32).reshape(1, n_envs, -1),
-                "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
-                "terminated": np.asarray(terminated, dtype=np.uint8).reshape(1, n_envs, -1),
-                "truncated": np.asarray(truncated, dtype=np.uint8).reshape(1, n_envs, -1),
-            }
-            if not cfg.buffer.sample_next_obs:
-                step_data["next_observations"] = real_next_obs[np.newaxis]
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-            obs_vec = next_obs_vec
+                step_data = {
+                    "observations": obs_vec[np.newaxis],
+                    "actions": np.asarray(actions, dtype=np.float32).reshape(1, n_envs, -1),
+                    "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
+                    "terminated": np.asarray(terminated, dtype=np.uint8).reshape(1, n_envs, -1),
+                    "truncated": np.asarray(truncated, dtype=np.uint8).reshape(1, n_envs, -1),
+                }
+                if not cfg.buffer.sample_next_obs:
+                    step_data["next_observations"] = real_next_obs[np.newaxis]
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                obs_vec = next_obs_vec
 
             if iter_num >= learning_starts:
                 ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+                # Pure arithmetic over config-derived counters, so in a
+                # multi-process world EVERY process computes the same count and
+                # stays in lockstep (the reference broadcasts it instead,
+                # sac_decoupled.py:237).
                 per_rank_gradient_steps = ratio(ratio_steps / trainer_world)
                 if per_rank_gradient_steps > 0:
-                    # The player samples and ships the batch (reference :243-257)
-                    sample = rb.sample(
-                        per_rank_gradient_steps * cfg.algo.per_rank_batch_size * trainer_world,
-                        sample_next_obs=cfg.buffer.sample_next_obs,
-                        n_samples=1,
-                    )
-                    batches = {
-                        k: np.asarray(v, dtype=np.float32).reshape(
-                            per_rank_gradient_steps,
-                            cfg.algo.per_rank_batch_size * trainer_world,
-                            *v.shape[2:],
+                    if is_player:
+                        # The player samples and ships the batch (reference :243-257)
+                        sample = rb.sample(
+                            per_rank_gradient_steps * cfg.algo.per_rank_batch_size * trainer_world,
+                            sample_next_obs=cfg.buffer.sample_next_obs,
+                            n_samples=1,
                         )
-                        for k, v in sample.items()
-                    }
+                        batches = {
+                            k: np.asarray(v, dtype=np.float32).reshape(
+                                per_rank_gradient_steps,
+                                cfg.algo.per_rank_batch_size * trainer_world,
+                                *v.shape[2:],
+                            )
+                            for k, v in sample.items()
+                        }
+                        if transport is not None:
+                            transport.sync_payload_spec("sac_batches", batches)
+                    else:
+                        # zero templates: feature dims from the player's one-time
+                        # spec, leading dim from this round's locally-computed count
+                        spec = transport.sync_payload_spec("sac_batches")
+                        batches = {
+                            k: np.zeros((per_rank_gradient_steps,) + tuple(s[1:]), d)
+                            for k, (s, d) in spec.items()
+                        }
                     with timer("Time/train_time", SumMetric()):
                         rng, train_key = jax.random.split(rng)
-                        player_params, train_metrics = trainer_step((batches, train_key))
-                        jax.block_until_ready(player_params)
-                        player.params = player_params
+                        player_params, train_metrics = trainer_step((batches, np.asarray(train_key)))
+                        if is_player:
+                            jax.block_until_ready(player_params)
+                            player.params = player_params
                         cumulative_grad_steps += per_rank_gradient_steps
                         train_step += trainer_world * per_rank_gradient_steps
-                    if aggregator:
-                        aggregator.update_from_device(train_metrics)
+                    if is_player and aggregator:
+                        aggregator.update_from_device(
+                            transport.pull_replicated(train_metrics) if transport is not None else train_metrics
+                        )
 
-            if cfg.metric.log_level > 0 and (
+            if is_player and cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
             ):
                 if aggregator and not aggregator.disabled:
@@ -294,14 +359,16 @@ def main(runtime, cfg: Dict[str, Any]):
                 last_log = policy_step
                 last_train = train_step
 
-            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-                iter_num == total_iters and cfg.checkpoint.save_last
+            if is_player and (
+                (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every)
+                or (iter_num == total_iters and cfg.checkpoint.save_last)
             ):
                 last_checkpoint = policy_step
+                pull = jax.device_get if transport is None else transport.pull_replicated
                 ckpt_state = {
-                    "agent": jax.device_get(trainer_state["params"]),
-                    "opt_states": jax.device_get(trainer_state["opt_states"]),
-                    "update_counter": int(trainer_state["update_counter"]),
+                    "agent": pull(trainer_state["params"]),
+                    "opt_states": pull(trainer_state["opt_states"]),
+                    "update_counter": int(np.asarray(pull(trainer_state["update_counter"]))),
                     "ratio": ratio.state_dict(),
                     "iter_num": iter_num,
                     "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
@@ -317,8 +384,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
 
     profiler.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         test(player, player_rt, cfg, log_dir)
+    if transport is not None:
+        runtime.barrier()  # leave the distributed world together
     if logger:
         logger.finalize()

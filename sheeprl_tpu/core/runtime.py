@@ -366,7 +366,12 @@ class Runtime:
         """
         if jax.process_count() <= 1:
             return
-        from jax._src import distributed
+        try:
+            from jax._src import distributed
+        except (ImportError, AttributeError):  # pragma: no cover - private-API drift
+            # Same degrade-to-skip policy as _distributed_initialized: a jax upgrade
+            # that moves the module must not crash every multihost boot here.
+            return
 
         client = getattr(distributed.global_state, "client", None)
         if client is None:  # pragma: no cover - initialize() always sets it

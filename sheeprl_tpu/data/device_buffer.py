@@ -224,7 +224,16 @@ class DeviceSequentialReplayBuffer:
         device_put, so the 8-put add becomes ONE transfer, unpacked in-graph."""
         parts = [pos.astype("<i4").tobytes(), env_idx.astype("<i4").tobytes()]
         for key in sorted(data):
-            parts.append(np.ascontiguousarray(self._to_physical(key, self._narrow(np.asarray(data[key])))).tobytes())
+            leaf = self._narrow(np.asarray(data[key]))
+            store_dtype = self._meta[key].dtype
+            if leaf.dtype != store_dtype:
+                # The packed byte stream is decoded with the storage dtype captured at
+                # allocation; a leaf arriving with a different (same-itemsize) dtype
+                # would be bit-reinterpreted and a different itemsize would misalign
+                # every later leaf in the stream. Coerce here, exactly as the pre-pack
+                # write path did in-graph via astype(store.dtype).
+                leaf = leaf.astype(store_dtype)
+            parts.append(np.ascontiguousarray(self._to_physical(key, leaf)).tobytes())
         return np.frombuffer(b"".join(parts), np.uint8)
 
     def _write_fn(self, rows: int, k: int, keys_sig):

@@ -27,6 +27,7 @@ if not _IS_DMC_AVAILABLE:
         "dm_control is not installed; install it to use the DeepMind Control Suite environments"
     )
 
+import warnings
 from typing import Any, Dict, Optional, Tuple
 
 import gymnasium as gym
@@ -223,8 +224,17 @@ class DMCWrapper(OldGymEnvAdapter):
             self._cameras[cam_id] = cam
         try:
             return cam.render().copy()
-        except Exception:
-            # model/scene changed under the cached camera (e.g. env rebuilt): rebuild once
+        except Exception as exc:
+            # model/scene changed under the cached camera (e.g. env rebuilt): rebuild
+            # once. Warn so genuine render failures (GL context loss, driver errors)
+            # stay visible instead of being silently absorbed by the cache rebuild —
+            # if the fallback render also fails, the real error propagates.
+            warnings.warn(
+                f"Cached dm_control camera render failed ({type(exc).__name__}: {exc}); "
+                "rebuilding the camera and retrying via physics.render",
+                RuntimeWarning,
+                stacklevel=2,
+            )
             self._cameras.pop(cam_id, None)
             return self.env.physics.render(
                 height=self._height, width=self._width, camera_id=cam_id

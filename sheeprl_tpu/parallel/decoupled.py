@@ -22,13 +22,24 @@ DEVICE split rather than a process split:
 from __future__ import annotations
 
 import copy
-from typing import Any, Optional, Sequence, Tuple
+import json
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from sheeprl_tpu.core.runtime import Runtime
+
+
+def _kv_client():
+    """The coordinator's key-value store client (None if unavailable)."""
+    try:
+        from jax._src import distributed
+
+        return getattr(distributed.global_state, "client", None)
+    except (ImportError, AttributeError):  # pragma: no cover - private-API drift
+        return None
 
 
 def _sub_runtime(runtime: Runtime, devices: Sequence[Any], axes: Tuple[str, ...] = ("data",)) -> Runtime:
@@ -89,6 +100,66 @@ class CrossHostTransport:
         self.trainer_mesh = trainer_mesh
         self.player_device = player_device
         self.is_player_process = jax.process_index() == 0
+        self._specs: Dict[str, Dict[str, Tuple[Tuple[int, ...], str]]] = {}
+        self._zero_payloads: Dict[str, Dict[str, np.ndarray]] = {}
+
+    def sync_payload_spec(
+        self, tag: str, flat: Optional[Dict[str, Any]] = None, timeout_ms: int = 86_400_000
+    ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+        """One-time shape/dtype exchange for a flat ``{name: array}`` payload.
+
+        ``rollout_to_trainers``'s device broadcast needs every process to present
+        an identically-structured pytree, but only the player process actually
+        HAS the rollout — the trainer processes need shape templates. The
+        reference solves this by pickling cfg/agent_args through
+        ``broadcast_object_list`` (ppo_decoupled.py:114-117); here the spec rides
+        the coordinator's KV store, the channel the world already booted on.
+
+        Player: pass the first real payload; publishes and returns its spec.
+        Trainer processes: pass nothing; blocks for the player's spec. The result
+        is cached — later calls are free. The default timeout is a day, the same
+        bound the reference puts on its decoupled collectives
+        (ppo_decoupled.py:650, ``timeout=timedelta(days=1)``): the player may
+        legitimately spend a long prefill (``learning_starts``) before its first
+        publish, and a short bound here would kill the job at the first round.
+        """
+        if tag in self._specs:
+            return self._specs[tag]
+        client = _kv_client()
+        if client is None:
+            raise RuntimeError(
+                "cross-host decoupled mode needs the jax coordinator KV store "
+                "(jax.distributed.initialize must have run in every process); "
+                "this jax version does not expose it"
+            )
+        key = f"sheeprl_tpu/decoupled/{tag}"
+        if self.is_player_process:
+            if flat is None:
+                raise ValueError("the player process must provide the payload to publish its spec")
+            spec = {
+                name: (tuple(int(d) for d in np.shape(v)), str(np.asarray(v).dtype))
+                for name, v in flat.items()
+            }
+            client.key_value_set(
+                key, json.dumps({n: [list(s), d] for n, (s, d) in spec.items()}), allow_overwrite=True
+            )
+        else:
+            raw = json.loads(client.blocking_key_value_get(key, timeout_ms))
+            spec = {n: (tuple(s), d) for n, (s, d) in raw.items()}
+        self._specs[tag] = spec
+        return spec
+
+    def zeros_payload(self, tag: str) -> Dict[str, np.ndarray]:
+        """Zero template matching a previously-synced payload spec.
+
+        The arrays are cached (``broadcast_one_to_all`` zeroes non-source
+        contributions itself, so stale values are impossible and a per-round
+        re-allocation of a full pixel rollout would be pure memset waste); the
+        dict is shallow-copied so callers may pop/re-key it freely.
+        """
+        if tag not in self._zero_payloads:
+            self._zero_payloads[tag] = {n: np.zeros(s, d) for n, (s, d) in self._specs[tag].items()}
+        return dict(self._zero_payloads[tag])
 
     def rollout_to_trainers(self, host_tree: Any) -> Any:
         """Player process's host rollout -> replicated on the trainer mesh.
@@ -109,9 +180,19 @@ class CrossHostTransport:
         """
         if not self.is_player_process:
             return None
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a.addressable_data(0), self.player_device), params
-        )
+
+        def put(a):
+            if isinstance(a, jax.Array) and not getattr(a.sharding, "is_fully_replicated", True):
+                # addressable_data(0) of a sharded leaf would be ONE shard with the
+                # shard's shape — the player would silently run on truncated params
+                raise ValueError(
+                    "Cannot refresh the player from SHARDED trainer params; keep the "
+                    "trainer state replicated over the trainer mesh (DDP placement) "
+                    "or all-gather it before the refresh"
+                )
+            return jax.device_put(a.addressable_data(0) if isinstance(a, jax.Array) else a, self.player_device)
+
+        return jax.tree_util.tree_map(put, params)
 
     def pull_replicated(self, tree: Any) -> Any:
         """Host copy of trainer-mesh-replicated values (metrics, checkpoints):
@@ -143,9 +224,25 @@ def split_runtime_crosshost(runtime: Runtime) -> Tuple[Runtime, Runtime, CrossHo
         raise RuntimeError(
             f"The decoupled actor-learner split requires at least 2 devices, got {len(global_devices)}"
         )
-    player_rt = _sub_runtime(runtime, global_devices[:1])
-    trainer_rt = _sub_runtime(runtime, global_devices[1:])
+    # The player PROCESS is process 0 (it owns the envs), so the player CHIP must
+    # be one that process addresses — on topologies where global device ids follow
+    # the interconnect rather than task order, the lowest-id device may belong to
+    # another host.
+    p0_devices = [d for d in global_devices if getattr(d, "process_index", 0) == 0]
+    if len(p0_devices) < 2:
+        # The parameter refresh reads the player process's own addressable replica
+        # of the trainer params (params_to_player); with zero trainer devices on
+        # the player process there is no such replica to read.
+        raise RuntimeError(
+            "cross-host decoupled mode needs the player process to own the player "
+            "chip PLUS at least one trainer device (2+ local devices on process 0), "
+            "so the parameter refresh has a local replica to read"
+        )
+    player_device = p0_devices[0]
+    trainer_devices = [d for d in global_devices if d is not player_device]
+    player_rt = _sub_runtime(runtime, [player_device])
+    trainer_rt = _sub_runtime(runtime, trainer_devices)
     player_rt.player_on_host = False
     trainer_rt.player_on_host = False
-    transport = CrossHostTransport(trainer_rt.mesh, global_devices[0])
+    transport = CrossHostTransport(trainer_rt.mesh, player_device)
     return player_rt, trainer_rt, transport

@@ -97,6 +97,23 @@ def test_dtype_narrowing_and_uint8_storage():
     assert rb.buffer["b"].dtype == jnp.int32
 
 
+def test_later_add_with_different_dtype_is_coerced_not_bitcast():
+    """A leaf arriving with a dtype that differs from the allocation-time storage
+    dtype must be VALUE-cast before packing: the packed byte stream is decoded
+    with the storage dtype, so a same-itemsize mismatch (int32 vs float32) would
+    otherwise silently reinterpret bits, and a different itemsize would misalign
+    every later leaf in the stream."""
+    rb = DeviceSequentialReplayBuffer(8, n_envs=1)
+    rb.add({"r": np.full((1, 1, 1), 1.0, dtype=np.float32), "z": np.zeros((1, 1, 2), np.float32)})
+    # same itemsize, different kind: int32 values 7 must land as float32 7.0
+    rb.add({"r": np.full((1, 1, 1), 7, dtype=np.int32), "z": np.ones((1, 1, 2), np.float32)})
+    # different itemsize: float16 3.0 must not shift the byte offsets of 'z'
+    rb.add({"r": np.full((1, 1, 1), 3.0, dtype=np.float16), "z": np.full((1, 1, 2), 5.0, np.float32)})
+    buf = {k: np.asarray(jax.device_get(v)) for k, v in rb.buffer.items()}
+    np.testing.assert_array_equal(buf["r"][:3, 0, 0], [1.0, 7.0, 3.0])
+    np.testing.assert_array_equal(buf["z"][2, 0, :2], [5.0, 5.0])
+
+
 def test_dv3_cli_with_device_buffer(tmp_path, monkeypatch):
     """End-to-end DV3 smoke over the HBM-resident buffer path."""
     monkeypatch.chdir(tmp_path)

@@ -158,6 +158,31 @@ def test_crosshost_decoupled_ppo_step(tmp_path):
     assert "id=0" in by_pid[0]["player_device"]  # refresh landed on the player chip
 
 
+@pytest.mark.timeout(600)
+def test_crosshost_decoupled_ppo_cli(tmp_path):
+    """The reference's flagship distributed mode through the REAL CLI: a
+    2-process `exp=ppo_decoupled fabric.multihost=True` launch must train
+    end-to-end over the cross-process trainer mesh and write the final
+    checkpoint (reference multi-node launch, ppo_decoupled.py:623-670)."""
+    child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_cli_child.py")
+    by_pid = _run_children(_free_port(), 2, tmp_path, "ppo_decoupled", timeout=540, child=child)
+    for pid in (0, 1):
+        assert by_pid[pid]["done"]
+    assert by_pid[0]["n_ckpts"] >= 1, "the player process must write the final checkpoint"
+
+
+@pytest.mark.timeout(600)
+def test_crosshost_decoupled_sac_cli(tmp_path):
+    """Same as above for `exp=sac_decoupled`: player owns the replay buffer and
+    samples, trainer processes join on spec-shaped zero templates (reference
+    sac_decoupled.py:548-588)."""
+    child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_cli_child.py")
+    by_pid = _run_children(_free_port(), 2, tmp_path, "sac_decoupled", timeout=540, child=child)
+    for pid in (0, 1):
+        assert by_pid[pid]["done"]
+    assert by_pid[0]["n_ckpts"] >= 1, "the player process must write the final checkpoint"
+
+
 @pytest.mark.timeout(300)
 def test_resume_under_multihost(tmp_path):
     """Write-once checkpoint -> every process reloads identical state, and the
