@@ -40,7 +40,7 @@ def _chip_peak_flops(device):
     return None  # unknown chip: report MFU as null rather than fabricate one
 
 
-def bench_ppo(total_steps: int = 65536) -> dict:
+def _ppo_pass(total_steps: int) -> float:
     from sheeprl_tpu.cli import run
 
     t0 = time.perf_counter()
@@ -62,14 +62,31 @@ def bench_ppo(total_steps: int = 65536) -> dict:
             "buffer.memmap=False",
         ]
     )
-    elapsed = time.perf_counter() - t0
-    steps_per_sec = total_steps / elapsed
+    return total_steps / (time.perf_counter() - t0)
+
+
+def bench_ppo(total_steps: int = 65536, passes: int = 3) -> dict:
+    """PPO throughput with variance control: one short warmup pass absorbs jit
+    compilation, then ``passes`` full runs are timed and the MEDIAN reported
+    with its spread.
+
+    Single-pass numbers on the tunneled chip swung r2->r3 by 34% purely from
+    cold-compile + tunnel-latency noise (see benchmarks/PPO_BENCH_NOTES.md);
+    per-iteration cost here is ONE tunnel round-trip (~100-140 ms measured) for
+    the on-policy params refresh, so wall-clock is latency- not compute-bound
+    and needs a median over repeats to be comparable across rounds.
+    """
+    _ppo_pass(8192)  # warmup: compile the train/rollout jits outside the timed passes
+    sps = sorted(_ppo_pass(total_steps) for _ in range(passes))
+    median = sps[len(sps) // 2] if passes % 2 else 0.5 * (sps[passes // 2 - 1] + sps[passes // 2])
     baseline_sps = 65536 / 81.27  # reference PPO benchmark: 65536 steps / 81.27 s (README.md:99-115)
     return {
         "metric": "ppo_cartpole_env_steps_per_sec",
-        "value": round(steps_per_sec, 2),
+        "value": round(median, 2),
         "unit": "env-steps/s",
-        "vs_baseline": round(steps_per_sec / baseline_sps, 3),
+        "vs_baseline": round(median / baseline_sps, 3),
+        "ppo_passes": [round(v, 2) for v in sps],
+        "ppo_spread": round((sps[-1] - sps[0]) / 2.0, 2),
     }
 
 

@@ -87,6 +87,8 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    if transport is not None:
+        transport.set_scope(log_dir)  # run-scope the KV spec exchange (coordinator store outlives runs)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
     runtime.print(

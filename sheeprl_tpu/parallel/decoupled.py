@@ -102,6 +102,19 @@ class CrossHostTransport:
         self.is_player_process = jax.process_index() == 0
         self._specs: Dict[str, Dict[str, Tuple[Tuple[int, ...], str]]] = {}
         self._zero_payloads: Dict[str, Dict[str, np.ndarray]] = {}
+        self._scope = ""
+
+    def set_scope(self, scope: str) -> None:
+        """Namespace the KV exchange to this run.
+
+        The coordinator KV store outlives a single ``main()`` (second Runtime on
+        the same coordinator: exploration->finetuning chains, launcher re-use),
+        so an unscoped spec key would hand a later run the PREVIOUS run's spec
+        the instant trainers ask, racing the player's re-publish and breaking the
+        broadcast on any shape change. Algorithms pass the log dir — identical
+        on every process after the ``get_log_dir`` broadcast.
+        """
+        self._scope = str(scope)
 
     def sync_payload_spec(
         self, tag: str, flat: Optional[Dict[str, Any]] = None, timeout_ms: int = 86_400_000
@@ -132,7 +145,10 @@ class CrossHostTransport:
                 "(jax.distributed.initialize must have run in every process); "
                 "this jax version does not expose it"
             )
-        key = f"sheeprl_tpu/decoupled/{tag}"
+        import hashlib
+
+        scope = hashlib.sha1(self._scope.encode()).hexdigest()[:12] if self._scope else "unscoped"
+        key = f"sheeprl_tpu/decoupled/{scope}/{tag}"
         if self.is_player_process:
             if flat is None:
                 raise ValueError("the player process must provide the payload to publish its spec")
@@ -230,13 +246,18 @@ def split_runtime_crosshost(runtime: Runtime) -> Tuple[Runtime, Runtime, CrossHo
     # another host.
     p0_devices = [d for d in global_devices if getattr(d, "process_index", 0) == 0]
     if len(p0_devices) < 2:
-        # The parameter refresh reads the player process's own addressable replica
-        # of the trainer params (params_to_player); with zero trainer devices on
-        # the player process there is no such replica to read.
+        # Not just the parameter refresh: in multi-controller SPMD a process only
+        # drives computations over meshes it owns devices in (computation follows
+        # data), so a player process with zero trainer devices could neither read
+        # a params replica NOR legally dispatch the trainer step it must stay in
+        # lockstep with. TPU pods give every process >= 4 local chips, so the
+        # supported topology is the natural one; the GPU-style
+        # one-process-per-accelerator shape is rejected loudly here.
         raise RuntimeError(
             "cross-host decoupled mode needs the player process to own the player "
             "chip PLUS at least one trainer device (2+ local devices on process 0), "
-            "so the parameter refresh has a local replica to read"
+            "so the parameter refresh has a local replica to read and the player "
+            "process participates in the trainer-mesh computation"
         )
     player_device = p0_devices[0]
     trainer_devices = [d for d in global_devices if d is not player_device]
