@@ -95,13 +95,16 @@ def bench_dv3(
     seq: int = 64,
     iters: int = 20,
     extra_overrides=("algo.imagination_scan_unroll=15",),
+    key_prefix: str = "dv3",
 ) -> dict:
     """Time the fused DreamerV3-S train step at the measured-best TPU config.
 
     Defaults follow scripts/mfu_sweep.py on the v5e: batch 128 with the H=15
-    imagination scan fully unrolled measures 29.1% MFU / 75.0k replayed
-    frames/s (batch 16, the Atari-100K recipe shape, measures 44.5k frames/s;
-    batch is a free training-recipe choice at fixed replay_ratio)."""
+    imagination scan fully unrolled measures ~27.7% MFU (XLA-estimated flops;
+    the T=64 dynamic scan's flops are NOT trip-count-scaled by XLA cost
+    analysis, so true model-flops MFU is higher — see
+    benchmarks/DV3_MFU_NOTES.md). ``key_prefix`` lets a second call report the
+    batch-16 Atari-100K recipe shape as ``dv3_recipe_*``."""
     import gymnasium as gym
     import jax
     import numpy as np
@@ -177,13 +180,13 @@ def bench_dv3(
     peak = _chip_peak_flops(runtime.device)
     mfu = (step_flops / sec_per_step / peak) if (step_flops and peak) else None
     return {
-        "dv3_gsteps_per_sec": round(gsteps_per_sec, 3),
-        "dv3_frames_per_sec": round(gsteps_per_sec * batch * seq, 1),
-        "dv3_step_tflops": round(step_flops / 1e12, 3) if step_flops else None,
-        "dv3_mfu": round(mfu, 4) if mfu is not None else None,
-        "dv3_device": getattr(runtime.device, "device_kind", str(runtime.device)),
+        f"{key_prefix}_gsteps_per_sec": round(gsteps_per_sec, 3),
+        f"{key_prefix}_frames_per_sec": round(gsteps_per_sec * batch * seq, 1),
+        f"{key_prefix}_step_tflops": round(step_flops / 1e12, 3) if step_flops else None,
+        f"{key_prefix}_mfu": round(mfu, 4) if mfu is not None else None,
+        f"{key_prefix}_device": getattr(runtime.device, "device_kind", str(runtime.device)),
         # reference anchor: ~1 g-step/s on RTX 3080 (Atari-100K in ~14h, README.md:44-51)
-        "dv3_vs_baseline": round(gsteps_per_sec / 1.0, 3),
+        f"{key_prefix}_vs_baseline": round(gsteps_per_sec / 1.0, 3),
     }
 
 
@@ -196,4 +199,9 @@ if __name__ == "__main__":
             result.update(bench_dv3())
         except Exception as e:  # a DV3 bench failure must not lose the PPO number
             result["dv3_error"] = f"{type(e).__name__}: {e}"
+        try:
+            # the Atari-100K training recipe shape (batch 16 x seq 64)
+            result.update(bench_dv3(batch=16, key_prefix="dv3_recipe"))
+        except Exception as e:
+            result["dv3_recipe_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

@@ -19,5 +19,10 @@ def available_agents() -> str:
     return "\n".join(lines)
 
 
-if __name__ == "__main__":
+def main() -> None:
+    """Console-script entry (``sheeprl-agents``, reference pyproject.toml:60)."""
     print(available_agents())
+
+
+if __name__ == "__main__":
+    main()

@@ -89,14 +89,14 @@ def test_ppo_checkpoint_written(standard_args, tmp_path, monkeypatch):
     assert len(ckpts) >= 1
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_a2c(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_a2c(standard_args, env_id, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=a2c",
         "env=dummy",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "algo.rollout_steps=4",
         "algo.per_rank_batch_size=2",
         "algo.mlp_keys.encoder=[state]",
@@ -165,13 +165,14 @@ def test_sac_rejects_discrete(standard_args, tmp_path, monkeypatch):
         _run(args)
 
 
-def test_droq(standard_args, tmp_path, monkeypatch):
+@pytest.mark.parametrize("devices", [1, 2])
+def test_droq(standard_args, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=droq",
         "env=dummy",
         "env.id=continuous_dummy",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "algo.per_rank_batch_size=2",
         "algo.learning_starts=0",
         "algo.hidden_size=8",
@@ -183,13 +184,14 @@ def test_droq(standard_args, tmp_path, monkeypatch):
     _run(args)
 
 
-def test_sac_ae(standard_args, tmp_path, monkeypatch):
+@pytest.mark.parametrize("devices", [1, 2])
+def test_sac_ae(standard_args, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=sac_ae",
         "env=dummy",
         "env.id=continuous_dummy",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "algo.per_rank_batch_size=2",
         "algo.learning_starts=0",
         "algo.hidden_size=8",
@@ -207,14 +209,14 @@ def test_sac_ae(standard_args, tmp_path, monkeypatch):
     _run(args)
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_ppo_recurrent(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_ppo_recurrent(standard_args, env_id, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=ppo_recurrent",
         "env=dummy",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "algo.rollout_steps=8",
         "algo.per_rank_sequence_length=4",
         "algo.per_rank_num_batches=2",
@@ -409,15 +411,15 @@ _P2E_DV1_TINY = [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_p2e_dv1(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_p2e_dv1(standard_args, env_id, devices, tmp_path, monkeypatch):
     """Exploration phase then finetuning from its checkpoint (reference
     tests/test_algos/test_algos.py p2e flow)."""
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=p2e_dv1_exploration",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "checkpoint.save_last=True",
     ] + _P2E_DV1_TINY
     _run(args)
@@ -430,7 +432,7 @@ def test_p2e_dv1(standard_args, env_id, tmp_path, monkeypatch):
     args = standard_args + [
         "exp=p2e_dv1_finetuning",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV1_TINY
     _run(args)
@@ -442,15 +444,15 @@ _P2E_DV2_TINY = _P2E_DV1_TINY + [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_p2e_dv2(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_p2e_dv2(standard_args, env_id, devices, tmp_path, monkeypatch):
     """Exploration phase then finetuning from its checkpoint (reference
     tests/test_algos/test_algos.py p2e flow)."""
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=p2e_dv2_exploration",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "checkpoint.save_last=True",
     ] + _P2E_DV2_TINY
     _run(args)
@@ -463,7 +465,7 @@ def test_p2e_dv2(standard_args, env_id, tmp_path, monkeypatch):
     args = standard_args + [
         "exp=p2e_dv2_finetuning",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV2_TINY
     _run(args)
@@ -478,15 +480,15 @@ _P2E_DV3_TINY = _P2E_DV2_TINY + [
 ]
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_p2e_dv3(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_p2e_dv3(standard_args, env_id, devices, tmp_path, monkeypatch):
     """Exploration phase then finetuning from its checkpoint (reference
     tests/test_algos/test_algos.py p2e flow)."""
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=p2e_dv3_exploration",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "checkpoint.save_last=True",
     ] + _P2E_DV3_TINY
     _run(args)
@@ -511,20 +513,20 @@ def test_p2e_dv3(standard_args, env_id, tmp_path, monkeypatch):
     args = standard_args + [
         "exp=p2e_dv3_finetuning",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         f"checkpoint.exploration_ckpt_path={ckpts[0]}",
     ] + _P2E_DV3_TINY
     _run(args)
 
 
-@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
-def test_dream_and_ponder(standard_args, env_id, tmp_path, monkeypatch):
+@pytest.mark.parametrize("env_id,devices", [("discrete_dummy", 1), ("continuous_dummy", 1), ("discrete_dummy", 2)])
+def test_dream_and_ponder(standard_args, env_id, devices, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
         "exp=dream_and_ponder",
         "env=dummy",
         f"env.id={env_id}",
-        "fabric.devices=1",
+        f"fabric.devices={devices}",
         "algo.per_rank_batch_size=1",
         "algo.per_rank_sequence_length=1",
         "buffer.size=4",
