@@ -1,0 +1,82 @@
+"""Run a pixel-target learning demonstration and emit a reward-vs-step curve.
+
+Trains via the real CLI, scrapes the per-episode reward lines the training
+loops print (``Rank-0: policy_step=N, reward_env_i=R``), and writes
+``benchmarks/results/<name>_curve.csv`` (+ a PNG with a running mean). A
+timeout still yields a partial curve from whatever output was captured.
+
+Usage: python scripts/train_curve.py <name> <timeout_s> <override> [...]
+e.g.:  python scripts/train_curve.py dreamer_v1_pixel_target 5400 exp=dreamer_v1_pixel_target
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+
+_LINE = re.compile(r"policy_step=(\d+), reward_env_\d+=([-\d.]+)")
+
+
+def parse_curve(text: str):
+    return [(int(m.group(1)), float(m.group(2))) for m in _LINE.finditer(text)]
+
+
+def write_outputs(name: str, points, out_dir: str) -> None:
+    os.makedirs(out_dir, exist_ok=True)
+    csv_path = os.path.join(out_dir, f"{name}_curve.csv")
+    with open(csv_path, "w") as f:
+        for step, rew in points:
+            f.write(f"{step},{rew}\n")
+    print(f"wrote {csv_path} ({len(points)} episodes)")
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+        import numpy as np
+
+        steps = np.array([p[0] for p in points])
+        rews = np.array([p[1] for p in points])
+        k = max(1, len(rews) // 50)
+        running = np.convolve(rews, np.ones(k) / k, mode="valid")
+        fig, ax = plt.subplots(figsize=(7, 4))
+        ax.plot(steps, rews, ".", ms=2, alpha=0.25, label="episode reward")
+        ax.plot(steps[k - 1 :], running, lw=2, label=f"running mean (k={k})")
+        ax.set_xlabel("policy step")
+        ax.set_ylabel("episode reward")
+        ax.set_title(name)
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(os.path.join(out_dir, f"{name}_curve.png"), dpi=120)
+        print(f"wrote {name}_curve.png")
+    except Exception as e:  # PNG is best-effort; the CSV is the artifact
+        print(f"PNG skipped: {type(e).__name__}: {e}")
+
+
+def main() -> None:
+    name, timeout_s, overrides = sys.argv[1], int(sys.argv[2]), sys.argv[3:]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cmd = [sys.executable, os.path.join(repo, "sheeprl.py")] + overrides + ["metric.log_level=1"]
+    try:
+        proc = subprocess.run(cmd, cwd=repo, capture_output=True, text=True, timeout=timeout_s)
+        out = (proc.stdout or "") + (proc.stderr or "")
+        status = proc.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        out += (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        status = "timeout"
+    points = parse_curve(out)
+    write_outputs(name, points, os.path.join(repo, "benchmarks", "results"))
+    print(f"run status: {status}, episodes: {len(points)}")
+    if status not in (0, "timeout"):
+        # always surface the failure, even with a partial curve in hand
+        tail = "\n".join(l for l in out.splitlines() if "cpu_aot_loader" not in l)
+        print(f"--- run tail ---\n{tail[-4000:]}", file=sys.stderr)
+    if not points:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.sac.agent import SACActor, SACParams, SACPlayer, init_sac_params
+from sheeprl_tpu.algos.sac.agent import SACActor, SACParams, SACPlayer, action_scale_bias, init_sac_params
 from sheeprl_tpu.models.models import MLP
 
 
@@ -73,7 +73,6 @@ def build_agent(
         if not isinstance(params, SACParams):
             params = SACParams(*params) if isinstance(params, (tuple, list)) else SACParams(**params)
     params = runtime.place_params(params)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
     player = SACPlayer(actor, params.actor, action_scale, action_bias)
     return actor, critic, params, player

@@ -24,6 +24,23 @@ LOG_STD_MAX = 2
 LOG_STD_MIN = -5
 
 
+def action_scale_bias(low, high) -> Tuple[jax.Array, jax.Array]:
+    """tanh-squash affine from box bounds, with non-finite bounds masked.
+
+    ``(high-low)/2`` and ``(high+low)/2`` on an unbounded dim produce inf and
+    inf-inf=NaN (a RuntimeWarning factory that would drown real NaN regressions
+    in CI logs); an unbounded dim gets the identity map (scale 1, bias 0)
+    instead — tanh already keeps the raw action finite.
+    """
+    low = np.asarray(low, dtype=np.float32)
+    high = np.asarray(high, dtype=np.float32)
+    bounded = np.isfinite(low) & np.isfinite(high)
+    with np.errstate(invalid="ignore", over="ignore"):
+        scale = np.where(bounded, (high - low) / 2.0, 1.0).astype(np.float32)
+        bias = np.where(bounded, (high + low) / 2.0, 0.0).astype(np.float32)
+    return jnp.asarray(scale), jnp.asarray(bias)
+
+
 class SACActor(nn.Module):
     action_dim: int
     hidden_size: int = 256
@@ -33,11 +50,11 @@ class SACActor(nn.Module):
 
     @property
     def action_scale(self):
-        return jnp.asarray((np.asarray(self.action_high) - np.asarray(self.action_low)) / 2.0, dtype=jnp.float32)
+        return action_scale_bias(self.action_low, self.action_high)[0]
 
     @property
     def action_bias(self):
-        return jnp.asarray((np.asarray(self.action_high) + np.asarray(self.action_low)) / 2.0, dtype=jnp.float32)
+        return action_scale_bias(self.action_low, self.action_high)[1]
 
     @nn.compact
     def __call__(self, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
@@ -180,7 +197,8 @@ def build_agent(
         if not isinstance(params, SACParams):
             params = SACParams(**params) if isinstance(params, dict) else params
     params = runtime.place_params(params)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    _scale, _bias = action_scale_bias(action_space.low, action_space.high)
+    action_scale = jnp.asarray(_scale)
+    action_bias = jnp.asarray(_bias)
     player = SACPlayer(actor, params.actor, action_scale, action_bias)
     return actor, critic, params, player

@@ -24,6 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.sac.agent import (
     SACParams,
+    action_scale_bias,
     actor_action_and_log_prob,
     build_agent,
     ensemble_q_values,
@@ -197,8 +198,7 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     act_dim = prod(action_space.shape)
     target_entropy = jnp.float32(-act_dim)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
 
     policy_steps_per_iter = int(n_envs)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1

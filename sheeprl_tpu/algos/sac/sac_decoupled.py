@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.agent import action_scale_bias, build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.config import instantiate
@@ -133,8 +133,7 @@ def main(runtime, cfg: Dict[str, Any]):
         player.params = transport.params_to_player(params.actor)
     act_dim = prod(action_space.shape)
     target_entropy = jnp.float32(-act_dim)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
 
     policy_steps_per_iter = int(n_envs)
     ema_every = int(cfg.algo.critic.target_network_frequency) // policy_steps_per_iter + 1

@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.sac.agent import actor_action_and_log_prob
+from sheeprl_tpu.algos.sac.agent import action_scale_bias, actor_action_and_log_prob
 from sheeprl_tpu.models.models import CNN, MLP, DeCNN, LayerNorm
 from sheeprl_tpu.utils.utils import host_float32
 
@@ -371,8 +371,7 @@ def build_agent(
         if not isinstance(params, SACAEParams):
             params = SACAEParams(*params) if isinstance(params, (tuple, list)) else SACAEParams(**params)
     params = runtime.place_params(params)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
     player = SACAEPlayer(encoder, actor_head, params, action_scale, action_bias)
     modules = {"encoder": encoder, "decoder": decoder, "qf": qf, "actor_head": actor_head}
     return modules, params, player

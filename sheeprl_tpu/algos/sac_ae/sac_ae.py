@@ -21,7 +21,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.algos.sac.agent import actor_action_and_log_prob
+from sheeprl_tpu.algos.sac.agent import action_scale_bias, actor_action_and_log_prob
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import SACAEParams, build_agent
 from sheeprl_tpu.algos.sac_ae.utils import prepare_obs, preprocess_obs, test
@@ -260,8 +260,7 @@ def main(runtime, cfg: Dict[str, Any]):
     )
     act_dim = prod(action_space.shape)
     target_entropy = jnp.float32(-act_dim)
-    action_scale = jnp.asarray((action_space.high - action_space.low) / 2.0, dtype=jnp.float32)
-    action_bias = jnp.asarray((action_space.high + action_space.low) / 2.0, dtype=jnp.float32)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
 
     params_sync = PlayerParamsSync((player.encoder_params, player.actor_params))
     init_opt, train_fn = make_train_fn(

@@ -38,8 +38,8 @@ def _native_seq_gather():
     return seq_gather if native_available() else None
 
 _MEMMAP_ERR = (
-    'Accepted values for memmap_mode are "r+", "readwrite", "w+", "write", "c" or '
-    '"copyonwrite". Read-only modes are not supported for replay buffers.'
+    'memmap_mode must be one of the writable modes ("r+"/"readwrite", "w+"/"write", '
+    '"c"/"copyonwrite") — a read-only mapping cannot back a replay buffer'
 )
 
 
@@ -79,22 +79,21 @@ def get_array(
 
 def _validate_added_data(data: Dict[str, np.ndarray]) -> None:
     if not isinstance(data, dict):
-        raise ValueError(f"'data' must be a dictionary containing Numpy arrays, but 'data' is of type '{type(data)}'")
+        raise ValueError(f"expected a dict of numpy arrays to add, not a {type(data)}")
     for k, v in data.items():
         if not isinstance(v, np.ndarray):
             raise ValueError(
-                f"'data' must be a dictionary containing Numpy arrays. Found key '{k}' "
-                f"containing a value of type '{type(v)}'"
+                f"expected a dict of numpy arrays to add; key '{k}' holds a {type(v)} instead"
             )
     shapes = {k: v.shape[:2] for k, v in data.items() if len(v.shape) >= 2}
     for k, v in data.items():
         if len(v.shape) < 2:
             raise RuntimeError(
-                f"'data' must have at least 2 dimensions: [sequence_length, n_envs, ...]. Shape of '{k}' is {v.shape}"
+                f"added arrays need a [time, env, ...] layout (>= 2 dims); '{k}' arrived with shape {v.shape}"
             )
     if len(set(shapes.values())) > 1:
         raise RuntimeError(
-            f"Every array in 'data' must be congruent in the first 2 dimensions, got: "
+            f"all added arrays must agree on their leading [time, env] dims; got "
             f"{ {k: s for k, s in shapes.items()} }"
         )
 
@@ -119,9 +118,9 @@ class ReplayBuffer:
         **kwargs: Any,
     ):
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"a replay buffer needs a positive capacity; received buffer_size={buffer_size}")
         if n_envs <= 0:
-            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+            raise ValueError(f"a replay buffer needs at least one env stream; received n_envs={n_envs}")
         self._buffer_size = buffer_size
         self._n_envs = n_envs
         self._obs_keys = tuple(obs_keys)
@@ -133,8 +132,7 @@ class ReplayBuffer:
                 raise ValueError(_MEMMAP_ERR)
             if memmap_dir is None:
                 raise ValueError(
-                    "The buffer is set to be memory-mapped but the 'memmap_dir' attribute is None. "
-                    "Set the 'memmap_dir' to a known directory."
+                    "memmap=True needs a target directory: pass memmap_dir (it is currently None)"
                 )
             self._memmap_dir = Path(memmap_dir)
             self._memmap_dir.mkdir(parents=True, exist_ok=True)
@@ -211,23 +209,21 @@ class ReplayBuffer:
 
     def __getitem__(self, key: str) -> Union[np.ndarray, MemmapArray]:
         if not isinstance(key, str):
-            raise TypeError("'key' must be a string")
+            raise TypeError("buffer keys are strings; got a non-string key")
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("empty buffer: nothing has been added yet, so there is no storage to read")
         return self._buf.get(key)
 
     def __setitem__(self, key: str, value: Union[np.ndarray, np.memmap, MemmapArray]) -> None:
         if not isinstance(value, (np.ndarray, MemmapArray)):
             raise ValueError(
-                f"The value to be set must be an instance of 'np.ndarray', 'np.memmap' or 'MemmapArray', "
-                f"got {type(value)}"
+                f"only ndarray/memmap/MemmapArray values can be stored; got {type(value)}"
             )
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("empty buffer: nothing has been added yet, so there is no storage to read")
         if value.shape[:2] != (self._buffer_size, self._n_envs):
             raise RuntimeError(
-                "'value' must have at least two dimensions of dimension [buffer_size, n_envs, ...]. "
-                f"Shape of 'value' is {value.shape}"
+                f"stored arrays need a [capacity, env, ...] layout (>= 2 dims); got shape {value.shape}"
             )
         if self._memmap:
             filename = value.filename if isinstance(value, MemmapArray) else Path(self._memmap_dir) / f"{key}.memmap"
@@ -257,10 +253,10 @@ class ReplayBuffer:
         never crosses the write head (reference buffers.py:223-268).
         """
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"sampling needs positive batch_size and n_samples; got batch_size={batch_size}, n_samples={n_samples}")
         if not self._full and self._pos == 0:
             raise ValueError(
-                "No sample has been added to the buffer. Please add at least one sample calling 'self.add()'"
+                "cannot sample from an empty buffer: add at least one transition first"
             )
         if self._full:
             first_range_end = self._pos - 1 if sample_next_obs else self._pos
@@ -273,8 +269,7 @@ class ReplayBuffer:
             max_pos = self._pos - 1 if sample_next_obs else self._pos
             if max_pos == 0:
                 raise RuntimeError(
-                    "You want to sample the next observations, but one sample has been added to the buffer. "
-                    "Make sure that at least two samples are added."
+                    "sample_next_obs needs two stored steps (obs and its successor); the buffer holds only one"
                 )
             batch_idxes = self._rng.integers(0, max_pos, size=(batch_size * n_samples,), dtype=np.intp)
         flat = self._gather(batch_idxes, sample_next_obs=sample_next_obs, clone=clone)
@@ -282,7 +277,7 @@ class ReplayBuffer:
 
     def _gather(self, batch_idxes: np.ndarray, sample_next_obs: bool = False, clone: bool = False):
         if self.empty:
-            raise RuntimeError("The buffer has not been initialized. Try to add some data first.")
+            raise RuntimeError("empty buffer: nothing has been added yet, so there is no storage to read")
         env_idxes = self._rng.integers(0, self._n_envs, size=(len(batch_idxes),), dtype=np.intp)
         flat_idx = batch_idxes * self._n_envs + env_idxes
         if sample_next_obs:
@@ -373,16 +368,16 @@ class SequentialReplayBuffer(ReplayBuffer):
     ) -> Dict[str, np.ndarray]:
         batch_dim = batch_size * n_samples
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"sampling needs positive batch_size and n_samples; got batch_size={batch_size}, n_samples={n_samples}")
         if not self._full and self._pos == 0:
             raise ValueError(
-                "No sample has been added to the buffer. Please add at least one sample calling 'self.add()'"
+                "cannot sample from an empty buffer: add at least one transition first"
             )
         if not self._full and self._pos - sequence_length + 1 < 1:
-            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: {self._pos}")
+            raise ValueError(f"not enough history for sequence_length={sequence_length}: only {self._pos} steps stored")
         if self._full and sequence_length > self._buffer_size:
             raise ValueError(
-                f"The sequence length ({sequence_length}) is greater than the buffer size ({self._buffer_size})"
+                f"sequence_length={sequence_length} cannot exceed the buffer capacity ({self._buffer_size})"
             )
         if self._full:
             first_range_end = self._pos - sequence_length + 1
@@ -486,16 +481,15 @@ class EnvIndependentReplayBuffer:
         **kwargs: Any,
     ):
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"a replay buffer needs a positive capacity; received buffer_size={buffer_size}")
         if n_envs <= 0:
-            raise ValueError(f"The number of environments must be greater than zero, got: {n_envs}")
+            raise ValueError(f"a replay buffer needs at least one env stream; received n_envs={n_envs}")
         if memmap:
             if memmap_mode not in ("r+", "w+", "c", "copyonwrite", "readwrite", "write"):
                 raise ValueError(_MEMMAP_ERR)
             if memmap_dir is None:
                 raise ValueError(
-                    "The buffer is set to be memory-mapped but the 'memmap_dir' attribute is None. "
-                    "Set the 'memmap_dir' to a known directory."
+                    "memmap=True needs a target directory: pass memmap_dir (it is currently None)"
                 )
             memmap_dir = Path(memmap_dir)
             memmap_dir.mkdir(parents=True, exist_ok=True)
@@ -560,8 +554,8 @@ class EnvIndependentReplayBuffer:
             indices = tuple(range(self._n_envs))
         elif len(indices) != next(iter(data.values())).shape[1]:
             raise ValueError(
-                f"The length of 'indices' ({len(indices)}) must be equal to the second dimension of the "
-                f"arrays in 'data' ({next(iter(data.values())).shape[1]})"
+                f"got {len(indices)} env indices for arrays carrying "
+                f"{next(iter(data.values())).shape[1]} env columns; they must match"
             )
         for data_col, env_idx in enumerate(indices):
             self._buf[env_idx].add({k: v[:, data_col : data_col + 1] for k, v in data.items()}, validate_args)
@@ -588,7 +582,7 @@ class EnvIndependentReplayBuffer:
         **kwargs: Any,
     ) -> Dict[str, np.ndarray]:
         if batch_size <= 0 or n_samples <= 0:
-            raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
+            raise ValueError(f"sampling needs positive batch_size and n_samples; got batch_size={batch_size}, n_samples={n_samples}")
         bs_per_buf = np.bincount(self._rng.integers(0, self._n_envs, (batch_size,)))
         parts = [
             b.sample(batch_size=bs, sample_next_obs=sample_next_obs, clone=clone, n_samples=n_samples, **kwargs)
@@ -650,13 +644,13 @@ class EpisodeBuffer:
         seed: Optional[int] = None,
     ) -> None:
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"a replay buffer needs a positive capacity; received buffer_size={buffer_size}")
         if minimum_episode_length <= 0:
-            raise ValueError(f"The sequence length must be greater than zero, got: {minimum_episode_length}")
+            raise ValueError(f"the minimum episode length must be positive; received {minimum_episode_length}")
         if buffer_size < minimum_episode_length:
             raise ValueError(
-                "The sequence length must be lower than the buffer size, "
-                f"got: bs = {buffer_size} and sl = {minimum_episode_length}"
+                f"the minimum episode length ({minimum_episode_length}) must fit inside the "
+                f"buffer capacity ({buffer_size})"
             )
         self._n_envs = n_envs
         self._obs_keys = tuple(obs_keys)
@@ -675,8 +669,7 @@ class EpisodeBuffer:
                 raise ValueError(_MEMMAP_ERR)
             if memmap_dir is None:
                 raise ValueError(
-                    "The buffer is set to be memory-mapped but the `memmap_dir` attribute is None. "
-                    "Set the `memmap_dir` to a known directory."
+                    "memmap=True needs a target directory: pass memmap_dir (it is currently None)"
                 )
             self._memmap_dir = Path(memmap_dir)
             self._memmap_dir.mkdir(parents=True, exist_ok=True)
@@ -733,15 +726,15 @@ class EpisodeBuffer:
             data = data.buffer
         if validate_args:
             if data is None:
-                raise ValueError("The `data` replay buffer must be not None")
+                raise ValueError("cannot add a None transition to the episode buffer")
             _validate_added_data(data)
-            if "terminated" not in data and "truncated" not in data:
+            if "terminated" not in data or "truncated" not in data:
                 raise RuntimeError(
-                    f"The episode must contain the `terminated` and the `truncated` keys, got: {data.keys()}"
+                    f"episode steps need both 'terminated' and 'truncated' flags; received keys {data.keys()}"
                 )
             if env_idxes is not None and (np.array(env_idxes) >= self._n_envs).any():
                 raise ValueError(
-                    f"The indices of the environment must be integers in [0, {self._n_envs}), given {env_idxes}"
+                    f"env indices must be ints within [0, {self._n_envs}); received {env_idxes}"
                 )
         if env_idxes is None:
             env_idxes = range(self._n_envs)
@@ -770,20 +763,21 @@ class EpisodeBuffer:
 
     def _save_episode(self, episode_chunks: Sequence[Dict[str, np.ndarray]]) -> None:
         if len(episode_chunks) == 0:
-            raise RuntimeError("Invalid episode, an empty sequence is given. You must pass a non-empty sequence.")
+            raise RuntimeError("refusing to store a zero-length episode")
         episode = {
             k: np.concatenate([chunk[k] for chunk in episode_chunks], axis=0) for k in episode_chunks[0].keys()
         }
         ends = np.logical_or(episode["terminated"], episode["truncated"])
         ep_len = ends.shape[0]
-        if len(ends.nonzero()[0]) != 1 or not ends[-1]:
-            raise RuntimeError(f"The episode must contain exactly one done, got: {len(np.nonzero(ends))}")
+        n_dones = len(ends.nonzero()[0])
+        if n_dones != 1 or not ends[-1]:
+            raise RuntimeError(f"a stored episode must end exactly once; this one has {n_dones} done flags")
         if ep_len < self._minimum_episode_length:
             raise RuntimeError(
-                f"Episode too short (at least {self._minimum_episode_length} steps), got: {ep_len} steps"
+                f"episode of {ep_len} steps is below the {self._minimum_episode_length}-step minimum"
             )
         if ep_len > self._buffer_size:
-            raise RuntimeError(f"Episode too long (at most {self._buffer_size} steps), got: {ep_len} steps")
+            raise RuntimeError(f"episode of {ep_len} steps exceeds the buffer capacity of {self._buffer_size}")
 
         if self.full or len(self) + ep_len > self._buffer_size:
             cum = np.array(self._cum_lengths)
@@ -824,16 +818,16 @@ class EpisodeBuffer:
         **kwargs: Any,
     ) -> Dict[str, np.ndarray]:
         if batch_size <= 0:
-            raise ValueError(f"Batch size must be greater than 0, got: {batch_size}")
+            raise ValueError(f"sampling needs a positive batch_size; received {batch_size}")
         if n_samples <= 0:
-            raise ValueError(f"The number of samples must be greater than 0, got: {n_samples}")
+            raise ValueError(f"sampling needs a positive n_samples; received {n_samples}")
         lengths = np.array(self._cum_lengths) - np.array([0] + self._cum_lengths[:-1])
         valid_mask = lengths > sequence_length if sample_next_obs else lengths >= sequence_length
         valid_episodes = list(compress(self._buf, valid_mask))
         if len(valid_episodes) == 0:
             raise RuntimeError(
-                "No valid episodes has been added to the buffer. Please add at least one episode of length greater "
-                f"than or equal to {sequence_length} calling `self.add()`"
+                f"no stored episode is long enough to cut a {sequence_length}-step window from; "
+                "add longer episodes first"
             )
         offsets = np.arange(sequence_length, dtype=np.intp)[None, :]
         counts = np.bincount(self._rng.integers(0, len(valid_episodes), (batch_size * n_samples,))).astype(np.intp)

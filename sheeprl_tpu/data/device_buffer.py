@@ -93,7 +93,7 @@ class DeviceSequentialReplayBuffer:
         device: Optional[Any] = None,
     ):
         if buffer_size <= 0:
-            raise ValueError(f"The buffer size must be greater than zero, got: {buffer_size}")
+            raise ValueError(f"a replay buffer needs a positive capacity; received buffer_size={buffer_size}")
         self._buffer_size = int(buffer_size)
         self._n_envs = int(n_envs)
         self._device = device

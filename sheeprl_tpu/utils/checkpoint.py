@@ -12,10 +12,19 @@ from __future__ import annotations
 import contextlib
 import os
 import pickle
-from typing import Any, Dict, List, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+# Versioned container format. v1 wraps the legacy bare-pickle state with a
+# manifest (leaf path -> shape/dtype) and a CRC of the serialized state, so a
+# truncated write, bit rot, or a state-dict refactor fails LOUDLY at resume
+# instead of silently training from garbage. Legacy bare-dict checkpoints
+# (rounds <= 3) still load.
+_CKPT_MAGIC = "sheeprl_tpu_ckpt"
+CKPT_FORMAT_VERSION = 1
 
 
 def _to_host(tree):
@@ -27,18 +36,117 @@ def _to_host(tree):
     return jax.tree_util.tree_map(conv, tree, is_leaf=lambda x: isinstance(x, jax.Array))
 
 
+def _manifest(tree) -> Dict[str, Tuple[Tuple[int, ...], str]]:
+    """``{leaf path: (shape, dtype)}`` for every array leaf of the state."""
+    out: Dict[str, Tuple[Tuple[int, ...], str]] = {}
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in leaves:
+        if isinstance(leaf, np.ndarray):
+            out[jax.tree_util.keystr(path)] = (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+    return out
+
+
+class _CrcWriter:
+    """File wrapper computing a running CRC of everything written through it,
+    so the state pickle streams straight to disk (a ``pickle.dumps`` staging
+    buffer would double peak RAM for multi-GB buffer-in-checkpoint states)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+
+    def write(self, b):
+        self.crc = zlib.crc32(b, self.crc)
+        return self._f.write(b)
+
+
+class _CrcReader:
+    """File wrapper computing a running CRC of everything read through it.
+    Pickle protocol >= 4 frames its stream, so ``pickle.load`` reads exactly
+    the state pickle's bytes and the CRC covers precisely that span."""
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+
+    def read(self, n=-1):
+        b = self._f.read(n)
+        self.crc = zlib.crc32(b, self.crc)
+        return b
+
+    def readline(self, n=-1):
+        b = self._f.readline(n)
+        self.crc = zlib.crc32(b, self.crc)
+        return b
+
+
 def save_state(path: str, state: Dict[str, Any]) -> None:
+    """Layout: header pickle (magic/version/manifest), state pickle (streamed
+    through a CRC), footer pickle ({"crc32": ...})."""
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     host_state = _to_host(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        header = {
+            "__format__": _CKPT_MAGIC,
+            "format_version": CKPT_FORMAT_VERSION,
+            "manifest": _manifest(host_state),
+        }
+        pickle.dump(header, f, protocol=pickle.HIGHEST_PROTOCOL)
+        writer = _CrcWriter(f)
+        pickle.dump(host_state, writer, protocol=pickle.HIGHEST_PROTOCOL)
+        pickle.dump({"crc32": writer.crc}, f, protocol=pickle.HIGHEST_PROTOCOL)
     os.replace(tmp, path)
 
 
-def load_state(path: str) -> Dict[str, Any]:
+def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
+    """The stored leaf manifest (None for legacy bare-pickle checkpoints)."""
     with open(path, "rb") as f:
-        return pickle.load(f)
+        obj = pickle.load(f)
+    if isinstance(obj, dict) and obj.get("__format__") == _CKPT_MAGIC:
+        return obj.get("manifest")
+    return None
+
+
+def load_state(path: str) -> Dict[str, Any]:
+    try:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+            if not (isinstance(obj, dict) and obj.get("__format__") == _CKPT_MAGIC):
+                return obj  # legacy bare-dict checkpoint (rounds <= 3)
+            version = obj.get("format_version")
+            if not isinstance(version, int) or version > CKPT_FORMAT_VERSION:
+                raise RuntimeError(
+                    f"Checkpoint '{path}' has format_version {version}; this build reads "
+                    f"<= {CKPT_FORMAT_VERSION}. Load it with the sheeprl_tpu version that wrote it."
+                )
+            reader = _CrcReader(f)
+            state = pickle.load(reader)
+            footer = pickle.load(f)
+    except RuntimeError:
+        raise
+    except (EOFError, pickle.UnpicklingError, UnicodeDecodeError, ValueError, KeyError, IndexError) as e:
+        raise RuntimeError(
+            f"Checkpoint '{path}' is unreadable (truncated, corrupt, or not a checkpoint): {e}"
+        ) from e
+    if reader.crc != footer.get("crc32"):
+        raise RuntimeError(
+            f"Checkpoint '{path}' failed its integrity check (CRC mismatch): the file "
+            "is corrupt (truncated copy, bit rot, or a partial write)."
+        )
+    stored = obj.get("manifest")
+    if stored is not None:
+        actual = _manifest(state)
+        if stored != actual:
+            diff = sorted(set(stored) ^ set(actual))[:5] or [
+                k for k in sorted(stored) if stored[k] != actual.get(k)
+            ][:5]
+            raise RuntimeError(
+                f"Checkpoint '{path}' state does not match its manifest "
+                f"(first differing leaves: {diff}); refusing to resume from an "
+                "inconsistent checkpoint."
+            )
+    return state
 
 
 class CheckpointCallback:

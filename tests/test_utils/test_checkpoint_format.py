@@ -1,0 +1,104 @@
+"""Versioned checkpoint container: integrity + manifest + version gating.
+
+Reference parity is plain ``torch.save`` pickles; the TPU build adds a format
+version, a leaf manifest, and a CRC so resume fails loudly on corrupt or
+inconsistent checkpoints instead of silently training from garbage.
+"""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import (
+    CKPT_FORMAT_VERSION,
+    load_state,
+    read_manifest,
+    save_state,
+)
+
+
+def _state():
+    return {
+        "agent": {"dense": {"kernel": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}},
+        "iter_num": 7,
+        "rewards": np.ones((4, 1), np.float32),
+    }
+
+
+def test_roundtrip_and_manifest(tmp_path):
+    path = str(tmp_path / "ckpt.ckpt")
+    save_state(path, _state())
+    state = load_state(path)
+    np.testing.assert_array_equal(
+        np.asarray(state["agent"]["dense"]["kernel"]), np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+    assert state["iter_num"] == 7
+    manifest = read_manifest(path)
+    assert manifest is not None
+    assert any("kernel" in k for k in manifest)
+    kern_key = next(k for k in manifest if "kernel" in k)
+    assert manifest[kern_key] == ((2, 3), "float32")
+
+
+def test_corrupt_payload_raises(tmp_path):
+    path = str(tmp_path / "ckpt.ckpt")
+    save_state(path, _state())
+    raw = bytearray(open(path, "rb").read())
+    # flip a byte well inside the embedded state payload
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises((RuntimeError, pickle.UnpicklingError), match="integrity|corrupt|unreadable|pickle"):
+        load_state(path)
+
+
+def test_truncated_file_raises(tmp_path):
+    path = str(tmp_path / "ckpt.ckpt")
+    save_state(path, _state())
+    raw = open(path, "rb").read()
+    open(path, "wb").write(raw[: len(raw) // 3])
+    with pytest.raises(RuntimeError, match="unreadable|truncated"):
+        load_state(path)
+
+
+def test_future_format_version_raises(tmp_path):
+    path = str(tmp_path / "ckpt.ckpt")
+    with open(path, "wb") as f:
+        pickle.dump(
+            {"__format__": "sheeprl_tpu_ckpt", "format_version": CKPT_FORMAT_VERSION + 1, "manifest": {}},
+            f,
+        )
+        pickle.dump({"x": 1}, f)
+        pickle.dump({"crc32": 0}, f)
+    with pytest.raises(RuntimeError, match="format_version"):
+        load_state(path)
+
+
+def test_manifest_mismatch_raises(tmp_path):
+    import zlib
+
+    path = str(tmp_path / "ckpt.ckpt")
+    payload = pickle.dumps({"agent": np.zeros((2, 2), np.float32)}, protocol=pickle.HIGHEST_PROTOCOL)
+    with open(path, "wb") as f:
+        pickle.dump(
+            {
+                "__format__": "sheeprl_tpu_ckpt",
+                "format_version": CKPT_FORMAT_VERSION,
+                # manifest claims a different shape than the state actually holds
+                "manifest": {"['agent']": ((4, 4), "float32")},
+            },
+            f,
+        )
+        f.write(payload)
+        pickle.dump({"crc32": zlib.crc32(payload)}, f)
+    with pytest.raises(RuntimeError, match="manifest"):
+        load_state(path)
+
+
+def test_legacy_bare_pickle_still_loads(tmp_path):
+    path = str(tmp_path / "legacy.ckpt")
+    with open(path, "wb") as f:
+        pickle.dump({"iter_num": 3, "agent": np.ones((2,), np.float32)}, f)
+    state = load_state(path)
+    assert state["iter_num"] == 3
