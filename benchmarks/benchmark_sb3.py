@@ -1,0 +1,68 @@
+"""Stable-Baselines3 comparison harness (reference benchmarks/benchmark_sb3.py:1).
+
+Times SB3 on the SAME workloads as this repo's benchmarks so the two frameworks
+can be compared on one machine:
+
+    python benchmarks/benchmark_sb3.py ppo   # CartPole-v1, 65_536 steps (cpu)
+    python benchmarks/benchmark_sb3.py a2c   # CartPole-v1, 65_536 steps (cpu)
+    python benchmarks/benchmark_sb3.py sac   # LunarLanderContinuous, 65_536 steps
+
+Prints one JSON line: {"algo", "sb3_seconds", "env_steps_per_sec", "eval_reward"}.
+The companion numbers come from `benchmarks/benchmark.py` / root `bench.py`
+(which anchor against the reference's published table when SB3 is absent —
+stable_baselines3 is an optional dependency and not part of the baked image).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+TOTAL_STEPS = 1024 * 64
+
+try:
+    import stable_baselines3 as sb3
+    from stable_baselines3 import A2C, PPO, SAC
+except ImportError:
+    print(
+        json.dumps(
+            {
+                "error": "stable_baselines3 is not installed; `pip install stable-baselines3` "
+                "to run the head-to-head comparison. The reference's published numbers "
+                "(SB3 v2.2.1 on 4 CPUs) are recorded in BASELINE.md: PPO 77.21s, "
+                "A2C 84.22s, SAC 336.06s for the same workloads."
+            }
+        )
+    )
+    sys.exit(0)
+
+import gymnasium as gym  # noqa: E402
+
+
+def bench(algo: str) -> dict:
+    t0 = time.perf_counter()
+    if algo == "ppo":
+        env = gym.make("CartPole-v1", render_mode="rgb_array")
+        model = PPO("MlpPolicy", env, verbose=0, device="cpu", n_steps=128)
+    elif algo == "a2c":
+        env = gym.make("CartPole-v1", render_mode="rgb_array")
+        model = A2C("MlpPolicy", env, verbose=0, device="cpu", vf_coef=1.0)
+    elif algo == "sac":
+        env = gym.make("LunarLanderContinuous-v3", render_mode="rgb_array")
+        model = SAC("MlpPolicy", env, verbose=0, device="cpu")
+    else:
+        raise SystemExit(f"unknown algo '{algo}'; choose ppo|a2c|sac")
+    model.learn(total_timesteps=TOTAL_STEPS, log_interval=None)
+    elapsed = time.perf_counter() - t0
+    mean_rew, _ = sb3.common.evaluation.evaluate_policy(model.policy, env)
+    return {
+        "algo": algo,
+        "sb3_seconds": round(elapsed, 2),
+        "env_steps_per_sec": round(TOTAL_STEPS / elapsed, 2),
+        "eval_reward": round(float(mean_rew), 2),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench(sys.argv[1] if len(sys.argv) > 1 else "ppo")))

@@ -16,7 +16,10 @@ import re
 import subprocess
 import sys
 
-_LINE = re.compile(r"policy_step=(\d+), reward_env_\d+=([-\d.]+)")
+_LINE = re.compile(
+    r"policy_step=(\d+), reward_env_\d+="
+    r"([-+]?(?:\d+\.?\d*(?:[eE][-+]?\d+)?|\.\d+(?:[eE][-+]?\d+)?|nan|inf))"
+)
 
 
 def parse_curve(text: str):

@@ -197,8 +197,6 @@ def build_agent(
         if not isinstance(params, SACParams):
             params = SACParams(**params) if isinstance(params, dict) else params
     params = runtime.place_params(params)
-    _scale, _bias = action_scale_bias(action_space.low, action_space.high)
-    action_scale = jnp.asarray(_scale)
-    action_bias = jnp.asarray(_bias)
+    action_scale, action_bias = action_scale_bias(action_space.low, action_space.high)
     player = SACPlayer(actor, params.actor, action_scale, action_bias)
     return actor, critic, params, player

@@ -123,11 +123,18 @@ def load_state(path: str) -> Dict[str, Any]:
             reader = _CrcReader(f)
             state = pickle.load(reader)
             footer = pickle.load(f)
+            if not isinstance(footer, dict):  # footer itself corrupted into something else
+                raise pickle.UnpicklingError(f"footer is a {type(footer).__name__}, not a dict")
     except RuntimeError:
         raise
-    except (EOFError, pickle.UnpicklingError, UnicodeDecodeError, ValueError, KeyError, IndexError) as e:
+    except Exception as e:
+        # Corruption inside a pickle stream surfaces as almost anything —
+        # UnpicklingError, EOFError, bad-opcode ModuleNotFoundError/AttributeError,
+        # struct.error, MemoryError from a corrupted frame length — so the whole
+        # parse is the corruption boundary, not an enumerable exception list.
         raise RuntimeError(
-            f"Checkpoint '{path}' is unreadable (truncated, corrupt, or not a checkpoint): {e}"
+            f"Checkpoint '{path}' is unreadable (truncated, corrupt, or not a checkpoint): "
+            f"{type(e).__name__}: {e}"
         ) from e
     if reader.crc != footer.get("crc32"):
         raise RuntimeError(

@@ -1,0 +1,103 @@
+"""Third-party algorithm packages register through the public decorator + search
+path, without touching the sheeprl_tpu tree (reference
+howto/register_external_algorithm.md + hydra_plugins search-path flow).
+"""
+
+import os
+import sys
+import textwrap
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.utils.registry import algorithm_registry
+
+
+def _write(path, content):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(textwrap.dedent(content))
+
+
+def test_external_algorithm_runs_through_cli(tmp_path, monkeypatch):
+    pkg_root = tmp_path / "ext_project"
+    marker = tmp_path / "ext_sota_ran.txt"
+
+    _write(str(pkg_root / "my_awesome_algo" / "__init__.py"), "")
+    _write(
+        str(pkg_root / "my_awesome_algo" / "ext_sota.py"),
+        f'''
+        from sheeprl_tpu.utils.registry import register_algorithm
+
+
+        @register_algorithm()
+        def main(runtime, cfg):
+            assert cfg.algo.name == "ext_sota"
+            assert cfg.algo.sota_rate == 0.5  # external algo config reached the entrypoint
+            with open({str(marker)!r}, "w") as f:
+                f.write(f"world={{runtime.world_size}}")
+        ''',
+    )
+    _write(
+        str(pkg_root / "my_awesome_algo" / "utils.py"),
+        """
+        AGGREGATOR_KEYS = set()
+        MODELS_TO_REGISTER = set()
+        """,
+    )
+    _write(
+        str(pkg_root / "my_awesome_configs" / "algo" / "ext_sota.yaml"),
+        """
+        defaults:
+          - default
+          - _self_
+        name: ext_sota
+        total_steps: 1000
+        per_rank_batch_size: 8
+        sota_rate: 0.5
+        """,
+    )
+    _write(
+        str(pkg_root / "my_awesome_configs" / "exp" / "ext_sota.yaml"),
+        """
+        # @package _global_
+        defaults:
+          - override /algo: ext_sota
+          - override /env: dummy
+          - _self_
+
+        buffer:
+          size: 64
+        """,
+    )
+
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.syspath_prepend(str(pkg_root))
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", f"file://{pkg_root / 'my_awesome_configs'}")
+    # the user's my_awesome_main.py imports the algo module before calling run()
+    import importlib
+
+    importlib.import_module("my_awesome_algo.ext_sota")
+    assert any("my_awesome_algo" in m for m in algorithm_registry)
+
+    try:
+        run(
+            overrides=[
+                "exp=ext_sota",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "fabric.accelerator=cpu",
+                "fabric.devices=1",
+                "dry_run=True",
+                "metric.log_level=0",
+                "checkpoint.save_last=False",
+            ]
+        )
+    finally:
+        # keep the registry clean for other tests in this process
+        for mod in [m for m in list(algorithm_registry) if "my_awesome_algo" in m]:
+            algorithm_registry.pop(mod, None)
+        sys.modules.pop("my_awesome_algo.ext_sota", None)
+        sys.modules.pop("my_awesome_algo.utils", None)
+        sys.modules.pop("my_awesome_algo", None)
+
+    assert marker.exists()
+    assert marker.read_text() == "world=1"
