@@ -67,13 +67,6 @@ CONFIGS = {
         ],
     ),
     "b128_iu15": dict(batch=128, extra_overrides=["algo.imagination_scan_unroll=15"]),
-    "b128_iu15_pallas": dict(
-        batch=128,
-        extra_overrides=[
-            "algo.imagination_scan_unroll=15",
-            "algo.world_model.recurrent_model.use_pallas_gru=True",
-        ],
-    ),
 }
 
 if __name__ == "__main__":

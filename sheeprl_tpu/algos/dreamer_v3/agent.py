@@ -305,7 +305,6 @@ class RecurrentModel(nn.Module):
     layer_norm_eps: float = 1e-3
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
-    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, recurrent_state: jax.Array) -> jax.Array:
@@ -329,7 +328,6 @@ class RecurrentModel(nn.Module):
             dtype=self.dtype,
             param_dtype=self.param_dtype,
             kernel_init=hafner_trunc_init,
-            use_pallas=self.use_pallas,
         )(feat, recurrent_state)
 
 
@@ -924,12 +922,6 @@ def build_agent(
         layer_norm_eps=rec_eps,
         dtype=compute_dtype,
         param_dtype=param_dtype,
-        # Optional fused Pallas GRU cell (ops/pallas/gru.py). Default off: measured
-        # on v5e at DV3-S imagination shapes, XLA's fused path is faster under the
-        # CLI's high matmul precision (see the gru.py module docstring). Only
-        # meaningful when this agent's mesh is actually on TPU.
-        use_pallas=bool(world_model_cfg.recurrent_model.get("use_pallas_gru", False))
-        and runtime.device.platform == "tpu",
     )
     decoupled = bool(world_model_cfg.get("decoupled_rssm", False))
     repr_input = encoder.output_dim + (0 if decoupled else recurrent_state_size)

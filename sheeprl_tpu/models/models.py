@@ -343,9 +343,6 @@ class LayerNormGRUCell(nn.Module):
     dtype: Dtype = jnp.float32
     param_dtype: Dtype = jnp.float32
     kernel_init: Optional[Callable] = None
-    # opt-in: the builder sets this when the agent's mesh is on TPU (the kernel
-    # can't see the target backend at trace time, so the caller decides)
-    use_pallas: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, h: jax.Array) -> jax.Array:
@@ -361,16 +358,6 @@ class LayerNormGRUCell(nn.Module):
         if self.layer_norm:
             ln_scale = self.param("ln_scale", nn.initializers.ones_init(), (n,), jnp.float32)
             ln_bias = self.param("ln_bias", nn.initializers.zeros_init(), (n,), jnp.float32)
-
-        # Fused Pallas kernel for the LN variant (the RSSM hot path): one VMEM
-        # round-trip for matmul+LN+gates, weights resident across the row grid.
-        if self.layer_norm and not self.bias and self.use_pallas and x.ndim == 2:
-            from sheeprl_tpu.ops.pallas import layer_norm_gru, pallas_gru_supported
-
-            if pallas_gru_supported(x.shape[0], x.shape[-1], self.hidden_size, self.dtype):
-                return layer_norm_gru(
-                    x, h, kernel, ln_scale, ln_bias, eps=self.layer_norm_eps
-                ).astype(self.dtype)
 
         xh = jnp.concatenate([h.astype(self.dtype), x.astype(self.dtype)], axis=-1)
         fused = xh @ kernel.astype(self.dtype)

@@ -127,6 +127,8 @@ def load_state(path: str) -> Dict[str, Any]:
                 raise pickle.UnpicklingError(f"footer is a {type(footer).__name__}, not a dict")
     except RuntimeError:
         raise
+    except OSError:
+        raise  # missing file / permissions is a path problem, not corruption
     except Exception as e:
         # Corruption inside a pickle stream surfaces as almost anything —
         # UnpicklingError, EOFError, bad-opcode ModuleNotFoundError/AttributeError,

@@ -113,19 +113,29 @@ def test_layer_norm_gru_keeps_state_when_update_closed():
     assert out.shape == h.shape
 
 
-def test_layer_norm_gru_ln_matches_pallas_reference():
-    """The flax LN path and the Pallas kernel's pure-JAX reference must agree —
-    they are the same op behind `pallas_gru_supported` dispatch."""
-    from sheeprl_tpu.ops.pallas.gru import layer_norm_gru_reference
-
-    cell = LayerNormGRUCell(hidden_size=16, layer_norm=True, bias=False, use_pallas=False)
+def test_layer_norm_gru_ln_matches_numpy_reference():
+    """Pin the LN-GRU gate math (Hafner variant: LN over the fused projection,
+    reset*cand inside tanh, update bias -1 — reference models.py:396-403)
+    against an independent numpy implementation."""
+    cell = LayerNormGRUCell(hidden_size=16, layer_norm=True, bias=False)
     x = jax.random.normal(jax.random.PRNGKey(3), (5, 12))
     h = jax.random.normal(jax.random.PRNGKey(4), (5, 16))
     params = cell.init(KEY, x, h)
     out = cell.apply(params, x, h)
+
     p = params["params"]
-    ref = layer_norm_gru_reference(x, h, p["kernel"], p["ln_scale"], p["ln_bias"])
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    xh = np.concatenate([np.asarray(h), np.asarray(x)], axis=-1)
+    z = xh @ np.asarray(p["kernel"], np.float64)
+    mu = z.mean(-1, keepdims=True)
+    var = z.var(-1, keepdims=True)
+    z = (z - mu) / np.sqrt(var + 1e-5) * np.asarray(p["ln_scale"]) + np.asarray(p["ln_bias"])
+    reset, cand, update = np.split(z, 3, axis=-1)
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    reset = sig(reset)
+    cand = np.tanh(reset * cand)
+    update = sig(update - 1)
+    ref = update * cand + (1 - update) * np.asarray(h)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
 
 
 def test_layer_norm_channel_last():
