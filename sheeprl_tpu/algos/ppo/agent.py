@@ -245,13 +245,40 @@ class PPOPlayer:
             _, values = agent.apply(params, obs)
             return host_float32(values)
 
+        def _normalize(obs):
+            # raw env obs -> the encoder's expected layout/ranges, in-graph: cnn
+            # stacks arrive uint8-scaled [0,255] and become centered floats; mlp
+            # obs flatten to [n_envs, features] (mirrors utils.prepare_obs)
+            out = {}
+            for k, v in obs.items():
+                v = jnp.asarray(v, jnp.float32)
+                if k in agent.cnn_keys:
+                    # collapse any frame-stack dim into channels (idempotent for
+                    # already-[n_envs, C, H, W] inputs)
+                    out[k] = v.reshape(v.shape[0], -1, *v.shape[-2:]) / 255.0 - 0.5
+                else:
+                    out[k] = v.reshape(v.shape[0], -1)
+            return out
+
+        def _act_raw(params, obs, key):
+            return _act(params, _normalize(obs), key)
+
         self._act = jax.jit(_act)
+        self._act_raw = jax.jit(_act_raw)
         self._greedy = jax.jit(_greedy)
         self._values = jax.jit(_values)
 
     def __call__(self, obs: Dict[str, jax.Array], key: jax.Array):
         """Returns (cat_actions, env_actions, logprobs, values, next_key) — all on device."""
         return self._act(self.params, obs, key)
+
+    def act_raw(self, obs: Dict[str, Any], key: jax.Array):
+        """Same as ``__call__`` but takes RAW host obs (mlp vectors + [0,255] cnn
+        stacks, already shaped ``[n_envs, ...]``): the normalization runs inside
+        the ONE jitted dispatch instead of as a separate eager prep + device_put
+        per step (measured ~20% of the per-step rollout cost in the host loop).
+        """
+        return self._act_raw(self.params, obs, key)
 
     def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False):
         """Returns (env-facing actions, next_key)."""

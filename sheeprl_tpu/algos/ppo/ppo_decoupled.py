@@ -258,8 +258,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                    cat_actions, env_actions, logprobs, values, rng = player(jax_obs, rng)
+                    # raw obs straight into the player jit (see PPOPlayer.act_raw)
+                    cat_actions, env_actions, logprobs, values, rng = player.act_raw(next_obs, rng)
                     real_actions = np.asarray(env_actions)
                     np_actions = np.asarray(cat_actions)
 

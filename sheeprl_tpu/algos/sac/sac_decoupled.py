@@ -247,7 +247,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         actions = envs.action_space.sample()
                     else:
                         rng, act_key = jax.random.split(rng)
-                        actions = np.asarray(player.get_actions(jnp.asarray(obs_vec), act_key))
+                        actions = np.asarray(player.get_actions(obs_vec, act_key))
                     next_obs, rewards, terminated, truncated, info = envs.step(
                         actions.reshape(envs.action_space.shape)
                     )
