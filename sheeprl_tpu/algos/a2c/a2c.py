@@ -195,8 +195,9 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += n_envs
 
             with timer("Time/env_interaction_time", SumMetric()):
-                jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-                cat_actions, env_actions, logprobs, values, player_rng = player(jax_obs, player_rng)
+                # raw obs straight into the player jit (see PPOPlayer.act_raw;
+                # A2C reuses the PPO agent, vector obs only)
+                cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
                 real_actions = np.asarray(env_actions)
                 np_actions = np.asarray(cat_actions)
                 obs, rewards, terminated, truncated, info = envs.step(
