@@ -1,0 +1,69 @@
+"""PPOPlayer.act_raw must be bit-identical to the prepare_obs + __call__ path
+(the rollout loops use act_raw for one-dispatch stepping; eval/bootstrap paths
+still go through prepare_obs)."""
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.utils import prepare_obs
+from sheeprl_tpu.config.loader import load_config
+from sheeprl_tpu.core.runtime import Runtime
+
+
+def test_act_raw_matches_prepare_obs_path():
+    cfg = load_config(
+        overrides=[
+            "exp=ppo",
+            "env=dummy",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.encoder.mlp_features_dim=8",
+        ]
+    )
+    runtime = Runtime(accelerator="cpu", devices=1)
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (4,), np.float32),
+        }
+    )
+    _agent, params, player = build_agent(runtime, (3,), False, cfg, obs_space)
+    player.params = runtime.to_player(params)
+
+    n_envs = 2
+    rng = np.random.default_rng(0)
+    raw = {
+        "rgb": rng.integers(0, 255, (n_envs, 3, 64, 64)).astype(np.uint8),
+        "state": rng.standard_normal((n_envs, 4)).astype(np.float32),
+    }
+    key = jax.device_put(jax.random.PRNGKey(7), runtime.player_device)
+
+    prepped = prepare_obs(runtime, raw, cnn_keys=["rgb"], num_envs=n_envs)
+    old = player(prepped, key)
+    new = player.act_raw(raw, key)
+    for a, b in zip(old[:4], new[:4]):
+        # host-numpy vs in-graph normalization differ by float rounding only
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    # frame-stacked cnn obs [n_envs, S, C, H, W] collapse to channels in-graph
+    stacked = dict(raw)
+    stacked["rgb"] = np.repeat(raw["rgb"][:, None], 2, axis=1)  # [n_envs, 2, 3, 64, 64]
+    prepped_stacked = prepare_obs(runtime, stacked, cnn_keys=["rgb"], num_envs=n_envs)
+    # need an agent built for 6 input channels
+    obs_space6 = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (2, 3, 64, 64), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (4,), np.float32),
+        }
+    )
+    _agent6, params6, player6 = build_agent(runtime, (3,), False, cfg, obs_space6)
+    player6.params = runtime.to_player(params6)
+    old6 = player6(prepped_stacked, key)
+    new6 = player6.act_raw(stacked, key)
+    for a, b in zip(old6[:4], new6[:4]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
