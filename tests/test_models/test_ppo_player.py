@@ -32,8 +32,7 @@ def test_act_raw_matches_prepare_obs_path():
             "state": gym.spaces.Box(-1, 1, (4,), np.float32),
         }
     )
-    _agent, params, player = build_agent(runtime, (3,), False, cfg, obs_space)
-    player.params = runtime.to_player(params)
+    _agent, _params, player = build_agent(runtime, (3,), False, cfg, obs_space)
 
     n_envs = 2
     rng = np.random.default_rng(0)
@@ -61,8 +60,7 @@ def test_act_raw_matches_prepare_obs_path():
             "state": gym.spaces.Box(-1, 1, (4,), np.float32),
         }
     )
-    _agent6, params6, player6 = build_agent(runtime, (3,), False, cfg, obs_space6)
-    player6.params = runtime.to_player(params6)
+    _agent6, _params6, player6 = build_agent(runtime, (3,), False, cfg, obs_space6)
     old6 = player6(prepped_stacked, key)
     new6 = player6.act_raw(stacked, key)
     for a, b in zip(old6[:4], new6[:4]):

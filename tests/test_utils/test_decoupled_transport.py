@@ -1,0 +1,85 @@
+"""CrossHostTransport payload-spec exchange: unit-level (the 2-process
+integration runs live in test_multihost.py; here the coordinator KV store is
+faked so the caching/template semantics are pinned cheaply)."""
+
+import numpy as np
+import pytest
+
+import sheeprl_tpu.parallel.decoupled as decoupled_mod
+from sheeprl_tpu.parallel.decoupled import CrossHostTransport
+
+
+class _FakeKV:
+    def __init__(self):
+        self.store = {}
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key not in self.store:
+            raise TimeoutError(f"no value for {key}")
+        return self.store[key]
+
+
+@pytest.fixture()
+def transport_pair(monkeypatch):
+    kv = _FakeKV()
+    monkeypatch.setattr(decoupled_mod, "_kv_client", lambda: kv)
+    player = CrossHostTransport.__new__(CrossHostTransport)
+    trainer = CrossHostTransport.__new__(CrossHostTransport)
+    for t, is_player in ((player, True), (trainer, False)):
+        t.is_player_process = is_player
+        t._specs = {}
+        t._zero_payloads = {}
+        t._scope = ""
+    return player, trainer, kv
+
+
+def test_spec_roundtrip_and_zero_templates(transport_pair):
+    player, trainer, _ = transport_pair
+    payload = {
+        "obs": np.zeros((4, 3, 5), np.float32),
+        "rew": np.zeros((4, 3, 1), np.float32),
+        "pix": np.zeros((4, 3, 2, 2), np.uint8),
+    }
+    spec = player.sync_payload_spec("roll", payload)
+    got = trainer.sync_payload_spec("roll")
+    assert got == spec
+    assert got["pix"] == ((4, 3, 2, 2), "uint8")
+
+    tpl = trainer.zeros_payload("roll")
+    assert set(tpl) == set(payload)
+    assert tpl["obs"].shape == (4, 3, 5) and tpl["obs"].dtype == np.float32
+    # the dict is a fresh shallow copy each call (callers pop keys), the arrays cached
+    tpl.pop("obs")
+    tpl2 = trainer.zeros_payload("roll")
+    assert "obs" in tpl2
+    assert tpl2["rew"] is trainer.zeros_payload("roll")["rew"]
+
+
+def test_spec_is_cached_after_first_exchange(transport_pair):
+    player, trainer, kv = transport_pair
+    player.sync_payload_spec("t", {"a": np.zeros((2,), np.float32)})
+    trainer.sync_payload_spec("t")
+    kv.store.clear()  # later calls must not touch the store again
+    assert player.sync_payload_spec("t")["a"] == ((2,), "float32")
+    assert trainer.sync_payload_spec("t")["a"] == ((2,), "float32")
+
+
+def test_scope_isolates_runs(transport_pair):
+    player, trainer, _ = transport_pair
+    player.set_scope("logs/run_A")
+    trainer.set_scope("logs/run_B")
+    player.sync_payload_spec("roll", {"a": np.zeros((2,), np.float32)})
+    # different scope -> the stale run-A spec must NOT satisfy run B
+    with pytest.raises(TimeoutError):
+        trainer.sync_payload_spec("roll")
+    trainer.set_scope("logs/run_A")
+    assert trainer.sync_payload_spec("roll")["a"] == ((2,), "float32")
+
+
+def test_player_must_provide_payload(transport_pair):
+    player, _, _ = transport_pair
+    with pytest.raises(ValueError, match="must provide the payload"):
+        player.sync_payload_spec("empty")
