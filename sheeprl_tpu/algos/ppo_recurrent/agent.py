@@ -187,7 +187,21 @@ class RecurrentPPOPlayer:
             _, values, states = agent.apply(params, obs, prev_actions, prev_states)
             return host_float32(values[0]), states
 
+        def _act_raw(params, obs, prev_actions, prev_states, key, greedy):
+            # raw host obs [n_envs, ...] -> normalized [T=1, n_envs, ...] in-graph
+            # (one dispatch per env step; see PPOPlayer.act_raw for the pattern)
+            prepped = {}
+            for k, v in obs.items():
+                v = jnp.asarray(v, jnp.float32)
+                if k in agent.cnn_keys:
+                    v = v.reshape(v.shape[0], -1, *v.shape[-2:]) / 255.0 - 0.5
+                else:
+                    v = v.reshape(v.shape[0], -1)
+                prepped[k] = v[None]
+            return _act(params, prepped, prev_actions[None], prev_states, key, greedy)
+
         self._act = jax.jit(_act, static_argnums=(5,))
+        self._act_raw = jax.jit(_act_raw, static_argnums=(5,))
         self._values = jax.jit(_values)
 
     def initial_states(self, hidden_size: int):
@@ -198,6 +212,11 @@ class RecurrentPPOPlayer:
 
     def __call__(self, obs, prev_actions, prev_states, key, greedy: bool = False):
         return self._act(self.params, obs, prev_actions, prev_states, key, greedy)
+
+    def act_raw(self, obs, prev_actions, prev_states, key, greedy: bool = False):
+        """Raw host obs (no T dim, [0,255] cnn stacks) + prev_actions [n_envs, A]:
+        normalization, T=1 expansion, and the forward run as ONE jitted dispatch."""
+        return self._act_raw(self.params, obs, prev_actions, prev_states, key, greedy)
 
     def get_values(self, obs, prev_actions, prev_states):
         return self._values(self.params, obs, prev_actions, prev_states)

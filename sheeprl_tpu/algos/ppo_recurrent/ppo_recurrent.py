@@ -259,11 +259,11 @@ def main(runtime, cfg: Dict[str, Any]):
             policy_step += n_envs
 
             with timer("Time/env_interaction_time", SumMetric()):
-                jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                jax_obs = {k: v[None] for k, v in jax_obs.items()}  # add T=1
-                cat_actions, env_actions, logprobs, values, states, player_rng = player(
-                    jax_obs,
-                    jax.device_put(prev_actions[None], runtime.player_device),
+                # raw obs + prev actions straight into the player jit (see
+                # RecurrentPPOPlayer.act_raw): one dispatch per env step
+                cat_actions, env_actions, logprobs, values, states, player_rng = player.act_raw(
+                    next_obs,
+                    prev_actions,
                     prev_states,
                     player_rng,
                 )

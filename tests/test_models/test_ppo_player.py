@@ -65,3 +65,50 @@ def test_act_raw_matches_prepare_obs_path():
     new6 = player6.act_raw(stacked, key)
     for a, b in zip(old6[:4], new6[:4]):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+def test_recurrent_act_raw_matches_prepare_obs_path():
+    """Same pin for RecurrentPPOPlayer.act_raw: the recurrent rollout loop now
+    uses it exclusively, so its in-graph normalization + T=1 expansion must
+    track the prepare_obs path (including carried LSTM states)."""
+    from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent as build_recurrent
+
+    cfg = load_config(
+        overrides=[
+            "exp=ppo_recurrent",
+            "env=dummy",
+            "env.num_envs=2",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.encoder.cnn_features_dim=16",
+            "algo.encoder.mlp_features_dim=8",
+            "algo.rnn.lstm.hidden_size=8",
+        ]
+    )
+    runtime = Runtime(accelerator="cpu", devices=1)
+    obs_space = gym.spaces.Dict(
+        {
+            "rgb": gym.spaces.Box(0, 255, (3, 64, 64), np.uint8),
+            "state": gym.spaces.Box(-1, 1, (4,), np.float32),
+        }
+    )
+    n_envs = 2
+    _agent, _params, player = build_recurrent(runtime, (3,), False, cfg, obs_space)
+
+    rng = np.random.default_rng(1)
+    raw = {
+        "rgb": rng.integers(0, 255, (n_envs, 3, 64, 64)).astype(np.uint8),
+        "state": rng.standard_normal((n_envs, 4)).astype(np.float32),
+    }
+    prev_actions = np.zeros((n_envs, 3), np.float32)
+    prev_states = player.initial_states(8)
+    key = jax.device_put(jax.random.PRNGKey(11), runtime.player_device)
+
+    prepped = prepare_obs(runtime, raw, cnn_keys=["rgb"], num_envs=n_envs)
+    prepped = {k: v[None] for k, v in prepped.items()}
+    old = player(prepped, jax.device_put(prev_actions[None], runtime.player_device), prev_states, key)
+    new = player.act_raw(raw, prev_actions, prev_states, key)
+    for a, b in zip(jax.tree_util.tree_leaves(old[:5]), jax.tree_util.tree_leaves(new[:5])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
