@@ -190,11 +190,55 @@ def bench_dv3(
     }
 
 
+def _regression_check(result: dict) -> None:
+    """Compare this run's PPO median against the newest BENCH_r*.json on disk.
+
+    The r2->r3 'regression' was single-pass noise nobody could classify at the
+    time (benchmarks/PPO_BENCH_NOTES.md); with the median+spread in hand, a
+    real regression is now a median below the previous record by more than the
+    measured spread — recorded in the JSON so the next round starts with a
+    verdict instead of a mystery.
+    """
+    import glob
+    import os
+    import re
+
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        numbered = []
+        for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+            m = re.search(r"BENCH_r(\d+)\.json$", os.path.basename(p))
+            if m:
+                numbered.append((int(m.group(1)), p))
+        if not numbered:
+            return
+        with open(max(numbered)[1]) as f:
+            prev = json.load(f)
+        prev = prev.get("parsed", prev)
+        prev_value = float(prev.get("value"))
+        spread = float(result.get("ppo_spread") or 0.0)
+        result["ppo_prev_round"] = prev_value
+        if "ppo_spread" in prev:
+            # both sides are warm medians with spreads: a confident verdict
+            result["ppo_regressed"] = bool(
+                result["value"] + spread < prev_value - float(prev.get("ppo_spread") or 0.0)
+            )
+        else:
+            # the previous round is a single cold pass with documented ~34% noise
+            # (benchmarks/PPO_BENCH_NOTES.md) — record the comparison, refuse the verdict
+            result["ppo_regressed"] = None
+    except Exception:
+        # a broken/odd historical file must never cost the PPO number or the
+        # one-JSON-line stdout contract
+        return
+
+
 if __name__ == "__main__":
     # stdout must carry EXACTLY one JSON line: the CLI's config dump and progress
     # prints go to stderr instead
     with contextlib.redirect_stdout(sys.stderr):
         result = bench_ppo()
+        _regression_check(result)
         try:
             result.update(bench_dv3())
         except Exception as e:  # a DV3 bench failure must not lose the PPO number
