@@ -61,6 +61,69 @@ def test_resume_from_checkpoint(tmp_path, monkeypatch):
     run(overrides=TINY_PPO + ["checkpoint.save_last=False", f"checkpoint.resume_from={ckpts[-1]}"])
 
 
+def test_resume_from_checkpoint_decoupled(tmp_path, monkeypatch):
+    """Decoupled PPO writes its checkpoint from the player role with the
+    trainer-world batch accounting; a resume must rebuild both roles from it
+    (reference resumes decoupled runs through the same cli path)."""
+    monkeypatch.chdir(tmp_path)
+    tiny = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.devices=3",
+        "metric.log_level=0",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.total_steps=16",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+    ]
+    # checkpoint MID-run (not save_last): the resume leg must actually train
+    # from the restored state, not just load it and exit
+    run(overrides=tiny + ["checkpoint.save_last=False", "checkpoint.every=8"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts, "decoupled training did not write a checkpoint"
+    run(overrides=tiny + ["checkpoint.save_last=False", f"checkpoint.resume_from={ckpts[0]}"])
+
+
+def test_resume_from_checkpoint_sac_decoupled(tmp_path, monkeypatch):
+    """SAC decoupled checkpoints carry the replay-ratio scheduler and update
+    counter alongside the params; resume must rehydrate all of it."""
+    monkeypatch.chdir(tmp_path)
+    tiny = [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.devices=2",
+        "metric.log_level=0",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.total_steps=8",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+        "buffer.size=64",
+    ]
+    # checkpoint MID-run (not save_last) so the resume leg trains from the
+    # restored scheduler/optimizer state instead of loading and exiting
+    run(overrides=tiny + ["checkpoint.save_last=False", "checkpoint.every=4"])
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts, "decoupled SAC training did not write a checkpoint"
+    run(overrides=tiny + ["checkpoint.save_last=False", f"checkpoint.resume_from={ckpts[0]}"])
+
+
 def test_resume_from_checkpoint_env_error(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     run(overrides=TINY_PPO + ["checkpoint.save_last=True"])
