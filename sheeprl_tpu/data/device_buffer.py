@@ -409,12 +409,12 @@ class DeviceSequentialReplayBuffer:
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError(f"'batch_size' ({batch_size}) and 'n_samples' ({n_samples}) must be both greater than 0")
         if self._buf is None:
-            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: 0")
+            raise ValueError(f"not enough history for sequence_length={sequence_length}: the buffer is empty")
         filled = self._filled()
         valid_envs = np.nonzero(filled >= sequence_length)[0]
         if len(valid_envs) == 0:
             raise ValueError(
-                f"Cannot sample a sequence of length {sequence_length}. Data added so far: {int(filled.max())}"
+                f"not enough history for sequence_length={sequence_length}: only {int(filled.max())} steps stored"
             )
         n = batch_size * n_samples
         env_idx = valid_envs[self._rng.integers(0, len(valid_envs), size=(n,))]
@@ -772,7 +772,7 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
                 f"mesh axis size ({self._world})"
             )
         if self._buf is None:
-            raise ValueError(f"Cannot sample a sequence of length {sequence_length}. Data added so far: 0")
+            raise ValueError(f"not enough history for sequence_length={sequence_length}: the buffer is empty")
         filled = self._filled()
         b_local = batch_size // self._world
         n_local = b_local * n_samples
@@ -784,8 +784,8 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
             valid = np.nonzero(local_filled >= sequence_length)[0]
             if len(valid) == 0:
                 raise ValueError(
-                    f"Cannot sample a sequence of length {sequence_length}. "
-                    f"Data added so far: {int(local_filled.max())} (device shard {d})"
+                    f"not enough history for sequence_length={sequence_length}: "
+                    f"only {int(local_filled.max())} steps stored on device shard {d}"
                 )
             le = valid[self._rng.integers(0, len(valid), size=(n_local,))]
             ge = le + lo  # global env ids for anchor/span lookups

@@ -70,7 +70,7 @@ def test_partial_env_add_advances_only_those_envs():
 def test_too_short_raises():
     rb = DeviceSequentialReplayBuffer(16, n_envs=1)
     rb.add(_step(0, n_envs=1))
-    with pytest.raises(ValueError, match="Cannot sample"):
+    with pytest.raises(ValueError, match="not enough history"):
         rb.sample(batch_size=1, sequence_length=4)
 
 
