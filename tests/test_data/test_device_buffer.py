@@ -148,6 +148,41 @@ def test_dv3_cli_with_device_buffer(tmp_path, monkeypatch):
     )
 
 
+def test_dv1_cli_with_device_buffer(tmp_path, monkeypatch):
+    """DV1's sequential path supports the HBM-resident buffer too (its
+    pixel-target recipe now defaults to it — host-buffer runs leak transport
+    staging memory on tunneled accelerators)."""
+    monkeypatch.chdir(tmp_path)
+    from sheeprl_tpu.cli import run
+
+    run(
+        overrides=[
+            "exp=dreamer_v1",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "dry_run=True",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "fabric.devices=1",
+            "buffer.device=True",
+            "algo.learning_starts=0",
+            "algo.per_rank_sequence_length=1",
+            "algo.per_rank_batch_size=2",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.stochastic_size=2",
+            "algo.horizon=3",
+        ]
+    )
+
+
 def test_dv2_cli_with_device_buffer(tmp_path, monkeypatch):
     """DV2's sequential path supports the HBM-resident buffer too."""
     monkeypatch.chdir(tmp_path)
