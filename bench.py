@@ -166,12 +166,12 @@ def bench_dv3(
     # block_until_ready returns without waiting — only a real host pull (np.asarray
     # of a device scalar) synchronizes, so that is how the timing fences work.
     for _ in range(2):
-        params, opt_states, moments, counter, _m = train_fn(params, opt_states, moments, counter, batches, key)
+        params, opt_states, moments, counter, _flat, _m = train_fn(params, opt_states, moments, counter, batches, key)
     np.asarray(counter)
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt_states, moments, counter, _m = train_fn(params, opt_states, moments, counter, batches, key)
+        params, opt_states, moments, counter, _flat, _m = train_fn(params, opt_states, moments, counter, batches, key)
     np.asarray(counter)  # counter is carried through every step: pulls the whole chain
     elapsed = time.perf_counter() - t0
 

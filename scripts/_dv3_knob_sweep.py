@@ -60,11 +60,11 @@ def run(label, extra, batch=128):
         except Exception:
             pass
         for _ in range(2):
-            pr, opt, mom, cnt, m = train_fn(pr, opt, mom, cnt, batches, key)
+            pr, opt, mom, cnt, _flat, m = train_fn(pr, opt, mom, cnt, batches, key)
         np.asarray(cnt)
         t0 = time.perf_counter()
         for _ in range(10):
-            pr, opt, mom, cnt, m = train_fn(pr, opt, mom, cnt, batches, key)
+            pr, opt, mom, cnt, _flat, m = train_fn(pr, opt, mom, cnt, batches, key)
         np.asarray(cnt)
         dt = (time.perf_counter() - t0) / 10
         mfu = flops / dt / 197e12 if flops else float("nan")

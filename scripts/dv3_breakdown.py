@@ -112,7 +112,7 @@ def main():
     state = [pr, opt_states, moments, np.int32(0)]
 
     def full(batches, key):
-        state[0], state[1], state[2], state[3], m = train_fn(state[0], state[1], state[2], state[3], batches, key)
+        state[0], state[1], state[2], state[3], _flat, m = train_fn(state[0], state[1], state[2], state[3], batches, key)
         return m
 
     fl = _flops(train_fn, state[0], state[1], state[2], state[3], batches, key)

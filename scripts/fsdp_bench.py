@@ -113,11 +113,11 @@ def main() -> None:
         dev0_mb = round(dev0_bytes((params, opt_states)) / 1e6, 2)  # before donation
         counter = jnp.int32(0)
         # train_fn donates params/opt/moments: continue from the warmup outputs
-        p, o, m, c, _metrics = train_fn(params, opt_states, moments, counter, dev_batches, key)
+        p, o, m, c, _flat, _metrics = train_fn(params, opt_states, moments, counter, dev_batches, key)
         jax.block_until_ready(p)  # compile + first step
         t0 = time.perf_counter()
         for _ in range(args.iters):
-            p, o, m, c, _metrics = train_fn(p, o, m, c, dev_batches, key)
+            p, o, m, c, _flat, _metrics = train_fn(p, o, m, c, dev_batches, key)
         jax.block_until_ready(p)
         dt = (time.perf_counter() - t0) / args.iters
         result[f"{strategy}_step_ms"] = round(dt * 1000, 1)

@@ -65,13 +65,16 @@ def prepare_obs(
 ) -> Dict[str, jax.Array]:
     """Host obs -> device arrays shaped [1, num_envs, ...] (reference utils.py:106-120)."""
     out = {}
+    device = runtime.player_device if runtime is not None else None
     for k, v in obs.items():
         arr = np.asarray(v, dtype=np.float32)
         if k in cnn_keys:
             arr = arr.reshape(1, num_envs, -1, *arr.shape[-2:]) / 255.0 - 0.5
         else:
             arr = arr.reshape(1, num_envs, -1)
-        out[k] = jnp.asarray(arr)
+        # commit to the player's device: an uncommitted jnp.asarray would land on
+        # the mesh default device and bounce host->mesh->host for a host player
+        out[k] = jnp.asarray(arr) if device is None else jax.device_put(arr, device)
     return out
 
 

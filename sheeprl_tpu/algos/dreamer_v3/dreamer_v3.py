@@ -54,7 +54,18 @@ from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, polyak_update, save_configs
+from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, polyak_update, save_configs
+
+# Obs->latent->action world-model subset the rollout player needs (see
+# PlayerDV3._raw_step / RSSM.initial_states); shipped to the player device by
+# DreamerPlayerSync instead of the full world model.
+PLAYER_WM_KEYS = (
+    "encoder",
+    "recurrent_model",
+    "representation_model",
+    "transition_model",
+    "initial_recurrent_state",
+)
 
 
 class DV3OptStates(NamedTuple):
@@ -63,7 +74,7 @@ class DV3OptStates(NamedTuple):
     critic: Any
 
 
-def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, actions_dim: Sequence[int]):
+def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, actions_dim: Sequence[int], psync=None):
     """Build (init_opt, train) where train is a single jitted scan over G gradient steps."""
     rssm = modules.rssm
     horizon = int(cfg.algo.horizon)
@@ -346,7 +357,10 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
             "Grads/actor": m[11],
             "Grads/critic": m[12],
         }
-        return params, opt_states, moments_state, counter, named
+        # raveled player subset computed in-graph: the host-player refresh is one
+        # flat transfer, not a per-leaf pull (see DreamerPlayerSync)
+        flat_player = psync.ravel(params) if psync is not None else None
+        return params, opt_states, moments_state, counter, flat_player, named
 
     return init_opt, jax.jit(train, donate_argnums=(0, 1, 2))
 
@@ -450,7 +464,10 @@ def main(runtime, cfg: Dict[str, Any]):
         state["target_critic"] if state else None,
     )
 
-    init_opt, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    psync = DreamerPlayerSync(
+        runtime, params, wm_keys=PLAYER_WM_KEYS, every=cfg.algo.get("player_sync_every", 1)
+    )
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim, psync)
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
@@ -460,6 +477,9 @@ def main(runtime, cfg: Dict[str, Any]):
     counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
     params = runtime.place_params(params)
     opt_states = runtime.place_params(opt_states)
+    # the player must never hold mesh-resident params when it lives on the host
+    # CPU backend: its per-step calls would pay per-leaf cross-backend pulls
+    psync.push(player, params, force=True)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -632,7 +652,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, moments_state, counter, train_metrics = train_fn(
+                    params, opt_states, moments_state, counter, flat_player, train_metrics = train_fn(
                         params, opt_states, moments_state, counter, batches, train_key
                     )
                     if not timer.disabled:
@@ -640,8 +660,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         # device work, but an unconditional sync would serialize the
                         # loop on the dispatch round-trip
                         jax.block_until_ready(params)
-                    player.wm_params = params["world_model"]
-                    player.actor_params = params["actor"]
+                    psync.push(player, params, flat=flat_player)
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
@@ -710,6 +729,7 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
+        psync.push(player, params, force=True)  # the cadence may have left the player stale
         test(player, runtime, cfg, log_dir, greedy=False)
     if logger:
         logger.finalize()

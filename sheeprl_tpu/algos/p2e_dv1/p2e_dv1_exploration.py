@@ -37,7 +37,8 @@ from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import PLAYER_WM_KEYS
+from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, save_configs
 
 
 class P2EDV1OptStates(NamedTuple):
@@ -75,7 +76,7 @@ METRIC_ORDER = [
 ]
 
 
-def make_train_fn(modules: P2EDV1Modules, cfg, runtime):
+def make_train_fn(modules: P2EDV1Modules, cfg, runtime, psync=None):
     """Build (init_opt, train): jitted G-step scan over the five P2E updates."""
     rssm = modules.rssm
     ensembles = modules.ensembles
@@ -342,7 +343,9 @@ def make_train_fn(modules: P2EDV1Modules, cfg, runtime):
         keys = jax.random.split(key, g)
         (params, opt_states), metrics = jax.lax.scan(one_step, (params, opt_states), (batches, keys))
         m = metrics.mean(axis=0)
-        return params, opt_states, {name: m[i] for i, name in enumerate(METRIC_ORDER)}
+        # raveled player subset computed in-graph (one flat host-player transfer)
+        flat_player = psync.ravel(params) if psync is not None else None
+        return params, opt_states, flat_player, {name: m[i] for i, name in enumerate(METRIC_ORDER)}
 
     return init_opt, jax.jit(train, donate_argnums=(0, 1))
 
@@ -418,12 +421,22 @@ def main(runtime, cfg: Dict[str, Any]):
         state["critic_exploration"] if state else None,
     )
 
-    init_opt, train_fn = make_train_fn(modules, cfg, runtime)
+    psync = DreamerPlayerSync(
+        runtime,
+        params,
+        wm_keys=PLAYER_WM_KEYS,
+        actor_name="actor_exploration",
+        every=cfg.algo.get("player_sync_every", 1),
+    )
+    init_opt, train_fn = make_train_fn(modules, cfg, runtime, psync)
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
     params = runtime.place_params(params)
     opt_states = runtime.place_params(opt_states)
+    # the player must never hold mesh-resident params when it lives on the host
+    # CPU backend: its per-step calls would pay per-leaf cross-backend pulls
+    psync.push(player, params, force=True)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -581,13 +594,14 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, train_metrics = train_fn(params, opt_states, batches, train_key)
+                    params, opt_states, flat_player, train_metrics = train_fn(
+                        params, opt_states, batches, train_key
+                    )
                     if not timer.disabled:
                         # fence ONLY when timing (Time/train_time honesty); an
                         # unconditional sync serializes on the dispatch round-trip
                         jax.block_until_ready(params)
-                    player.wm_params = params["world_model"]
-                    player.actor_params = params["actor_exploration"]
+                    psync.push(player, params, flat=flat_player)
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
@@ -660,7 +674,10 @@ def main(runtime, cfg: Dict[str, Any]):
     # Zero-shot evaluation runs with the TASK policy (reference :795-798).
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor = modules.actor_task
-        player.actor_params = params["actor_task"]
+        # zero-shot eval swaps in the TASK actor: ship a coherent (wm, actor)
+        # pair to the player device rather than mixing backends
+        psync_task = DreamerPlayerSync(runtime, params, wm_keys=PLAYER_WM_KEYS, actor_name="actor_task")
+        psync_task.push(player, params, force=True)
         player.actor_type = "task"
         test(player, runtime, cfg, log_dir)
     if logger:

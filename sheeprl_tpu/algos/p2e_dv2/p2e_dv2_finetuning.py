@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.algos.dreamer_v2.agent import expl_amount_schedule
-from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import DV2OptStates, make_train_fn
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import DV2OptStates, PLAYER_WM_KEYS, make_train_fn
 from sheeprl_tpu.algos.dreamer_v2.utils import prepare_obs, test
 from sheeprl_tpu.algos.p2e_dv2.agent import build_agent
 from sheeprl_tpu.algos.dreamer_v3.utils import get_action_masks
@@ -33,7 +33,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, save_configs
 
 
 @register_algorithm()
@@ -127,7 +127,13 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
 
     # Finetune the TASK behaviour with the plain DV2 step on real rewards.
     dv2_modules = modules.as_dv2(task=True)
-    init_opt, train_fn = make_train_fn(dv2_modules, cfg, runtime, is_continuous, actions_dim)
+    psync = DreamerPlayerSync(
+        runtime,
+        {"world_model": params["world_model"], "actor": params["actor_task"]},
+        wm_keys=PLAYER_WM_KEYS,
+        every=cfg.algo.get("player_sync_every", 1),
+    )
+    init_opt, train_fn = make_train_fn(dv2_modules, cfg, runtime, is_continuous, actions_dim, psync)
     fine_params = {
         "world_model": params["world_model"],
         "actor": params["actor_task"],
@@ -151,6 +157,10 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     counter = jnp.int32(state["counter"]) if resumed and "counter" in state else jnp.int32(0)
     fine_params = runtime.place_params(fine_params)
     opt_states = runtime.place_params(opt_states)
+    # pre-switch rollouts keep the EXPLORATION policy the checkpoint shipped;
+    # commit those copies to the player device so the player never mixes backends
+    player.wm_params = runtime.to_player(player.wm_params)
+    player.actor_params = runtime.to_player(player.actor_params)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -309,7 +319,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 if player.actor_type != "task":
                     player.actor_type = "task"
                     player.actor = modules.actor_task
-                    player.actor_params = fine_params["actor"]
+                    psync.push(player, fine_params, force=True)
                 # consumes the batch prefetched during the previous train step and
                 # immediately speculates the next one
                 batches = prefetcher.get(
@@ -319,12 +329,12 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 )
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    fine_params, opt_states, counter, train_metrics = train_fn(
+                    fine_params, opt_states, counter, flat_player, train_metrics = train_fn(
                         fine_params, opt_states, counter, batches, train_key
                     )
-                    jax.block_until_ready(fine_params["actor"])
-                    player.wm_params = fine_params["world_model"]
-                    player.actor_params = fine_params["actor"]
+                    if not timer.disabled:
+                        jax.block_until_ready(fine_params["actor"])
+                    psync.push(player, fine_params, flat=flat_player)
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
@@ -396,8 +406,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor = modules.actor_task
-        player.actor_params = fine_params["actor"]
         player.actor_type = "task"
+        psync.push(player, fine_params, force=True)
         test(player, runtime, cfg, log_dir, "few-shot")
     if logger:
         logger.finalize()

@@ -58,7 +58,8 @@ from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import PLAYER_WM_KEYS
+from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, save_configs
 
 from functools import partial
 
@@ -72,7 +73,7 @@ class P2EDV3OptStates(NamedTuple):
     critics_exploration: Dict[str, Any]
 
 
-def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, actions_dim):
+def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, actions_dim, psync=None):
     """Build (init_opt, train): jitted G-step scan over the five P2E-DV3 updates.
 
     The moments argument/return is a dict ``{"task": MomentsState, <critic_key>:
@@ -519,7 +520,9 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
             one_step, (params, opt_states, moments, counter), (batches, keys)
         )
         named = {k: v.mean(axis=0) for k, v in metrics.items()}
-        return params, opt_states, moments, counter, named
+        # raveled player subset computed in-graph (one flat host-player transfer)
+        flat_player = psync.ravel(params) if psync is not None else None
+        return params, opt_states, moments, counter, flat_player, named
 
     return init_opt, init_moments_dict, jax.jit(train, donate_argnums=(0, 1, 2))
 
@@ -636,7 +639,16 @@ def main(runtime, cfg: Dict[str, Any]):
     critic_keys = list(modules.critics_exploration.keys())
     expand_critic_metric_keys(cfg, modules.critics_exploration)
 
-    init_opt, init_moments_dict, train_fn = make_train_fn(modules, cfg, runtime, is_continuous, actions_dim)
+    psync = DreamerPlayerSync(
+        runtime,
+        params,
+        wm_keys=PLAYER_WM_KEYS,
+        actor_name="actor_exploration",
+        every=cfg.algo.get("player_sync_every", 1),
+    )
+    init_opt, init_moments_dict, train_fn = make_train_fn(
+        modules, cfg, runtime, is_continuous, actions_dim, psync
+    )
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
@@ -649,6 +661,9 @@ def main(runtime, cfg: Dict[str, Any]):
     counter = jnp.int32(state["counter"]) if state and "counter" in state else jnp.int32(0)
     params = runtime.place_params(params)
     opt_states = runtime.place_params(opt_states)
+    # the player must never hold mesh-resident params when it lives on the host
+    # CPU backend: its per-step calls would pay per-leaf cross-backend pulls
+    psync.push(player, params, force=True)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -808,15 +823,14 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 with timer("Time/train_time", SumMetric()):
                     rng, train_key = jax.random.split(rng)
-                    params, opt_states, moments, counter, train_metrics = train_fn(
+                    params, opt_states, moments, counter, flat_player, train_metrics = train_fn(
                         params, opt_states, moments, counter, batches, train_key
                     )
                     if not timer.disabled:
                         # fence ONLY when timing (Time/train_time honesty); an
                         # unconditional sync serializes on the dispatch round-trip
                         jax.block_until_ready(params)
-                    player.wm_params = params["world_model"]
-                    player.actor_params = params["actor_exploration"]
+                    psync.push(player, params, flat=flat_player)
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
                 if aggregator:
@@ -892,7 +906,10 @@ def main(runtime, cfg: Dict[str, Any]):
     # Zero-shot evaluation runs with the TASK policy (reference :1032-1036).
     if runtime.is_global_zero and cfg.algo.run_test:
         player.actor = modules.actor_task
-        player.actor_params = params["actor_task"]
+        # zero-shot eval swaps in the TASK actor: ship a coherent (wm, actor)
+        # pair to the player device rather than mixing backends
+        psync_task = DreamerPlayerSync(runtime, params, wm_keys=PLAYER_WM_KEYS, actor_name="actor_task")
+        psync_task.push(player, params, force=True)
         player.actor_type = "task"
         test(player, runtime, cfg, log_dir, "zero-shot", greedy=False)
     if logger:

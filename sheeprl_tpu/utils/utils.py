@@ -260,6 +260,70 @@ class PlayerParamsSync:
         return self._unravel_jit(jax.device_put(flat, device))
 
 
+class DreamerPlayerSync:
+    """Mesh -> player-device param pipe for the dreamer-family rollout policies.
+
+    A dreamer player only needs the obs->latent->action subset of the world model
+    (encoder + the recurrent/representation step models, plus the transition model
+    and learned initial state for the DV3 line) and the behavior actor — not the
+    decoder, reward, or continue heads. This helper ravels exactly that subset
+    into ONE flat vector inside the jitted train step (:meth:`ravel`) and
+    refreshes the player every ``algo.player_sync_every`` train calls with a
+    single cross-backend transfer (:meth:`push`), the same amortization the SAC
+    family uses and the same one-flat-vector shape the reference's decoupled
+    param broadcast ships (sheeprl/algos/ppo/ppo_decoupled.py:302,550).
+
+    With ``fabric.player_on_host=False`` the player shares the mesh device and
+    :meth:`push` just rebinds the mesh references (zero transfers).
+    """
+
+    def __init__(self, runtime, params, wm_keys: Sequence[str], actor_name: str = "actor", every: int = 1):
+        self._runtime = runtime
+        self._wm_keys = tuple(wm_keys)
+        self._actor_name = actor_name
+        self._every = max(1, int(every))
+        self._calls = 0
+        self.enabled = bool(runtime.player_on_host)
+        if self.enabled:
+            self._sync = PlayerParamsSync(self.subset(params))
+            self._ravel_jit = jax.jit(self._sync.ravel)
+
+    def subset(self, params):
+        wm = params["world_model"]
+        return ({k: wm[k] for k in self._wm_keys}, params[self._actor_name])
+
+    def ravel(self, params) -> Optional[jax.Array]:
+        """Call inside the jitted train step; one flat vector on the mesh (or None
+        when the player lives on the mesh and no transfer is needed).
+
+        With a >1 cadence most train calls would discard the vector, so the
+        in-graph ravel is skipped and the cadence-hit :meth:`push` ravels the
+        then-current params with its own dispatch instead."""
+        return self._sync.ravel(self.subset(params)) if self.enabled and self._every == 1 else None
+
+    def push(self, player, params, flat: Optional[jax.Array] = None, force: bool = False) -> None:
+        """Host side, after a train call: refresh the player's param copies.
+
+        ``flat`` is the train step's raveled output (avoids an extra dispatch);
+        ``force`` bypasses the cadence (initial placement, final pre-test flush).
+        """
+        if not self.enabled:
+            player.wm_params = params["world_model"]
+            player.actor_params = params[self._actor_name]
+            return
+        if force:
+            self._calls = 0  # the player is fresh: restart the staleness window
+        else:
+            self._calls += 1
+            if self._calls % self._every != 0:
+                return
+        if flat is None:
+            flat = self._ravel_jit(self.subset(params))
+        wm, actor = self._sync.pull(flat, self._runtime.player_device)
+        player.wm_params = wm
+        player.actor_params = actor
+
+
 # --------------------------------------------------------------------------------------
 # Host-side bookkeeping
 # --------------------------------------------------------------------------------------

@@ -128,7 +128,7 @@ def test_fsdp_train_step_matches_ddp():
 
         batch_sh = NamedSharding(runtime.mesh, P(None, None, "data"))
         dev_batches = {k: jax.device_put(jnp.asarray(v), batch_sh) for k, v in batches.items()}
-        new_params, _, _, counter, metrics = train_fn(
+        new_params, _, _, counter, _flat, metrics = train_fn(
             params, opt_states, moments, jnp.int32(0), dev_batches, key
         )
         results[strategy] = (

@@ -63,6 +63,83 @@ def test_player_device_selection(monkeypatch):
     assert on_mesh.player_device != fake_host
 
 
+class _FakePlayer:
+    wm_params = None
+    actor_params = None
+
+
+def _dreamer_params(scale=1.0):
+    return {
+        "world_model": {
+            "encoder": {"w": jnp.full((4, 8), scale)},
+            "recurrent_model": {"w": jnp.full((8, 8), 2 * scale)},
+            "representation_model": {"w": jnp.full((8, 4), 3 * scale)},
+            "observation_model": {"w": jnp.full((4, 4), 99.0)},  # player never needs this
+            "reward_model": {"w": jnp.full((4, 1), 98.0)},
+        },
+        "actor": {"w": jnp.full((8, 2), 4 * scale)},
+        "critic": {"w": jnp.full((8, 1), 97.0)},
+    }
+
+
+def test_dreamer_player_sync_host_player():
+    from sheeprl_tpu.utils.utils import DreamerPlayerSync
+
+    rt = Runtime(accelerator="cpu", devices=2, player_on_host=True)
+    keys = ("encoder", "recurrent_model", "representation_model")
+    params = rt.replicate(_dreamer_params())
+    psync = DreamerPlayerSync(rt, params, wm_keys=keys, every=1)
+    player = _FakePlayer()
+
+    psync.push(player, params, force=True)
+    # only the player subset ships; decoder/reward/critic stay behind
+    assert set(player.wm_params) == set(keys)
+    np.testing.assert_allclose(np.asarray(player.actor_params["w"]), 4.0)
+    leaf = player.wm_params["encoder"]["w"]
+    assert leaf.devices() == {rt.player_device}
+
+    # every=1: the train step's in-graph ravel output drives the refresh
+    new = rt.replicate(_dreamer_params(scale=2.0))
+    flat = jax.jit(psync.ravel)(new)
+    assert flat is not None and flat.ndim == 1
+    psync.push(player, new, flat=flat)
+    np.testing.assert_allclose(np.asarray(player.wm_params["representation_model"]["w"]), 6.0)
+    np.testing.assert_allclose(np.asarray(player.actor_params["w"]), 8.0)
+
+
+def test_dreamer_player_sync_cadence():
+    from sheeprl_tpu.utils.utils import DreamerPlayerSync
+
+    rt = Runtime(accelerator="cpu", devices=1, player_on_host=True)
+    keys = ("encoder", "recurrent_model", "representation_model")
+    psync = DreamerPlayerSync(rt, _dreamer_params(), wm_keys=keys, every=3)
+    # with a >1 cadence the per-train in-graph ravel is skipped entirely
+    assert psync.ravel(_dreamer_params()) is None
+    player = _FakePlayer()
+    psync.push(player, _dreamer_params(), force=True)
+
+    stale = np.asarray(player.actor_params["w"]).copy()
+    psync.push(player, _dreamer_params(5.0))  # call 1 of 3: stale
+    psync.push(player, _dreamer_params(6.0))  # call 2 of 3: stale
+    np.testing.assert_allclose(np.asarray(player.actor_params["w"]), stale)
+    psync.push(player, _dreamer_params(7.0))  # cadence hit: refreshed
+    np.testing.assert_allclose(np.asarray(player.actor_params["w"]), 28.0)
+
+
+def test_dreamer_player_sync_mesh_player_rebinds():
+    from sheeprl_tpu.utils.utils import DreamerPlayerSync
+
+    rt = Runtime(accelerator="cpu", devices=2, player_on_host=False)
+    params = rt.replicate(_dreamer_params())
+    psync = DreamerPlayerSync(rt, params, wm_keys=("encoder",), every=1)
+    assert psync.ravel(params) is None  # no transfer machinery on the mesh path
+    player = _FakePlayer()
+    psync.push(player, params, force=True)
+    # mesh path rebinds the full world model by reference (pre-r5 behavior)
+    assert player.wm_params is params["world_model"]
+    assert player.actor_params is params["actor"]
+
+
 def test_trace_profiler_window(monkeypatch, tmp_path):
     calls = []
     import jax.profiler as jp
