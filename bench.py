@@ -179,11 +179,23 @@ def bench_dv3(
     sec_per_step = elapsed / iters
     peak = _chip_peak_flops(runtime.device)
     mfu = (step_flops / sec_per_step / peak) if (step_flops and peak) else None
+    # hand-counted model FLOPs: XLA's cost_analysis counts scan bodies once
+    # instead of x trip count (benchmarks/DV3_MFU_NOTES.md), so the analytic
+    # figure is the honest numerator for MFU
+    try:
+        from benchmarks.analytic_flops import dv3_step_flops
+
+        analytic_flops = dv3_step_flops(cfg, batch, seq, actions_dim)["total"]
+    except Exception:
+        analytic_flops = None
+    mfu_analytic = (analytic_flops / sec_per_step / peak) if (analytic_flops and peak) else None
     return {
         f"{key_prefix}_gsteps_per_sec": round(gsteps_per_sec, 3),
         f"{key_prefix}_frames_per_sec": round(gsteps_per_sec * batch * seq, 1),
         f"{key_prefix}_step_tflops": round(step_flops / 1e12, 3) if step_flops else None,
         f"{key_prefix}_mfu": round(mfu, 4) if mfu is not None else None,
+        f"{key_prefix}_step_tflops_analytic": round(analytic_flops / 1e12, 3) if analytic_flops else None,
+        f"{key_prefix}_mfu_analytic": round(mfu_analytic, 4) if mfu_analytic is not None else None,
         f"{key_prefix}_device": getattr(runtime.device, "device_kind", str(runtime.device)),
         # reference anchor: ~1 g-step/s on RTX 3080 (Atari-100K in ~14h, README.md:44-51)
         f"{key_prefix}_vs_baseline": round(gsteps_per_sec / 1.0, 3),

@@ -1,0 +1,124 @@
+"""Analytic model-FLOP count for one DreamerV3 gradient step.
+
+``bench.py`` reports MFU from XLA's ``compiled.cost_analysis()``, but XLA counts
+a ``lax.scan`` body ONCE instead of multiplying by its trip count (measured in
+benchmarks/DV3_MFU_NOTES.md), so the XLA figure undercounts the T=64 dynamic
+scan and H=15 imagination scan. This module hand-counts matmul/conv FLOPs from
+the config shapes (the MXU work; vector ops are noise at these shapes) so the
+bench JSON can carry an honest ``dv3_mfu_analytic`` next to the XLA estimate.
+
+Counting rules (standard practice, e.g. the palm/chinchilla appendix math):
+- one matmul [m,k]@[k,n] = 2*m*k*n FLOPs; a conv = 2 * prod(out_spatial) *
+  C_out * C_in * k_h * k_w per sample;
+- backward = 2x forward for every path that receives parameter gradients
+  (so trained paths cost 3x forward; no-grad paths 1x);
+- DreamerV3 trains with a REINFORCE actor objective (discrete heads, the bench
+  shape), so the imagination rollout's world-model applications are forward-only
+  (gradients reach only the actor's own forward, reference dreamer_v3.py:296-320);
+- LayerNorms/activations/softmaxes are ignored (<1% of MXU work).
+
+Reference step semantics: dynamic learning over [T,B] then imagination over
+H x (T*B) starts (reference sheeprl/algos/dreamer_v3/dreamer_v3.py:48-353).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+
+def _mm(m: float, k: float, n: float) -> float:
+    return 2.0 * m * k * n
+
+
+def _mlp(n_samples: float, in_dim: int, hidden: Sequence[int], out_dim: int) -> float:
+    dims = [in_dim, *hidden, out_dim]
+    return sum(_mm(n_samples, a, b) for a, b in zip(dims[:-1], dims[1:]))
+
+
+def _encoder_convs(n_samples: float, in_ch: int, mult: int, image: int = 64, stages: int = 4, k: int = 4) -> float:
+    """Stride-2 conv stack: image -> image/2**stages (agent.py CNNEncoder)."""
+    flops = 0.0
+    c_in, side = in_ch, image
+    for i in range(stages):
+        c_out = (2**i) * mult
+        side //= 2
+        flops += _mm(n_samples * side * side, c_in * k * k, c_out) / 2.0 * 2.0  # = 2*out*cin*k*k*cout
+        c_in = c_out
+    return flops
+
+
+def _decoder_convs(n_samples: float, out_ch: int, mult: int, image: int = 64, stages: int = 4, k: int = 4) -> float:
+    """Mirror transposed-conv stack 4x4 -> image (agent.py CNNDecoder).
+
+    A stride-2 transposed conv [C_in, s, s] -> [C_out, 2s, 2s] costs the same
+    matmul volume as the forward conv of the mirrored shape: 2 * (2s)^2/4*k*k...
+    counted here as 2 * out_spatial * C_out * C_in * k*k / stride^2 aggregated
+    via the input spatial extent (each input pixel drives k*k*C_in*C_out MACs).
+    """
+    flops = 0.0
+    side = image // (2**stages)
+    c_in = (2 ** (stages - 1)) * mult
+    channels = [(2**i) * mult for i in reversed(range(stages - 1))] + [out_ch]
+    for c_out in channels:
+        flops += _mm(n_samples * side * side, c_in * k * k, c_out)
+        side *= 2
+        c_in = c_out
+    return flops
+
+
+def dv3_step_flops(cfg, batch: int, seq: int, actions_dim: Sequence[int], image: int = 64) -> Dict[str, float]:
+    """Analytic FLOPs for ONE DreamerV3 gradient step at the given shape.
+
+    Returns a per-part breakdown plus the ``total``; shapes are read from the
+    same config tree build_agent consumes.
+    """
+    wm = cfg.algo.world_model
+    mult = int(wm.encoder.cnn_channels_multiplier)
+    deter = int(wm.recurrent_model.recurrent_state_size)
+    stoch = int(wm.stochastic_size) * int(wm.discrete_size)
+    dense = int(cfg.algo.dense_units)
+    layers = int(cfg.algo.mlp_layers)
+    horizon = int(cfg.algo.horizon)
+    stages = 4
+    embed = (2 ** (stages - 1)) * mult * (image // 2**stages) ** 2
+    latent = deter + stoch
+    n_act = int(sum(actions_dim))
+    bins = int(cfg.distribution.get("bins", 255)) if hasattr(cfg, "distribution") else 255
+
+    N = float(batch * seq)  # dynamic-phase samples
+    M = float(batch * seq)  # imagination lanes
+    H = float(horizon)
+
+    def recurrent(n):
+        # input MLP (stoch+act -> dense) + fused LayerNorm-GRU ([feat,h] -> 3*deter)
+        return _mm(n, stoch + n_act, dense) + _mm(n, dense + deter, 3 * deter)
+
+    def transition(n):
+        return _mlp(n, deter, [int(wm.transition_model.hidden_size)], stoch)
+
+    def representation(n):
+        return _mlp(n, deter + embed, [int(wm.representation_model.hidden_size)], stoch)
+
+    def head(n, out_dim):
+        return _mlp(n, latent, [dense] * layers, out_dim)
+
+    parts: Dict[str, float] = {}
+    # ---- dynamic learning: everything here gets world-model gradients (x3)
+    parts["encoder"] = 3 * _encoder_convs(N, 3, mult, image, stages)
+    parts["dynamic_scan"] = 3 * (recurrent(N) + transition(N) + representation(N))
+    parts["decoder"] = 3 * (_mm(N, latent, embed) + _decoder_convs(N, 3, mult, image, stages))
+    parts["reward_head"] = 3 * head(N, bins)
+    parts["continue_head"] = 3 * head(N, 1)
+    # ---- imagination: REINFORCE actor -> world-model rollout is forward-only,
+    # the actor forward is trained (x3)
+    parts["imagination_rollout"] = H * (recurrent(M) + transition(M))
+    parts["imagination_actor"] = 3 * H * _mlp(M, latent, [dense] * layers, n_act)
+    # reward, online-critic value, and continue predictions over the imagined
+    # trajectories for the lambda targets (no grad)
+    parts["imagination_heads"] = head(H * M, bins) + head(H * M, bins) + head(H * M, 1)
+    # ---- critic update: trained forward+backward over [H, M], target critic fwd
+    parts["critic_update"] = 3 * head(H * M, bins)
+    parts["target_critic"] = head(H * M, bins)
+    total = sum(parts.values())
+    parts["total"] = total
+    return parts
