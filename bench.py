@@ -186,7 +186,8 @@ def bench_dv3(
         from benchmarks.analytic_flops import dv3_step_flops
 
         analytic_flops = dv3_step_flops(cfg, batch, seq, actions_dim)["total"]
-    except Exception:
+    except Exception as e:  # pure-Python counter: a failure is a bug, make it visible
+        print(f"analytic flop count failed: {type(e).__name__}: {e}", file=sys.stderr)
         analytic_flops = None
     mfu_analytic = (analytic_flops / sec_per_step / peak) if (analytic_flops and peak) else None
     return {

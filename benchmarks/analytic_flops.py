@@ -42,7 +42,7 @@ def _encoder_convs(n_samples: float, in_ch: int, mult: int, image: int = 64, sta
     for i in range(stages):
         c_out = (2**i) * mult
         side //= 2
-        flops += _mm(n_samples * side * side, c_in * k * k, c_out) / 2.0 * 2.0  # = 2*out*cin*k*k*cout
+        flops += _mm(n_samples * side * side, c_in * k * k, c_out)  # = 2*out*cin*k*k*cout
         c_in = c_out
     return flops
 
@@ -83,7 +83,7 @@ def dv3_step_flops(cfg, batch: int, seq: int, actions_dim: Sequence[int], image:
     embed = (2 ** (stages - 1)) * mult * (image // 2**stages) ** 2
     latent = deter + stoch
     n_act = int(sum(actions_dim))
-    bins = int(cfg.distribution.get("bins", 255)) if hasattr(cfg, "distribution") else 255
+    bins = int(wm.reward_model.get("bins", 255))  # critic.bins matches by config contract
 
     N = float(batch * seq)  # dynamic-phase samples
     M = float(batch * seq)  # imagination lanes
