@@ -1,0 +1,116 @@
+"""End-to-end model-manager walkthrough (reference examples/model_manager.ipynb).
+
+The reference notebook trains a short PPO run against an MLflow server, then
+drives MlflowModelManager through register -> get latest -> transition ->
+register-best -> download -> delete. This script is the same tour on the
+TPU build's default backend, the filesystem ``LocalModelManager``
+(sheeprl_tpu/utils/model_manager.py) — no server required; point
+``model_manager.registry_dir`` at shared storage to share a registry.
+
+Run from the repo root (a minute on CPU)::
+
+    JAX_PLATFORMS=cpu python examples/model_manager.py
+
+Every step prints what it did; the registry lands in a temp dir by default
+(override with --registry-dir to keep it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import pickle
+import tempfile
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--registry-dir", default=None, help="keep the registry here instead of a temp dir")
+    args = parser.parse_args()
+
+    registry_dir = args.registry_dir or os.path.join(tempfile.mkdtemp(prefix="sheeprl_tpu_registry_"), "registry")
+
+    # ---- 1. train a short PPO run on CartPole (the notebook's first cell: a small
+    # experiment whose checkpoint feeds the registry; quality is not the point)
+    from sheeprl_tpu.cli import run
+
+    run(
+        overrides=[
+            "exp=ppo",
+            "algo.total_steps=2048",
+            "algo.rollout_steps=128",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "algo.mlp_keys.encoder=[state]",
+            "checkpoint.every=2048",
+            "metric.log_level=1",
+            "metric.disable_timer=True",
+            "exp_name=model_manager_example",
+        ]
+    )
+    run_dirs = sorted(glob.glob("logs/runs/ppo/CartPole-v1/*model_manager_example*/version_*"), key=os.path.getmtime)
+    assert run_dirs, "the PPO run should have produced a versioned log dir"
+    run_dir = run_dirs[-1]
+    ckpts = sorted(glob.glob(os.path.join(run_dir, "checkpoint", "*.ckpt")), key=os.path.getmtime)
+    assert ckpts, f"no checkpoint under {run_dir}"
+    print(f"\n[1] trained PPO; checkpoint: {ckpts[-1]}")
+
+    # ---- 2. register the agent from the checkpoint (notebook: register_model)
+    from sheeprl_tpu.utils.checkpoint import load_state
+    from sheeprl_tpu.utils.model_manager import LocalModelManager
+
+    manager = LocalModelManager(None, registry_dir)
+    state = load_state(ckpts[-1])
+    with tempfile.TemporaryDirectory() as tmp:
+        agent_path = os.path.join(tmp, "agent.pkl")
+        with open(agent_path, "wb") as f:
+            pickle.dump(state["agent"], f, protocol=pickle.HIGHEST_PROTOCOL)
+        mv = manager.register_model(
+            agent_path,
+            "ppo_cartpole_agent",
+            description="PPO agent from the model-manager example",
+            tags={"algo": "ppo", "env": "CartPole-v1"},
+        )
+    print(f"[2] registered '{mv.name}' v{mv.version} at {mv.path}")
+
+    # ---- 3. retrieve the latest version (notebook: get_latest_version)
+    latest = manager.get_latest_version("ppo_cartpole_agent")
+    print(f"[3] latest version: v{latest.version} (stage={latest.stage!r}, description={latest.description!r})")
+
+    # ---- 4. transition it to a stage (notebook: transition_model to 'staging')
+    staged = manager.transition_model(
+        "ppo_cartpole_agent", latest.version, "staging", description="promoted by examples/model_manager.py"
+    )
+    print(f"[4] transitioned v{staged.version} -> stage {staged.stage!r}")
+
+    # ---- 5. register the best run under the experiment dir (the RL-flavored
+    # flow the notebook closes with: rank runs by a test metric, register the winner)
+    try:
+        best = manager.register_best_models(
+            os.path.dirname(run_dir), {"agent"}, metric="Test/cumulative_reward"
+        )
+        for name, version in best.items():
+            print(f"[5] best-run registration: '{name}' -> v{version.version} ({version.description})")
+    except RuntimeError as e:
+        # run_test=False or a metrics-less run leaves nothing to rank — not an error here
+        print(f"[5] best-run registration skipped: {e}")
+
+    # ---- 6. download an artifact copy (notebook: download_model)
+    with tempfile.TemporaryDirectory() as out:
+        manager.download_model("ppo_cartpole_agent", latest.version, out)
+        got = os.listdir(out)
+        print(f"[6] downloaded v{latest.version} artifact -> {got}")
+
+    # ---- 7. delete the version (notebook: delete_model) and show the changelog audit trail
+    manager.delete_model("ppo_cartpole_agent", latest.version, description="example cleanup")
+    print(f"[7] deleted v{latest.version}")
+    with open(os.path.join(registry_dir, "ppo_cartpole_agent", "CHANGELOG.md")) as f:
+        print("\n--- CHANGELOG.md (the registry's audit trail) ---")
+        print(f.read())
+    print(f"registry dir: {registry_dir}")
+
+
+if __name__ == "__main__":
+    main()

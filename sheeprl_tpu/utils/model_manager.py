@@ -260,6 +260,11 @@ class LocalModelManager:
         for root, _, files in os.walk(experiment_dir):
             if "metrics.json" not in files:
                 continue
+            if not os.path.isdir(os.path.join(root, "checkpoint")):
+                # the logger drops a metrics.json copy in the writer dir too
+                # (parent of the versioned run dir); only a root that also owns
+                # the run's checkpoints can supply the model pytrees
+                continue
             with open(os.path.join(root, "metrics.json")) as f:
                 metrics = json.load(f)
             score = metrics.get(metric)
@@ -276,8 +281,11 @@ class LocalModelManager:
         ) if os.path.isdir(ckpt_dir) else []
         if not ckpts:
             raise RuntimeError(f"The best run '{best_run}' (score {best_score}) has no checkpoint to register")
-        with open(ckpts[-1], "rb") as f:
-            state = pickle.load(f)
+        # checkpoints are versioned containers (utils/checkpoint.py), not raw
+        # pickles: load_state decodes the envelope (and still reads legacy files)
+        from sheeprl_tpu.utils.checkpoint import load_state
+
+        state = load_state(ckpts[-1])
         out = {}
         with tempfile.TemporaryDirectory(prefix="sheeprl_tpu_best_") as tmp:
             for name in sorted(models_keys):

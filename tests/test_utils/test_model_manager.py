@@ -131,3 +131,28 @@ def test_register_best_models(manager, tmp_path):
     tree = manager.load_model("agent")
     assert np.allclose(tree["w"], 5.0)  # run_b won
     assert "Best Test/cumulative_reward: 5.0" in manager.get_latest_version("agent").description
+
+
+def test_register_best_models_real_run_layout(manager, tmp_path):
+    """The real logger layout: a metrics.json COPY in the writer dir (parent,
+    no checkpoint sibling) plus the versioned run dir holding a v1-container
+    checkpoint — ranking must pick the root that owns the checkpoints, and the
+    loader must decode the versioned envelope (both regressions caught by
+    examples/model_manager.py)."""
+    import json
+
+    from sheeprl_tpu.utils.checkpoint import save_state
+
+    exp = tmp_path / "exp" / "2026-01-01_ppo_42"
+    run = exp / "version_0"
+    (run / "checkpoint").mkdir(parents=True)
+    metrics = {"Test/cumulative_reward": 7.0}
+    with open(exp / "metrics.json", "w") as f:  # writer-dir copy, no checkpoint/ here
+        json.dump(metrics, f)
+    with open(run / "metrics.json", "w") as f:
+        json.dump(metrics, f)
+    save_state(str(run / "checkpoint" / "ckpt_1_0.ckpt"), {"agent": {"w": np.full((2,), 7.0)}, "iter_num": 1})
+
+    out = manager.register_best_models(str(exp), {"agent"})
+    assert set(out) == {"agent"}
+    assert np.allclose(manager.load_model("agent")["w"], 7.0)
