@@ -89,6 +89,10 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     if transport is not None:
         transport.set_scope(log_dir)  # run-scope the KV spec exchange (coordinator store outlives runs)
+        if cfg.checkpoint.resume_from:
+            # every process loaded its own copy of the checkpoint: verify they
+            # are the SAME file before any of its state drives a collective
+            transport.verify_resume_digest(cfg.checkpoint.resume_from)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
     runtime.print(

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import copy
 import json
+import os
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -33,7 +34,21 @@ from sheeprl_tpu.core.runtime import Runtime
 
 
 def _kv_client():
-    """The coordinator's key-value store client (None if unavailable)."""
+    """The coordinator's key-value store client (None if unavailable).
+
+    jax 0.9 only exposes the client at the private path; probe a public
+    location first so that a future jax that promotes it keeps working even
+    if the private module moves (graceful degradation instead of a dead
+    feature on upgrade — advisor r4 finding).
+    """
+    try:
+        import jax.distributed as jd
+
+        client = getattr(getattr(jd, "global_state", None), "client", None)
+        if client is not None:
+            return client
+    except Exception:  # pragma: no cover - future-API probe only
+        pass
     try:
         from jax._src import distributed
 
@@ -116,6 +131,54 @@ class CrossHostTransport:
         """
         self._scope = str(scope)
 
+    def _scope_key(self, tag: str) -> str:
+        """Run-scoped KV key shared by the spec and digest exchanges."""
+        import hashlib
+
+        scope = hashlib.sha1(self._scope.encode()).hexdigest()[:12] if self._scope else "unscoped"
+        return f"sheeprl_tpu/decoupled/{scope}/{tag}"
+
+    def verify_resume_digest(self, ckpt_path: str, timeout_ms: int = 600_000) -> None:
+        """Fail fast when processes resume from DIFFERENT copies of a checkpoint.
+
+        Every process calls ``load_state(resume_from)`` against its own
+        filesystem; without a shared FS a stale or divergent copy on one host
+        would desync host-side schedulers (e.g. the Ratio state) and surface
+        only much later as a hung broadcast or shape mismatch (advisor r4
+        finding). Process 0 publishes a cheap content digest — (size, sha1 of
+        the first and last 1 MiB) — through the coordinator KV store; every
+        other process verifies its local file against it before training
+        starts. Multi-GB buffer-in-checkpoint files are never fully hashed.
+        """
+        import hashlib
+
+        def digest() -> str:
+            chunk = 1 << 20
+            size = os.path.getsize(ckpt_path)
+            h = hashlib.sha1()
+            with open(ckpt_path, "rb") as f:
+                h.update(f.read(chunk))
+                if size > chunk:
+                    f.seek(max(size - chunk, chunk))
+                    h.update(f.read(chunk))
+            return f"{size}:{h.hexdigest()}"
+
+        client = _kv_client()
+        if client is None:  # single-process split_runtime path: nothing to compare
+            return
+        key = self._scope_key("resume_digest")
+        local = digest()
+        if self.is_player_process:
+            client.key_value_set(key, local, allow_overwrite=True)
+        else:
+            published = client.blocking_key_value_get(key, timeout_ms)
+            if published != local:
+                raise RuntimeError(
+                    f"Resume checkpoint mismatch: this process's copy of '{ckpt_path}' "
+                    f"(digest {local}) differs from process 0's (digest {published}). "
+                    "All processes must resume from the same checkpoint file."
+                )
+
     def sync_payload_spec(
         self, tag: str, flat: Optional[Dict[str, Any]] = None, timeout_ms: int = 86_400_000
     ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
@@ -145,10 +208,12 @@ class CrossHostTransport:
                 "(jax.distributed.initialize must have run in every process); "
                 "this jax version does not expose it"
             )
-        import hashlib
-
-        scope = hashlib.sha1(self._scope.encode()).hexdigest()[:12] if self._scope else "unscoped"
-        key = f"sheeprl_tpu/decoupled/{scope}/{tag}"
+        # The scope string is the run's log_dir, which ends in a fresh
+        # ``version_N`` minted per process incarnation (get_log_dir bumps it
+        # even on resume) — it doubles as the run nonce that keeps a still-live
+        # coordinator from handing a resumed run the previous incarnation's
+        # spec under the same key (advisor r4 finding).
+        key = self._scope_key(tag)
         if self.is_player_process:
             if flat is None:
                 raise ValueError("the player process must provide the payload to publish its spec")

@@ -100,9 +100,19 @@ def save_state(path: str, state: Dict[str, Any]) -> None:
 
 
 def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
-    """The stored leaf manifest (None for legacy bare-pickle checkpoints)."""
+    """The stored leaf manifest (None for legacy bare-pickle checkpoints).
+
+    Cost: O(header). A v1 header pickles its magic within the first bytes of
+    the stream, so a legacy file (whose FIRST pickle is the entire state —
+    potentially multi-GB with buffer-in-checkpoint) is recognized from a
+    256-byte sniff and never unpickled (advisor r4 finding).
+    """
     with open(path, "rb") as f:
-        obj = pickle.load(f)
+        head = f.read(256)
+        if _CKPT_MAGIC.encode() not in head:
+            return None  # legacy bare pickle: no container header to read
+        f.seek(0)
+        obj = pickle.load(f)  # v1: this first pickle is just the small header
     if isinstance(obj, dict) and obj.get("__format__") == _CKPT_MAGIC:
         return obj.get("manifest")
     return None

@@ -102,3 +102,30 @@ def test_legacy_bare_pickle_still_loads(tmp_path):
         pickle.dump({"iter_num": 3, "agent": np.ones((2,), np.float32)}, f)
     state = load_state(path)
     assert state["iter_num"] == 3
+
+
+def test_read_manifest_never_unpickles_legacy_payload(tmp_path, monkeypatch):
+    """Legacy bare pickles are recognized from a header sniff; the (potentially
+    multi-GB) state pickle must not be loaded just to return None (advisor r4)."""
+    import pickle
+
+    import numpy as np
+
+    from sheeprl_tpu.utils import checkpoint as ckpt_mod
+
+    legacy = tmp_path / "legacy.ckpt"
+    with open(legacy, "wb") as f:
+        pickle.dump({"agent": np.zeros((8, 8))}, f)
+
+    def boom(*a, **k):  # any unpickle of the legacy file is the regression
+        raise AssertionError("read_manifest unpickled a legacy checkpoint payload")
+
+    monkeypatch.setattr(ckpt_mod.pickle, "load", boom)
+    assert ckpt_mod.read_manifest(str(legacy)) is None
+
+    # v1 container: only the header pickle is read (small), manifest returned
+    monkeypatch.undo()
+    v1 = tmp_path / "v1.ckpt"
+    ckpt_mod.save_state(str(v1), {"agent": np.ones((2, 2))})
+    manifest = ckpt_mod.read_manifest(str(v1))
+    assert manifest is not None and any("agent" in k for k in manifest)

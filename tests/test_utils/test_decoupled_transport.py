@@ -83,3 +83,31 @@ def test_player_must_provide_payload(transport_pair):
     player, _, _ = transport_pair
     with pytest.raises(ValueError, match="must provide the payload"):
         player.sync_payload_spec("empty")
+
+
+def test_resume_digest_match_and_mismatch(transport_pair, tmp_path):
+    """Process 0 publishes its checkpoint digest; a trainer process with the
+    same file passes, one with a divergent copy fails fast (advisor r4)."""
+    player, trainer, _ = transport_pair
+    ckpt = tmp_path / "ckpt_1_0.ckpt"
+    ckpt.write_bytes(b"same-bytes" * 1000)
+
+    player.verify_resume_digest(str(ckpt))
+    trainer.verify_resume_digest(str(ckpt))  # identical copy: no raise
+
+    stale = tmp_path / "stale.ckpt"
+    stale.write_bytes(b"other-bytes" * 1000)
+    with pytest.raises(RuntimeError, match="Resume checkpoint mismatch"):
+        trainer.verify_resume_digest(str(stale))
+
+
+def test_resume_digest_scoped_per_run(transport_pair, tmp_path):
+    """Digests ride the same run-scoped keys as the payload specs."""
+    player, trainer, kv = transport_pair
+    ckpt = tmp_path / "c.ckpt"
+    ckpt.write_bytes(b"x" * 64)
+    player.set_scope("logs/runs/a/version_0")
+    player.verify_resume_digest(str(ckpt))
+    trainer.set_scope("logs/runs/a/version_1")  # different incarnation
+    with pytest.raises(TimeoutError):
+        trainer.verify_resume_digest(str(ckpt))
