@@ -1,0 +1,79 @@
+"""Summarize a reward curve: peak, late-window stability, verdict-style stats.
+
+Reads either a ``<name>_curve.csv`` (step,reward rows) or a raw training log
+(scraped with train_curve's regex), writes the CSV/PNG via train_curve's
+helpers when given a log, and prints a stability summary:
+
+- running-mean peak (window k = n/50, the PNG's smoothing),
+- final-20%-window mean and its ratio to the peak,
+- episode count and step span.
+
+Usage:
+  python scripts/curve_stats.py benchmarks/results/dv3_dmc_walker_walk_curve.csv
+  python scripts/curve_stats.py /tmp/walker_r5.log --emit dv3_dmc_walker_walk
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from train_curve import parse_curve, write_outputs  # noqa: E402
+
+
+def load_points(path: str):
+    if path.endswith(".csv"):
+        pts = []
+        with open(path) as f:
+            for line in f:
+                step, rew = line.strip().split(",")
+                pts.append((int(step), float(rew)))
+        return pts
+    with open(path) as f:
+        return parse_curve(f.read())
+
+
+def stats(points) -> dict:
+    steps = np.array([p[0] for p in points], dtype=np.int64)
+    rews = np.array([p[1] for p in points], dtype=np.float64)
+    k = max(1, len(rews) // 50)
+    running = np.convolve(rews, np.ones(k) / k, mode="valid")
+    peak = float(running.max())
+    peak_step = int(steps[k - 1 :][int(running.argmax())])
+    cutoff = steps[0] + (steps[-1] - steps[0]) * 0.8
+    late = rews[steps >= cutoff]
+    late_mean = float(late.mean()) if late.size else float("nan")
+    return {
+        "episodes": len(points),
+        "first_step": int(steps[0]),
+        "last_step": int(steps[-1]),
+        "running_peak": round(peak, 2),
+        "peak_step": peak_step,
+        "late20_mean": round(late_mean, 2),
+        "late20_episodes": int(late.size),
+        "late20_over_peak": round(late_mean / peak, 3) if peak else None,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("path", help="curve CSV or raw training log")
+    ap.add_argument("--emit", default=None, help="also write <name>_curve.{csv,png} from a log")
+    args = ap.parse_args()
+    points = load_points(args.path)
+    if not points:
+        print("no reward points found", file=sys.stderr)
+        sys.exit(1)
+    if args.emit:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        write_outputs(args.emit, points, os.path.join(repo, "benchmarks", "results"))
+    for k, v in stats(points).items():
+        print(f"{k}: {v}")
+
+
+if __name__ == "__main__":
+    main()
