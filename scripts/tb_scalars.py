@@ -1,0 +1,51 @@
+"""Dump TensorBoard scalar series from a run dir as CSV lines.
+
+Used for post-hoc analysis of metrics the reward-curve scraper doesn't carry
+(e.g. Dream-and-Ponder's ``State/expected_ponder_steps`` — the PonderNet
+paper's own halting diagnostic).
+
+Usage:
+  python scripts/tb_scalars.py logs/runs/dream_and_ponder/.../version_0 State/expected_ponder_steps
+  python scripts/tb_scalars.py <run_dir>            # list available tags
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> None:
+    if len(sys.argv) < 2:
+        print(__doc__)
+        raise SystemExit(2)
+    run_dir = sys.argv[1]
+    tags = sys.argv[2:]
+
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    acc = EventAccumulator(run_dir, size_guidance={"scalars": 0})
+    acc.Reload()
+    available = acc.Tags().get("scalars", [])
+    if not tags:
+        print("\n".join(sorted(available)))
+        return
+    for tag in tags:
+        if tag not in available:
+            print(f"# tag not found: {tag} (available: {sorted(available)})", file=sys.stderr)
+            continue
+        for ev in acc.Scalars(tag):
+            print(f"{tag},{ev.step},{ev.value}")
+
+
+if __name__ == "__main__":
+    main()
+
+
+def series(run_dir: str, tag: str):
+    """Programmatic access: [(step, value), ...] for one scalar tag."""
+    from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+
+    acc = EventAccumulator(run_dir, size_guidance={"scalars": 0})
+    acc.Reload()
+    return [(ev.step, ev.value) for ev in acc.Scalars(tag)]
