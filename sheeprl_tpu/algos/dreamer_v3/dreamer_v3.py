@@ -331,6 +331,11 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                 world_grad_norm,
                 actor_grad_norm,
                 critic_grad_norm,
+                # return-normalizer state: the advantage scale divisor is
+                # max(1e-8, high-low); its drift is the first thing to check
+                # when a policy degrades under a healthy world model+critic
+                aux_a["moments"].low,
+                aux_a["moments"].high,
             ]
         )
         return (new_params, DV3OptStates(world_opt, actor_opt, critic_opt), aux_a["moments"], counter + 1), metrics
@@ -356,6 +361,8 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
             "Grads/world_model": m[10],
             "Grads/actor": m[11],
             "Grads/critic": m[12],
+            "State/moments_low": m[13],
+            "State/moments_high": m[14],
         }
         # raveled player subset computed in-graph: the host-player refresh is one
         # flat transfer, not a per-leaf pull (see DreamerPlayerSync)

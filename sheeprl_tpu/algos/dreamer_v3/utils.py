@@ -25,6 +25,8 @@ AGGREGATOR_KEYS = {
     "Loss/state_loss",
     "Loss/continue_loss",
     "State/kl",
+    "State/moments_low",
+    "State/moments_high",
     "State/post_entropy",
     "State/prior_entropy",
     "Grads/world_model",
