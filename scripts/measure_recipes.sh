@@ -1,0 +1,16 @@
+#!/bin/bash
+# Wall-clock recipe rows for the README table (run on the real chip, chip idle).
+# Dreamer rows use the dummy pixel env at the reference benchmark shapes
+# (Atari is an optional dependency, absent here) — same substitution the r4
+# measurements made, now with the host-CPU player + amortized param sync.
+set -u
+cd "$(dirname "$0")/.."
+for args in \
+  "dreamer_v1 env=dummy env.id=discrete_dummy env.capture_video=False algo.player_sync_every=16" \
+  "dreamer_v2 env=dummy env.id=discrete_dummy env.capture_video=False algo.player_sync_every=16" \
+  "dreamer_v3 env=dummy env.id=discrete_dummy env.capture_video=False algo.player_sync_every=16" \
+  "sac algo.player_sync_every=16" \
+  ; do
+  echo "=== $args"
+  timeout 1800 python benchmarks/benchmark.py $args 2>&1 | tail -1
+done
