@@ -17,6 +17,18 @@ import time
 import warnings
 from typing import Any, Dict, List, Optional, Sequence
 
+# Honor JAX_PLATFORMS at the CONFIG level before any backend discovery: the
+# env var alone selects the backend but does not stop jax from eagerly
+# initializing every registered PJRT plugin (e.g. a tunneled TPU plugin
+# registered by sitecustomize) — a dead tunnel then hangs even
+# JAX_PLATFORMS=cpu child processes at first jax.devices(). The config update
+# gates discovery to the requested platforms only (same pattern as
+# tests/conftest.py and the __graft_entry__ dryrun child).
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 from sheeprl_tpu.config import ConfigError, compose
 from sheeprl_tpu.core.runtime import Runtime, build_runtime, seed_everything
 from sheeprl_tpu.utils.checkpoint import CheckpointCallback, load_state
