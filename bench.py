@@ -247,6 +247,39 @@ def _regression_check(result: dict) -> None:
 
 
 if __name__ == "__main__":
+    # Fail FAST if the accelerator is unreachable (a dead tunnel parks every
+    # device RPC forever — seen in round 5 when the relay process died): probe
+    # backend discovery under a watchdog and emit a diagnosable one-line record
+    # instead of hanging the driver's bench step.
+    import threading
+
+    probe_done = threading.Event()
+
+    def _watchdog():
+        if not probe_done.wait(180):
+            print(
+                json.dumps(
+                    {
+                        "metric": "ppo_cartpole_env_steps_per_sec",
+                        "value": None,
+                        "unit": "env-steps/s",
+                        "vs_baseline": None,
+                        "error": "accelerator unreachable: backend discovery exceeded 180s "
+                        "(tunnel/relay down?)",
+                    }
+                ),
+                flush=True,
+            )
+            import os
+
+            os._exit(3)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    import jax
+
+    jax.devices()
+    probe_done.set()
+
     # stdout must carry EXACTLY one JSON line: the CLI's config dump and progress
     # prints go to stderr instead
     with contextlib.redirect_stdout(sys.stderr):
