@@ -220,3 +220,26 @@ def test_decoupled_requires_two_devices(tmp_path, monkeypatch):
                 "dry_run=True",
             ]
         )
+
+
+def test_cli_gates_backend_discovery_to_env_platforms(tmp_path):
+    """JAX_PLATFORMS=cpu children must never initialize unrequested PJRT
+    plugins: the env var selects a backend but does not gate eager plugin
+    discovery, so a dead tunneled-TPU plugin hangs the process (round-5
+    outage). cli.py applies the config-level jax_platforms gate; this pins
+    the gate plus the resulting backend."""
+    out = subprocess.run(
+        [
+            sys.executable,
+            "-c",
+            "import sheeprl_tpu.cli, jax; "
+            "assert jax.config.jax_platforms == 'cpu', jax.config.jax_platforms; "
+            "print(jax.devices()[0].platform)",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.stdout.strip().endswith("cpu")
