@@ -111,6 +111,11 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     tau = float(cfg.algo.critic.tau)
     moments_cfg = cfg.algo.actor.moments
+    actor_objective_mode = str(cfg.algo.actor.get("objective", "auto"))
+    if actor_objective_mode not in ("auto", "reinforce"):
+        raise ValueError(
+            f"algo.actor.objective must be 'auto' or 'reinforce', got {actor_objective_mode!r}"
+        )
     n_ponder = int(cfg.algo.ponder.max_ponder_steps)
     ponder_beta = float(cfg.algo.ponder.get("beta", 0.01))
     ponder_prior = jnp.asarray(geometric_prior(n_ponder, float(cfg.algo.ponder.lambda_prior_geom)))
@@ -231,7 +236,9 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                 actor_params, jax.lax.stop_gradient(latent0), method=PonderActor.ponder_train
             )  # pre0: each [TB, N, dim]; halt_dist [TB, N]
             out0 = ActorOutput(actor, pre0)
-            actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)  # [TB, N, A]
+            acts0, raws0 = out0.sample_actions_with_raw(key0)
+            actions0 = jnp.concatenate(acts0, axis=-1)  # [TB, N, A]
+            raw0 = branch_major(jnp.concatenate(raws0, axis=-1))  # [N*TB, A]
             a0 = branch_major(actions0)  # [N*TB, A]
             pre0_b = [branch_major(p) for p in pre0]  # each [N*TB, dim]
             prior_b = jnp.tile(start_prior, (n_ponder, 1))
@@ -248,21 +255,24 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                     actor_params, jax.lax.stop_gradient(latent), k_halt, method=PonderActor.ponder_infer
                 )
                 out = ActorOutput(actor, pre)
-                act = jnp.concatenate(out.sample_actions(k_act), axis=-1)
-                return (prior, rec, act), (latent, act, tuple(pre))
+                new_acts, new_raws = out.sample_actions_with_raw(k_act)
+                act = jnp.concatenate(new_acts, axis=-1)
+                raw = jnp.concatenate(new_raws, axis=-1)
+                return (prior, rec, act), (latent, act, raw, tuple(pre))
 
-            _, (latents, acts, pre_seq) = jax.lax.scan(step, (prior_b, rec_b, a0), keys)
+            _, (latents, acts, raws, pre_seq) = jax.lax.scan(step, (prior_b, rec_b, a0), keys)
             trajectories = jnp.concatenate([latent0_b[None], latents], axis=0)  # [H+1, N*TB, L]
             im_actions = jnp.concatenate([a0[None], acts], axis=0)  # [H+1, N*TB, A]
+            im_actions_raw = jnp.concatenate([raw0[None], raws], axis=0)  # [H+1, N*TB, A]
             # Per-timestep pre-distributions: the branch's own train-mode dist at
             # t=0, the halting-mode dists afterwards.
             full_pre = [
                 jnp.concatenate([p0[None], ps], axis=0) for p0, ps in zip(pre0_b, pre_seq)
             ]  # each [H+1, N*TB, dim]
-            return trajectories, im_actions, full_pre, halt_dist
+            return trajectories, im_actions, im_actions_raw, full_pre, halt_dist
 
         def actor_loss_fn(actor_params):
-            trajectories, im_actions, full_pre, halt_dist = imagine(actor_params, k_img0, img_keys)
+            trajectories, im_actions, im_actions_raw, full_pre, halt_dist = imagine(actor_params, k_img0, img_keys)
             predicted_values = TwoHotEncodingDistribution(
                 modules.critic.apply(params["critic"], trajectories), dims=1
             ).mean
@@ -289,11 +299,13 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
             advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
 
             policies = ActorOutput(actor, full_pre)
-            if is_continuous:
+            if is_continuous and actor_objective_mode != "reinforce":
                 objective = advantage
             else:
+                # score-function estimator at the RAW (pre-clip) samples — see
+                # dreamer_v3.py and benchmarks/WALKER_WALK_NOTES.md
                 splits = np.cumsum(np.asarray(actions_dim))[:-1]
-                action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+                action_parts = jnp.split(jax.lax.stop_gradient(im_actions_raw), splits, axis=-1)
                 log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))  # [H+1, N*TB]
                 objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
             try:

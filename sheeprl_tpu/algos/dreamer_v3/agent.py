@@ -553,6 +553,18 @@ class ActorOutput:
             ]
 
     def sample_actions(self, key: jax.Array, greedy: bool = False) -> List[jax.Array]:
+        return self.sample_actions_with_raw(key, greedy=greedy)[0]
+
+    def sample_actions_with_raw(self, key: jax.Array, greedy: bool = False):
+        """(clipped actions, raw pre-clip samples).
+
+        The raw sample is the point at which a score-function (REINFORCE)
+        estimator must evaluate log-prob: for a saturated continuous policy the
+        clip rescaling moves ~half the samples onto the boundary, and log-prob
+        at the CLIPPED point no longer estimates the sampled policy's score
+        (walker_walk measures 40-46% saturation, benchmarks/WALKER_WALK_NOTES.md).
+        The env/dynamics always consume the clipped actions.
+        """
         if self.actor.is_continuous:
             if greedy:
                 # Reference draws 100 samples and takes the max-log-prob one
@@ -561,14 +573,17 @@ class ActorOutput:
                 actions = self.dists[0].mode
             else:
                 actions = self.dists[0].rsample(key)
+            raw = actions
             if self.actor.action_clip > 0.0:
                 clip = jnp.full_like(actions, self.actor.action_clip)
                 actions = actions * jax.lax.stop_gradient(clip / jnp.maximum(clip, jnp.abs(actions)))
-            return [actions]
+            return [actions], [raw]
         keys = jax.random.split(key, len(self.dists))
         if greedy:
-            return [d.mode for d in self.dists]
-        return [d.rsample(k) for d, k in zip(self.dists, keys)]
+            modes = [d.mode for d in self.dists]
+            return modes, modes
+        samples = [d.rsample(k) for d, k in zip(self.dists, keys)]
+        return samples, samples
 
     def log_prob(self, actions: List[jax.Array]) -> jax.Array:
         """Summed log-prob across heads; ``[...,]`` shaped."""

@@ -94,6 +94,11 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     tau = float(cfg.algo.critic.tau)
     moments_cfg = cfg.algo.actor.moments
+    actor_objective = str(cfg.algo.actor.get("objective", "auto"))
+    if actor_objective not in ("auto", "reinforce"):
+        raise ValueError(
+            f"algo.actor.objective must be 'auto' or 'reinforce', got {actor_objective!r}"
+        )
     imagination_unroll = int(cfg.algo.get("imagination_scan_unroll", 1))
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
 
@@ -201,10 +206,13 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         true_continue = continues_targets.reshape(-1, 1)  # [T*B, 1]
 
         def imagine(actor_params, key0, keys):
-            """H+1-step differentiable imagination -> (trajectories, actions, entropies)."""
+            """H+1-step differentiable imagination -> (trajectories, clipped actions,
+            raw pre-clip samples — the score-function evaluation points)."""
             latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
             out0 = ActorOutput(modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(latent0)))
-            actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)
+            acts0, raws0 = out0.sample_actions_with_raw(key0)
+            actions0 = jnp.concatenate(acts0, axis=-1)
+            raw0 = jnp.concatenate(raws0, axis=-1)
 
             def step(carry, k):
                 prior_flat, rec_state, act = carry
@@ -215,20 +223,23 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                 out = ActorOutput(
                     modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(latent))
                 )
-                new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
-                return (prior_flat, rec_state, new_act), (latent, new_act)
+                new_acts, new_raws = out.sample_actions_with_raw(k_act_step)
+                new_act = jnp.concatenate(new_acts, axis=-1)
+                new_raw = jnp.concatenate(new_raws, axis=-1)
+                return (prior_flat, rec_state, new_act), (latent, new_act, new_raw)
 
-            _, (latents, acts) = jax.lax.scan(
+            _, (latents, acts, raws) = jax.lax.scan(
                 step, (start_prior, start_recurrent, actions0), keys, unroll=imagination_unroll
             )
             trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
             im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
-            return trajectories, im_actions
+            im_actions_raw = jnp.concatenate([raw0[None], raws], axis=0)  # [H+1, TB, A]
+            return trajectories, im_actions, im_actions_raw
 
         img_keys = jax.random.split(k_img, horizon)
 
         def actor_loss_fn(actor_params):
-            trajectories, im_actions = imagine(actor_params, k_img0, img_keys)
+            trajectories, im_actions, im_actions_raw = imagine(actor_params, k_img0, img_keys)
             predicted_values = TwoHotEncodingDistribution(
                 modules.critic.apply(params["critic"], trajectories), dims=1
             ).mean
@@ -259,11 +270,22 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
             policies = ActorOutput(
                 modules.actor, modules.actor.apply(actor_params, jax.lax.stop_gradient(trajectories))
             )
-            if is_continuous:
+            if is_continuous and actor_objective != "reinforce":
+                # reference parity: direct advantage (dynamics backprop) for
+                # continuous actions. The walker_walk forensics measured this
+                # gradient as noise-dominated at the trained-policy state
+                # (key-to-key update cosine ~0, benchmarks/WALKER_WALK_NOTES.md);
+                # algo.actor.objective=reinforce opts continuous actors into the
+                # low-variance score-function estimator the discrete branch uses
+                # (the DreamerV3 paper's own default for all action spaces).
                 objective = advantage
             else:
+                # score-function estimator: log-prob evaluated at the RAW samples
+                # (clipping rescales saturated continuous actions onto the
+                # boundary, where the clipped point's log-prob is not the
+                # sampled policy's score; discrete raw == clipped)
                 splits = np.cumsum(np.asarray(actions_dim))[:-1]
-                action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+                action_parts = jnp.split(jax.lax.stop_gradient(im_actions_raw), splits, axis=-1)
                 log_probs = sum(
                     d.log_prob(a) for d, a in zip(policies.dists, action_parts)
                 )  # [H+1, TB]

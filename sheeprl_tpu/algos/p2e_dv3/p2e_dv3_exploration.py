@@ -103,6 +103,11 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
     target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
     tau = float(cfg.algo.critic.tau)
     moments_cfg = cfg.algo.actor.moments
+    actor_objective_mode = str(cfg.algo.actor.get("objective", "auto"))
+    if actor_objective_mode not in ("auto", "reinforce"):
+        raise ValueError(
+            f"algo.actor.objective must be 'auto' or 'reinforce', got {actor_objective_mode!r}"
+        )
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
 
     world_tx = with_clipping(
@@ -145,7 +150,9 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
         from the actor on the (detached) latent, then one RSSM imagination step."""
         latent0 = jnp.concatenate([start_prior, start_recurrent], axis=-1)
         out0 = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent0)))
-        actions0 = jnp.concatenate(out0.sample_actions(key0), axis=-1)
+        acts0, raws0 = out0.sample_actions_with_raw(key0)
+        actions0 = jnp.concatenate(acts0, axis=-1)
+        raw0 = jnp.concatenate(raws0, axis=-1)
 
         def step(carry, k):
             prior_flat, rec_state, act = carry
@@ -154,23 +161,28 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
             prior_flat = prior.reshape(prior_flat.shape)
             latent = jnp.concatenate([prior_flat, rec_state], axis=-1)
             out = ActorOutput(actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(latent)))
-            new_act = jnp.concatenate(out.sample_actions(k_act_step), axis=-1)
-            return (prior_flat, rec_state, new_act), (latent, new_act)
+            new_acts, new_raws = out.sample_actions_with_raw(k_act_step)
+            new_act = jnp.concatenate(new_acts, axis=-1)
+            new_raw = jnp.concatenate(new_raws, axis=-1)
+            return (prior_flat, rec_state, new_act), (latent, new_act, new_raw)
 
-        _, (latents, acts) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
+        _, (latents, acts, raws) = jax.lax.scan(step, (start_prior, start_recurrent, actions0), keys)
         trajectories = jnp.concatenate([latent0[None], latents], axis=0)  # [H+1, TB, L]
         im_actions = jnp.concatenate([actions0[None], acts], axis=0)  # [H+1, TB, A]
-        return trajectories, im_actions
+        im_actions_raw = jnp.concatenate([raw0[None], raws], axis=0)  # [H+1, TB, A]
+        return trajectories, im_actions, im_actions_raw
 
-    def actor_objective(actor_mod, actor_params, trajectories, im_actions, advantage):
+    def actor_objective(actor_mod, actor_params, trajectories, im_actions_raw, advantage):
         policies = ActorOutput(
             actor_mod, actor_mod.apply(actor_params, jax.lax.stop_gradient(trajectories))
         )
-        if is_continuous:
+        if is_continuous and actor_objective_mode != "reinforce":
             objective = advantage
         else:
+            # score-function estimator at the RAW (pre-clip) samples — see
+            # dreamer_v3.py and benchmarks/WALKER_WALK_NOTES.md
             splits = np.cumsum(np.asarray(actions_dim))[:-1]
-            action_parts = jnp.split(jax.lax.stop_gradient(im_actions), splits, axis=-1)
+            action_parts = jnp.split(jax.lax.stop_gradient(im_actions_raw), splits, axis=-1)
             log_probs = sum(d.log_prob(a) for d, a in zip(policies.dists, action_parts))  # [H+1, TB]
             objective = log_probs[..., None][:-1] * jax.lax.stop_gradient(advantage)
         try:
@@ -315,7 +327,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
         # ---- (3) exploration actor on the weighted multi-critic advantage
         # (reference :259-333)
         def actor_expl_loss_fn(actor_params):
-            trajectories, im_actions = imagine(
+            trajectories, im_actions, im_actions_raw = imagine(
                 modules.actor_exploration, actor_params, new_wm, start_prior, start_recurrent, k_expl0, expl_keys
             )
             continues = Independent(
@@ -357,7 +369,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
                 }
 
             objective, entropy = actor_objective(
-                modules.actor_exploration, actor_params, trajectories, im_actions, advantage
+                modules.actor_exploration, actor_params, trajectories, im_actions_raw, advantage
             )
             p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
             aux_e = {
@@ -409,7 +421,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
 
         # ---- (5) zero-shot task behaviour, exactly DreamerV3 (reference :375-487)
         def actor_task_loss_fn(actor_params):
-            trajectories, im_actions = imagine(
+            trajectories, im_actions, im_actions_raw = imagine(
                 modules.actor_task, actor_params, new_wm, start_prior, start_recurrent, k_task0, task_keys
             )
             predicted_values = TwoHotEncodingDistribution(
@@ -429,7 +441,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
             offset, invscale, new_task_moments = norm_moments("task", moments, lambda_values)
             advantage = (lambda_values - offset) / invscale - (predicted_values[:-1] - offset) / invscale
             objective, entropy = actor_objective(
-                modules.actor_task, actor_params, trajectories, im_actions, advantage
+                modules.actor_task, actor_params, trajectories, im_actions_raw, advantage
             )
             p_loss = -jnp.mean(jax.lax.stop_gradient(discount[:-1]) * (objective + entropy[..., None][:-1]))
             aux_t = {
