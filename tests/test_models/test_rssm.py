@@ -88,3 +88,46 @@ def test_imagination_step_shapes():
     assert rec.shape == (B, R)
     # one-hot per categorical
     assert jnp.allclose(prior.reshape(B, S, D).sum(-1), 1.0)
+
+
+def test_dv3_actor_raw_samples_contract():
+    """sample_actions_with_raw: the env/dynamics consume CLIPPED actions, the
+    score-function estimator evaluates log-prob at the RAW samples (clipping
+    rescales saturated continuous samples onto the boundary, where log-prob is
+    not the sampled policy's score — benchmarks/WALKER_WALK_NOTES.md)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import Actor, ActorOutput
+
+    actor = Actor(
+        latent_state_size=8,
+        actions_dim=(3,),
+        is_continuous=True,
+        distribution="auto",
+        dense_units=8,
+        mlp_layers=1,
+    )
+    latent = jnp.linspace(-3, 3, 2 * 8).reshape(2, 8)
+    params = actor.init(jax.random.PRNGKey(0), latent)
+    out = ActorOutput(actor, actor.apply(params, latent))
+    (clipped,), (raw,) = out.sample_actions_with_raw(jax.random.PRNGKey(1))
+    assert clipped.shape == raw.shape == (2, 3)
+    # clipped action is the clip-rescaled raw sample; inside the box they agree
+    np.testing.assert_allclose(
+        np.asarray(clipped), np.clip(np.asarray(raw), -1.0, 1.0) * 0 + np.asarray(raw) * np.minimum(1.0, 1.0 / np.abs(np.asarray(raw))), rtol=1e-5
+    )
+    assert np.all(np.abs(np.asarray(clipped)) <= 1.0 + 1e-6)
+    # sample_actions returns exactly the clipped list
+    (via_plain,) = ActorOutput(actor, actor.apply(params, latent)).sample_actions(jax.random.PRNGKey(1))
+    np.testing.assert_allclose(np.asarray(via_plain), np.asarray(clipped), rtol=1e-6)
+    # discrete: raw == clipped (one-hot samples)
+    dactor = Actor(
+        latent_state_size=8, actions_dim=(4,), is_continuous=False, distribution="auto",
+        dense_units=8, mlp_layers=1,
+    )
+    dparams = dactor.init(jax.random.PRNGKey(0), latent)
+    dout = ActorOutput(dactor, dactor.apply(dparams, latent))
+    (dc,), (dr,) = dout.sample_actions_with_raw(jax.random.PRNGKey(2))
+    np.testing.assert_array_equal(np.asarray(dc), np.asarray(dr))
