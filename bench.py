@@ -203,6 +203,68 @@ def bench_dv3(
     }
 
 
+def bench_smoke(total_steps: int = 128) -> dict:
+    """Tiny PPO pass on the CPU backend for BOTH buffer backends.
+
+    Exists so the bench harness itself is exercised by the test suite (as a
+    non-slow test) while the accelerator tunnel is down: every BENCH_*.json
+    round since r2 failed on reachability, which also meant nobody would notice
+    the harness bit-rotting. Runs on the dummy env, a 16-step rollout, and both
+    ``buffer.backend=host`` and ``buffer.backend=device`` so the on-policy HBM
+    rollout path is covered too. Numbers are NOT comparable to the real bench.
+    """
+    from sheeprl_tpu.cli import run
+
+    result = {
+        "metric": _target_metric("smoke"),
+        "unit": "env-steps/s",
+        "smoke": True,
+    }
+    for backend in ("host", "device"):
+        t0 = time.perf_counter()
+        run(
+            overrides=[
+                "exp=ppo",
+                f"algo.total_steps={total_steps}",
+                "algo.rollout_steps=16",
+                "algo.per_rank_batch_size=8",
+                "algo.update_epochs=1",
+                "env=dummy",
+                "env.num_envs=2",
+                "env.sync_env=True",
+                "env.capture_video=False",
+                "algo.mlp_keys.encoder=[state]",
+                "algo.cnn_keys.encoder=[]",
+                "algo.run_test=False",
+                "metric.log_level=0",
+                "metric.disable_timer=True",
+                "checkpoint.every=999999999",
+                "checkpoint.save_last=False",
+                "buffer.memmap=False",
+                f"buffer.backend={backend}",
+                "fabric.devices=1",
+            ]
+        )
+        result[f"smoke_{backend}_env_steps_per_sec"] = round(
+            total_steps / (time.perf_counter() - t0), 2
+        )
+    result["value"] = result["smoke_host_env_steps_per_sec"]
+    return result
+
+
+def _target_metric(target: str) -> str:
+    """Headline metric name for a bench target — the watchdog's failure record
+    must name the metric the selected target WOULD have produced, not hardcode
+    the PPO one (advisor r5 finding: a dv3-only failure record claiming
+    ``ppo_cartpole_env_steps_per_sec`` misfiles the regression history)."""
+    return {
+        "ppo": "ppo_cartpole_env_steps_per_sec",
+        "dv3": "dv3_gsteps_per_sec",
+        "smoke": "ppo_smoke_env_steps_per_sec",
+        "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
+    }[target]
+
+
 def _regression_check(result: dict) -> None:
     """Compare this run's PPO median against the newest BENCH_r*.json on disk.
 
@@ -247,6 +309,29 @@ def _regression_check(result: dict) -> None:
 
 
 if __name__ == "__main__":
+    import argparse
+    import os
+
+    parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
+    parser.add_argument(
+        "--target",
+        choices=("ppo", "dv3", "all"),
+        default="all",
+        help="which workload(s) to run on the accelerator",
+    )
+    parser.add_argument(
+        "--smoke",
+        action="store_true",
+        help="tiny CPU-backend PPO pass over both buffer backends (harness self-test; "
+        "no accelerator, no comparable numbers)",
+    )
+    cli_args = parser.parse_args()
+    headline_metric = _target_metric("smoke" if cli_args.smoke else cli_args.target)
+
+    if cli_args.smoke:
+        # the smoke pass must not depend on (or wait for) the tunneled chip
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
     # Fail FAST if the accelerator is unreachable (a dead tunnel parks every
     # device RPC forever — seen in round 5 when the relay process died): probe
     # backend discovery under a watchdog and emit a diagnosable one-line record
@@ -260,9 +345,9 @@ if __name__ == "__main__":
             print(
                 json.dumps(
                     {
-                        "metric": "ppo_cartpole_env_steps_per_sec",
+                        "metric": headline_metric,
                         "value": None,
-                        "unit": "env-steps/s",
+                        "unit": "env-steps/s" if "env_steps" in headline_metric else "g-steps/s",
                         "vs_baseline": None,
                         "error": "accelerator unreachable: backend discovery exceeded 180s "
                         "(tunnel/relay down?)",
@@ -270,8 +355,6 @@ if __name__ == "__main__":
                 ),
                 flush=True,
             )
-            import os
-
             os._exit(3)
 
     threading.Thread(target=_watchdog, daemon=True).start()
@@ -283,15 +366,27 @@ if __name__ == "__main__":
     # stdout must carry EXACTLY one JSON line: the CLI's config dump and progress
     # prints go to stderr instead
     with contextlib.redirect_stdout(sys.stderr):
-        result = bench_ppo()
-        _regression_check(result)
-        try:
-            result.update(bench_dv3())
-        except Exception as e:  # a DV3 bench failure must not lose the PPO number
-            result["dv3_error"] = f"{type(e).__name__}: {e}"
-        try:
-            # the Atari-100K training recipe shape (batch 16 x seq 64)
-            result.update(bench_dv3(batch=16, key_prefix="dv3_recipe"))
-        except Exception as e:
-            result["dv3_recipe_error"] = f"{type(e).__name__}: {e}"
+        if cli_args.smoke:
+            result = bench_smoke()
+        else:
+            result = {}
+            if cli_args.target in ("ppo", "all"):
+                result = bench_ppo()
+                _regression_check(result)
+            if cli_args.target in ("dv3", "all"):
+                try:
+                    dv3 = bench_dv3()
+                    result.update(dv3)
+                    if cli_args.target == "dv3":
+                        result.setdefault("metric", headline_metric)
+                        result.setdefault("value", dv3.get("dv3_gsteps_per_sec"))
+                        result.setdefault("unit", "g-steps/s")
+                        result.setdefault("vs_baseline", dv3.get("dv3_vs_baseline"))
+                except Exception as e:  # a DV3 bench failure must not lose the PPO number
+                    result["dv3_error"] = f"{type(e).__name__}: {e}"
+                try:
+                    # the Atari-100K training recipe shape (batch 16 x seq 64)
+                    result.update(bench_dv3(batch=16, key_prefix="dv3_recipe"))
+                except Exception as e:
+                    result["dv3_recipe_error"] = f"{type(e).__name__}: {e}"
     print(json.dumps(result))

@@ -24,7 +24,7 @@ from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -158,13 +158,8 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    rb = ReplayBuffer(
-        cfg.buffer.size,
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-        obs_keys=obs_keys,
-    )
+    rb = make_rollout_buffer(cfg, runtime, n_envs, obs_keys, log_dir)
+    device_rollout = getattr(rb, "backend", "host") == "device"
 
     last_train = 0
     train_step = 0
@@ -198,22 +193,35 @@ def main(runtime, cfg: Dict[str, Any]):
                 # raw obs straight into the player jit (see PPOPlayer.act_raw;
                 # A2C reuses the PPO agent, vector obs only)
                 cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                if device_rollout:
+                    # in-graph scatter: actions/values stay in HBM (A2C's loss
+                    # recomputes logprobs, so only these two leaves are stored)
+                    rb.add_policy({"actions": cat_actions, "values": values})
+                # the one unavoidable per-step device->host sync: env actions
                 real_actions = np.asarray(env_actions)
-                np_actions = np.asarray(cat_actions)
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions.reshape(envs.action_space.shape)
                 )
                 dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                 rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
 
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis]
-            step_data["actions"] = np_actions[np.newaxis]
-            step_data["rewards"] = rewards[np.newaxis]
-            if cfg.buffer.memmap:
-                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if device_rollout:
+                rb.add_env(
+                    {
+                        "rewards": rewards,
+                        "dones": dones,
+                        **{k: next_obs[k] for k in obs_keys},
+                    }
+                )
+            else:
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(values)[np.newaxis]
+                step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs = {}
             for k in obs_keys:
@@ -228,15 +236,25 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        local_data = rb.to_arrays(dtype=np.float32)
-        if cfg.buffer.size > cfg.algo.rollout_steps:
-            idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-            local_data = {k: v[idx] for k, v in local_data.items()}
+        if not device_rollout:
+            local_data = rb.to_arrays(dtype=np.float32)
+            if cfg.buffer.size > cfg.algo.rollout_steps:
+                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                local_data = {k: v[idx] for k, v in local_data.items()}
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-            next_values = np.asarray(player.get_values(jax_obs))
             rng, train_key = jax.random.split(rng)
-            device_data = {k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")}
+            if device_rollout:
+                # HBM rollout + bootstrap values: player-device -> trainer-mesh,
+                # no host round-trip
+                device_data, next_values = runtime.replicate(
+                    (rb.rollout(), player.get_values(jax_obs))
+                )
+            else:
+                next_values = np.asarray(player.get_values(jax_obs))
+                device_data = {
+                    k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
+                }
             params, opt_state, flat_params, train_metrics = train_fn(
                 params, opt_state, device_data, next_values, train_key
             )

@@ -30,7 +30,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -228,13 +228,10 @@ def main(runtime, cfg: Dict[str, Any]):
             f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
             f"than the rollout steps ({cfg.algo.rollout_steps})"
         )
-    rb = ReplayBuffer(
-        cfg.buffer.size,
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-        obs_keys=obs_keys,
-    )
+    rb = make_rollout_buffer(cfg, runtime, n_envs, obs_keys, log_dir)
+    # device backend: the [T, B] rollout lives in HBM; policy outputs never
+    # touch host and the per-step host->device traffic is one packed put
+    device_rollout = getattr(rb, "backend", "host") == "device"
 
     # Counters (same step semantics as the reference, howto/work_with_steps.md)
     last_train = 0
@@ -284,8 +281,13 @@ def main(runtime, cfg: Dict[str, Any]):
                 # the one dispatch instead of as a per-step eager prep (see
                 # PPOPlayer.act_raw)
                 cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                if device_rollout:
+                    # in-graph scatter straight from the player step's outputs:
+                    # values/logprobs/actions stay in HBM, no host pull
+                    rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
+                # the ONE unavoidable per-step device->host sync: the env needs
+                # the actions on host to step
                 real_actions = np.asarray(env_actions)
-                np_actions = np.asarray(cat_actions)
 
                 obs, rewards, terminated, truncated, info = envs.step(
                     real_actions.reshape(envs.action_space.shape)
@@ -317,15 +319,26 @@ def main(runtime, cfg: Dict[str, Any]):
                 dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                 rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
 
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis]
-            step_data["actions"] = np_actions[np.newaxis]
-            step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-            step_data["rewards"] = rewards[np.newaxis]
-            if cfg.buffer.memmap:
-                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if device_rollout:
+                # env products (pre-step obs + rewards + dones) ride ONE packed
+                # device_put; the row index goes in-band, unpacked in-graph
+                rb.add_env(
+                    {
+                        "rewards": rewards,
+                        "dones": dones,
+                        **{k: next_obs[k] for k in obs_keys},
+                    }
+                )
+            else:
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(values)[np.newaxis]
+                step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
+                step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+                step_data["rewards"] = rewards[np.newaxis]
+                if cfg.buffer.memmap:
+                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
             next_obs = {}
             for k in obs_keys:
@@ -344,19 +357,31 @@ def main(runtime, cfg: Dict[str, Any]):
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
         # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
-        local_data = rb.to_arrays(dtype=np.float32)
-        if cfg.buffer.size > cfg.algo.rollout_steps:
-            # keep only the last rollout in chronological order (stale/zero rows
-            # beyond the write head would corrupt GAE)
-            idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-            local_data = {k: v[idx] for k, v in local_data.items()}
+        if not device_rollout:
+            local_data = rb.to_arrays(dtype=np.float32)
+            if cfg.buffer.size > cfg.algo.rollout_steps:
+                # keep only the last rollout in chronological order (stale/zero rows
+                # beyond the write head would corrupt GAE)
+                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                local_data = {k: v[idx] for k, v in local_data.items()}
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-            # bootstrap values come from the player device; re-enter the mesh
-            # uncommitted so the jitted train step can place them freely
-            next_values = np.asarray(player.get_values(jax_obs))
             rng, train_key = jax.random.split(rng)
-            device_data = {k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")}
+            if device_rollout:
+                # zero bulk host->device transfer: the completed HBM rollout and
+                # the bootstrap values move player-device -> trainer-mesh directly
+                # (ownership transfers out of the buffer, so the train fn's view
+                # is never aliased by next iteration's donated writes)
+                device_data, next_values = runtime.replicate(
+                    (rb.rollout(), player.get_values(jax_obs))
+                )
+            else:
+                # bootstrap values come from the player device; re-enter the mesh
+                # uncommitted so the jitted train step can place them freely
+                next_values = np.asarray(player.get_values(jax_obs))
+                device_data = {
+                    k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
+                }
             params, opt_state, flat_params, train_metrics = train_fn(
                 params,
                 opt_state,

@@ -40,7 +40,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -179,17 +179,11 @@ def main(runtime, cfg: Dict[str, Any]):
             f"The size of the buffer ({cfg.buffer.size}) cannot be lower "
             f"than the rollout steps ({cfg.algo.rollout_steps})"
         )
-    rb = (
-        ReplayBuffer(
-            cfg.buffer.size,
-            n_envs,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-            obs_keys=obs_keys,
-        )
-        if is_player
-        else None
-    )
+    # device backend: the rollout lives on the player CHIP (player_rt places the
+    # player on its own device in the decoupled split), so the trainer handoff
+    # below is a direct chip->mesh device_put
+    rb = make_rollout_buffer(cfg, player_rt, n_envs, obs_keys, log_dir) if is_player else None
+    device_rollout = is_player and getattr(rb, "backend", "host") == "device"
 
     last_train = 0
     train_step = 0
@@ -264,8 +258,12 @@ def main(runtime, cfg: Dict[str, Any]):
                 with timer("Time/env_interaction_time", SumMetric()):
                     # raw obs straight into the player jit (see PPOPlayer.act_raw)
                     cat_actions, env_actions, logprobs, values, rng = player.act_raw(next_obs, rng)
+                    if device_rollout:
+                        # in-graph scatter on the player chip: no host pull of
+                        # values/logprobs/actions
+                        rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
+                    # the one unavoidable per-step device->host sync: env actions
                     real_actions = np.asarray(env_actions)
-                    np_actions = np.asarray(cat_actions)
 
                     obs, rewards, terminated, truncated, info = envs.step(
                         real_actions.reshape(envs.action_space.shape)
@@ -293,15 +291,24 @@ def main(runtime, cfg: Dict[str, Any]):
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                     rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
 
-                step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(values)[np.newaxis]
-                step_data["actions"] = np_actions[np.newaxis]
-                step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-                step_data["rewards"] = rewards[np.newaxis]
-                if cfg.buffer.memmap:
-                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                if device_rollout:
+                    rb.add_env(
+                        {
+                            "rewards": rewards,
+                            "dones": dones,
+                            **{k: next_obs[k] for k in obs_keys},
+                        }
+                    )
+                else:
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(values)[np.newaxis]
+                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
+                    step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+                    step_data["rewards"] = rewards[np.newaxis]
+                    if cfg.buffer.memmap:
+                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
                 next_obs = {}
                 for k in obs_keys:
@@ -323,7 +330,7 @@ def main(runtime, cfg: Dict[str, Any]):
             # (the reference's scatter_object_list + params broadcast round)
             if not is_player:
                 policy_step += policy_steps_per_iter
-            else:
+            elif not device_rollout:
                 local_data = rb.to_arrays(dtype=np.float32)
                 if cfg.buffer.size > cfg.algo.rollout_steps:
                     idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
@@ -331,8 +338,19 @@ def main(runtime, cfg: Dict[str, Any]):
             with timer("Time/train_time", SumMetric()):
                 if is_player:
                     jax_obs = prepare_obs(player_rt, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                    next_values = np.asarray(player.get_values(jax_obs))
-                    host_data = {k: v for k, v in local_data.items() if k not in ("returns", "advantages")}
+                    if device_rollout and transport is None:
+                        # the HBM rollout feeds trainer_step's replicate as-is:
+                        # a direct player-chip -> trainer-mesh device_put, the
+                        # host never sees the [T, B] arrays
+                        host_data = rb.rollout()
+                        next_values = player.get_values(jax_obs)
+                    else:
+                        if device_rollout:
+                            # cross-host: the broadcast collective needs host
+                            # numpy, so de-layout the rollout in ONE bulk pull
+                            local_data = rb.rollout_host()
+                        next_values = np.asarray(player.get_values(jax_obs))
+                        host_data = {k: v for k, v in local_data.items() if k not in ("returns", "advantages")}
                     if transport is not None:
                         transport.sync_payload_spec("ppo_rollout", {**host_data, "__next_values__": next_values})
                 else:

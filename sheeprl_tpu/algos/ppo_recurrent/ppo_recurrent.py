@@ -24,7 +24,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
 from sheeprl_tpu.config import instantiate
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -222,13 +222,11 @@ def main(runtime, cfg: Dict[str, Any]):
     if not MetricAggregator.disabled:
         aggregator = instantiate(cfg.metric.aggregator)
 
-    rb = ReplayBuffer(
-        cfg.buffer.size,
-        n_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{runtime.global_rank}"),
-        obs_keys=obs_keys,
-    )
+    rb = make_rollout_buffer(cfg, runtime, n_envs, obs_keys, log_dir)
+    # device backend: policy outputs AND recurrent states stay in HBM per step;
+    # the episode chunking below still runs on host, fed by ONE bulk pull per
+    # iteration (rollout_host) instead of per-step np.asarray syncs
+    device_rollout = getattr(rb, "backend", "host") == "device"
 
     last_train = 0
     train_step = 0
@@ -293,18 +291,44 @@ def main(runtime, cfg: Dict[str, Any]):
                 dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.float32)
                 rewards = rewards.reshape(n_envs, -1)
 
-            step_data["dones"] = dones[np.newaxis]
-            step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
-            step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
-            step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
-            step_data["rewards"] = rewards[np.newaxis]
-            step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
-            step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
-            step_data["prev_actions"] = prev_actions.reshape(1, n_envs, -1)
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            if device_rollout:
+                # policy outputs + the recurrent state that PRODUCED this step:
+                # all scattered in-graph, no per-step host pull
+                rb.add_policy(
+                    {
+                        "values": jnp.reshape(values, (n_envs, 1)),
+                        "actions": jnp.reshape(cat_actions, (n_envs, -1)),
+                        "logprobs": jnp.reshape(logprobs, (n_envs, 1)),
+                        "prev_hx": jnp.reshape(prev_states[0], (n_envs, -1)),
+                        "prev_cx": jnp.reshape(prev_states[1], (n_envs, -1)),
+                        "prev_actions": jnp.reshape(jnp.asarray(prev_actions), (n_envs, -1)),
+                    }
+                )
+                rb.add_env(
+                    {
+                        "rewards": rewards,
+                        "dones": dones,
+                        **{k: next_obs[k] for k in obs_keys},
+                    }
+                )
+                # prev action feedback stays device-side (dones ride up with the
+                # packed env put's sibling transfer; small and async)
+                prev_actions = jnp.asarray(1.0 - dones, dtype=jnp.float32) * jnp.reshape(
+                    cat_actions, (n_envs, -1)
+                )
+            else:
+                step_data["dones"] = dones[np.newaxis]
+                step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
+                step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
+                step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
+                step_data["rewards"] = rewards[np.newaxis]
+                step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
+                step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
+                step_data["prev_actions"] = np.asarray(prev_actions).reshape(1, n_envs, -1)
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
 
-            # reset recurrent state / prev action on done (reference :356-371)
-            prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
+            # reset recurrent state on done (reference :356-371)
             if cfg.algo.reset_recurrent_state_on_done:
                 not_done = jnp.asarray(1.0 - dones, dtype=jnp.float32)
                 prev_states = tuple(not_done * s for s in states)
@@ -327,13 +351,17 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update("Game/ep_len_avg", ep_len)
                     runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        local_data = rb.to_arrays(dtype=np.float32)
+        # device path: ONE bulk de-layout pull feeds the host-side episode
+        # chunking (variable-length episode splitting is inherently host work)
+        local_data = rb.rollout_host() if device_rollout else rb.to_arrays(dtype=np.float32)
         with timer("Time/train_time", SumMetric()):
             jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
             jax_obs = {k: v[None] for k, v in jax_obs.items()}
             next_values = np.asarray(
                 player.get_values(
-                    jax_obs, jax.device_put(prev_actions[None], runtime.player_device), prev_states
+                    jax_obs,
+                    jax.device_put(np.asarray(prev_actions)[None], runtime.player_device),
+                    prev_states,
                 )[0]
             )
             returns, advantages = gae(

@@ -1,23 +1,86 @@
-"""Shared replay-buffer + sampling-pipeline construction for the Dreamer-family loops.
+"""Shared replay/rollout-buffer + sampling-pipeline construction for the train loops.
 
 One place decides between the host path (EnvIndependentReplayBuffer over
 SequentialReplayBuffer + the double-buffered DevicePrefetcher) and the
-HBM-resident path (``buffer.device=True`` -> DeviceSequentialReplayBuffer +
+HBM-resident path (``buffer.backend=device`` -> DeviceSequentialReplayBuffer +
 InlineSampler), so the seven sequential-replay train loops cannot drift apart.
+The on-policy family (PPO/A2C) goes through :func:`make_rollout_buffer`, which
+maps the same ``buffer.backend`` switch onto the host numpy ``ReplayBuffer``
+vs the HBM-resident ``DeviceRolloutBuffer``.
 """
 
 from __future__ import annotations
 
 import os
+import warnings
 from typing import Any, Optional, Sequence, Tuple
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
 from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer, ShardedDeviceSequentialReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
+from sheeprl_tpu.data.rollout_buffer import DeviceRolloutBuffer
 
-__all__ = ["make_episode_replay", "make_sequential_replay"]
+__all__ = ["buffer_backend", "make_episode_replay", "make_rollout_buffer", "make_sequential_replay"]
+
+
+def buffer_backend(cfg) -> str:
+    """The resolved ``buffer.backend`` ("host" | "device").
+
+    ``buffer.device=True`` (the pre-backend switch for the off-policy HBM
+    replay) is accepted as an alias of ``backend=device`` so existing override
+    lines keep working; either switch alone selects the device path (the
+    config default for both is host).
+    """
+    backend = str(cfg.buffer.get("backend", "host") or "host").lower()
+    if backend not in ("host", "device"):
+        raise ValueError(f"buffer.backend must be 'host' or 'device'; got {backend!r}")
+    if bool(cfg.buffer.get("device", False)):
+        return "device"
+    return backend
+
+
+def make_rollout_buffer(cfg, runtime, n_envs: int, obs_keys: Sequence[str], log_dir: Optional[str]):
+    """The on-policy rollout store for the PPO/A2C family.
+
+    - ``buffer.backend=host`` (default): the reference design — a circular numpy
+      ``ReplayBuffer`` of ``cfg.buffer.size`` rows, optionally memmapped; every
+      step's policy outputs are pulled to host and the whole ``[T, B]`` rollout
+      is re-uploaded each iteration.
+    - ``buffer.backend=device``: a ``DeviceRolloutBuffer`` of exactly
+      ``cfg.algo.rollout_steps`` rows resident on ``runtime.player_device``;
+      policy outputs are scattered in-graph, env products ride one packed
+      ``device_put`` per step, and the iteration handoff is device->device.
+      ``buffer.size > rollout_steps`` keeps extra history host-side only, which
+      the device layout doesn't model — use the host backend for that.
+    """
+    if buffer_backend(cfg) == "device":
+        if cfg.buffer.get("memmap", False):
+            # memmap defaults True for the host path; flipping backend=device
+            # alone must work, so this is advisory (same as the off-policy
+            # device replay, which has no host storage to memmap either)
+            warnings.warn("buffer.memmap has no effect with buffer.backend=device (storage lives in HBM)")
+        if int(cfg.buffer.size) > int(cfg.algo.rollout_steps):
+            raise ValueError(
+                f"buffer.backend=device stores exactly one rollout ({cfg.algo.rollout_steps} steps); "
+                f"buffer.size={cfg.buffer.size} rows of retained history need buffer.backend=host"
+            )
+        return DeviceRolloutBuffer(
+            int(cfg.algo.rollout_steps), n_envs, device=runtime.player_device
+        )
+    return ReplayBuffer(
+        cfg.buffer.size,
+        n_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir or ".", "memmap_buffer", f"rank_{runtime.global_rank}"),
+        obs_keys=tuple(obs_keys),
+    )
 
 
 def make_sequential_replay(
@@ -41,7 +104,7 @@ def make_sequential_replay(
     buffer_size = (
         cfg.buffer.size // int(cfg.env.num_envs * runtime.world_size) if not cfg.dry_run else 2
     )
-    use_device_buffer = bool(cfg.buffer.get("device", False))
+    use_device_buffer = buffer_backend(cfg) == "device"
     if use_device_buffer:
         if runtime.world_size > 1:
             import jax
@@ -51,7 +114,7 @@ def make_sequential_replay(
                 # addressable from this controller; per-process env data against a
                 # global-mesh sharding would silently drop foreign columns
                 raise ValueError(
-                    "buffer.device=True is single-controller only (one process, any "
+                    "buffer.backend=device is single-controller only (one process, any "
                     "number of local devices); use the host buffer for multihost runs"
                 )
             # env axis mapped onto the mesh's data axis: local writes/gathers,
@@ -94,9 +157,9 @@ def make_episode_replay(
     don't map onto the fixed-slot HBM layout), so ``buffer.device=True`` raises
     and the pipeline is always the double-buffered host prefetcher.
     """
-    if bool(cfg.buffer.get("device", False)):
+    if buffer_backend(cfg) == "device":
         raise ValueError(
-            "buffer.device=True supports sequential replay only; "
+            "buffer.backend=device supports sequential replay only; "
             "buffer.type=episode must use the host buffer"
         )
     buffer_size = (

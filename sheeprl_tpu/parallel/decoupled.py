@@ -57,6 +57,32 @@ def _kv_client():
         return None
 
 
+def _ckpt_digest(path: str, chunk: int = 1 << 20) -> str:
+    """Cheap content digest of a checkpoint: size + sha1 of three 1 MiB chunks.
+
+    Head and tail catch truncation and header/footer drift; the MIDDLE chunk
+    catches same-size files diverging mid-stream (e.g. two resumes of the same
+    run whose params differ but whose pickled head/tail bookkeeping is identical
+    — advisor r5 finding). Multi-GB buffer-in-checkpoint files are never fully
+    hashed.
+    """
+    import hashlib
+
+    size = os.path.getsize(path)
+    h = hashlib.sha1()
+    with open(path, "rb") as f:
+        h.update(f.read(chunk))
+        if size > 2 * chunk:
+            # centered middle chunk, clamped past the head chunk and off the tail
+            mid = min(max(chunk, size // 2 - chunk // 2), max(size - 2 * chunk, chunk))
+            f.seek(mid)
+            h.update(f.read(chunk))
+        if size > chunk:
+            f.seek(max(size - chunk, chunk))
+            h.update(f.read(chunk))
+    return f"{size}:{h.hexdigest()}"
+
+
 def _sub_runtime(runtime: Runtime, devices: Sequence[Any], axes: Tuple[str, ...] = ("data",)) -> Runtime:
     """A shallow copy of ``runtime`` whose mesh spans exactly ``devices``."""
     rt = copy.copy(runtime)
@@ -145,29 +171,16 @@ class CrossHostTransport:
         filesystem; without a shared FS a stale or divergent copy on one host
         would desync host-side schedulers (e.g. the Ratio state) and surface
         only much later as a hung broadcast or shape mismatch (advisor r4
-        finding). Process 0 publishes a cheap content digest — (size, sha1 of
-        the first and last 1 MiB) — through the coordinator KV store; every
-        other process verifies its local file against it before training
-        starts. Multi-GB buffer-in-checkpoint files are never fully hashed.
+        finding). Process 0 publishes a cheap content digest (:func:`_ckpt_digest`)
+        through the coordinator KV store; every other process verifies its local
+        file against it before training starts. Multi-GB buffer-in-checkpoint
+        files are never fully hashed.
         """
-        import hashlib
-
-        def digest() -> str:
-            chunk = 1 << 20
-            size = os.path.getsize(ckpt_path)
-            h = hashlib.sha1()
-            with open(ckpt_path, "rb") as f:
-                h.update(f.read(chunk))
-                if size > chunk:
-                    f.seek(max(size - chunk, chunk))
-                    h.update(f.read(chunk))
-            return f"{size}:{h.hexdigest()}"
-
         client = _kv_client()
         if client is None:  # single-process split_runtime path: nothing to compare
             return
         key = self._scope_key("resume_digest")
-        local = digest()
+        local = _ckpt_digest(ckpt_path)
         if self.is_player_process:
             client.key_value_set(key, local, allow_overwrite=True)
         else:

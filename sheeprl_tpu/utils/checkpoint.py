@@ -99,17 +99,78 @@ def save_state(path: str, state: Dict[str, Any]) -> None:
     os.replace(tmp, path)
 
 
+def _v1_header_at_head(head: bytes) -> bool:
+    """True iff ``head`` starts with a v1 container header pickle.
+
+    Walks the pickle opcodes a ``{"__format__": _CKPT_MAGIC, ...}`` dict written
+    at any protocol >= 2 produces — PROTO, optional FRAME, EMPTY_DICT, MARK,
+    then the first key/value strings at their FIXED offsets — instead of
+    substring-scanning the magic anywhere in the head. A legacy bare pickle
+    whose first 256 bytes coincidentally contain the magic bytes (e.g. a state
+    dict keyed "sheeprl_tpu_ckpt_dir") must NOT be classified v1: that path
+    ``pickle.load``s the whole (potentially multi-GB) state just to sniff it
+    (advisor r5 finding).
+    """
+
+    def read_string(i):
+        # the two string opcodes HIGHEST_PROTOCOL emits for short ASCII keys
+        if i < len(head) and head[i] == 0x8C:  # SHORT_BINUNICODE, 1-byte length
+            if i + 2 > len(head):
+                return None, i
+            n = head[i + 1]
+            return head[i + 2 : i + 2 + n], i + 2 + n
+        if i < len(head) and head[i : i + 1] == b"X":  # BINUNICODE, 4-byte LE length
+            if i + 5 > len(head):
+                return None, i
+            n = int.from_bytes(head[i + 1 : i + 5], "little")
+            return head[i + 5 : i + 5 + n], i + 5 + n
+        return None, i
+
+    def skip_memo(i):
+        # MEMOIZE (proto 4+) / BINPUT / LONG_BINPUT memo bookkeeping between tokens
+        while i < len(head):
+            if head[i] == 0x94:  # MEMOIZE
+                i += 1
+            elif head[i : i + 1] == b"q":  # BINPUT, 1-byte arg
+                i += 2
+            elif head[i : i + 1] == b"r":  # LONG_BINPUT, 4-byte arg
+                i += 5
+            else:
+                break
+        return i
+
+    if len(head) < 2 or head[0] != 0x80:  # PROTO
+        return False
+    proto = head[1]
+    i = 2
+    if proto >= 4 and i < len(head) and head[i] == 0x95:  # FRAME, 8-byte length
+        i += 9
+    if head[i : i + 1] != b"}":  # EMPTY_DICT
+        return False
+    i = skip_memo(i + 1)
+    if head[i : i + 1] != b"(":  # MARK opening the (key, value, ...) batch
+        return False
+    key, i = read_string(i + 1)
+    if key != b"__format__":
+        return False
+    value, _ = read_string(skip_memo(i))
+    return value == _CKPT_MAGIC.encode()
+
+
 def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]:
     """The stored leaf manifest (None for legacy bare-pickle checkpoints).
 
-    Cost: O(header). A v1 header pickles its magic within the first bytes of
-    the stream, so a legacy file (whose FIRST pickle is the entire state —
-    potentially multi-GB with buffer-in-checkpoint) is recognized from a
-    256-byte sniff and never unpickled (advisor r4 finding).
+    Cost: O(header). A v1 header pickle carries the magic at a fixed offset
+    (``save_state`` writes ``"__format__"`` as the dict's first key), so the
+    sniff checks the opcode structure there rather than substring-scanning; a
+    legacy file (whose FIRST pickle is the entire state — potentially multi-GB
+    with buffer-in-checkpoint) is recognized from a 256-byte read and never
+    unpickled, even when the magic appears somewhere in its own leading bytes
+    (advisor r4 + r5 findings).
     """
     with open(path, "rb") as f:
         head = f.read(256)
-        if _CKPT_MAGIC.encode() not in head:
+        if not _v1_header_at_head(head):
             return None  # legacy bare pickle: no container header to read
         f.seek(0)
         obj = pickle.load(f)  # v1: this first pickle is just the small header
@@ -187,8 +248,9 @@ class CheckpointCallback:
         # Device buffers are probed WITHOUT touching .buffer: their property
         # materializes the whole logical storage on device (GBs per call).
         from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer
+        from sheeprl_tpu.data.rollout_buffer import DeviceRolloutBuffer
 
-        if isinstance(rb, DeviceSequentialReplayBuffer):
+        if isinstance(rb, (DeviceSequentialReplayBuffer, DeviceRolloutBuffer)):
             return [rb]
         buf = getattr(rb, "buffer", None)
         if isinstance(buf, (list, tuple)) and all(hasattr(b, "_patch_truncated") for b in buf):

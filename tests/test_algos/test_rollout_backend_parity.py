@@ -1,0 +1,143 @@
+"""buffer.backend=host vs device must be a pure transport change for PPO.
+
+Two end-to-end CLI runs under a fixed seed must feed the jitted train fn
+bit-identical ``[T, B]`` rollouts and produce bit-identical post-update params;
+and the device-backend hot loop must never pull ``values``/``logprobs`` to host
+per step (the instrumentation poisons ``__array__`` on exactly those arrays).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos.ppo.ppo as ppo_module
+from sheeprl_tpu.algos.ppo.agent import PPOPlayer
+from sheeprl_tpu.cli import run
+
+_PPO_ARGS = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "fabric.devices=1",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=2",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+    "seed=7",
+]
+
+
+def _run_and_capture(standard_args, backend, monkeypatch):
+    """Run one dry-run PPO iteration; capture the train fn's exact inputs and
+    the post-update params via a make_train_fn spy."""
+    captured = []
+    real_make_train_fn = ppo_module.make_train_fn
+
+    def spy_make_train_fn(*args, **kwargs):
+        train_fn = real_make_train_fn(*args, **kwargs)
+
+        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef):
+            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+            captured.append(
+                {
+                    "data": {k: np.asarray(jax.device_get(v)) for k, v in data.items()},
+                    "next_values": np.asarray(jax.device_get(next_values)),
+                    "params": jax.device_get(out[0]),
+                }
+            )
+            return out
+
+        return wrapped
+
+    with monkeypatch.context() as m:
+        m.setattr(ppo_module, "make_train_fn", spy_make_train_fn)
+        run(overrides=standard_args + _PPO_ARGS + [f"buffer.backend={backend}"])
+    assert len(captured) == 1, f"expected exactly one train call, got {len(captured)}"
+    return captured[0]
+
+
+def test_ppo_backend_parity(standard_args, tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    host = _run_and_capture(standard_args, "host", monkeypatch)
+    device = _run_and_capture(standard_args, "device", monkeypatch)
+
+    assert set(host["data"]) == set(device["data"])
+    for k in sorted(host["data"]):
+        np.testing.assert_array_equal(
+            host["data"][k], device["data"][k], err_msg=f"train-fn input '{k}' diverged across backends"
+        )
+    np.testing.assert_array_equal(host["next_values"], device["next_values"])
+
+    host_leaves = jax.tree_util.tree_leaves_with_path(host["params"])
+    dev_leaves = dict(
+        (jax.tree_util.keystr(p), l) for p, l in jax.tree_util.tree_leaves_with_path(device["params"])
+    )
+    assert host_leaves and len(host_leaves) == len(dev_leaves)
+    for path, leaf in host_leaves:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(dev_leaves[jax.tree_util.keystr(path)]),
+            err_msg=f"post-update param {jax.tree_util.keystr(path)} diverged across backends",
+        )
+
+
+def _poison_policy_outputs(monkeypatch_ctx):
+    """Intercept every act_raw call and poison its values/logprobs outputs:
+    any host materialization of them (np.asarray / np.array / jax.device_get)
+    raises. Returns the forbidden-id registry (also the proof act_raw ran).
+
+    np.asarray on a jax CPU array does NOT go through the Python-level
+    ``ArrayImpl.__array__`` (numpy hits the array-interface/buffer protocol
+    first), so the guard wraps the numpy entry points themselves.
+    """
+    forbidden = {}  # id -> strong ref (keeps ids stable for the run's lifetime)
+    real_act_raw = PPOPlayer.act_raw
+
+    def spy_act_raw(self, obs, key, **kwargs):
+        out = real_act_raw(self, obs, key, **kwargs)
+        forbidden[id(out[2])] = out[2]  # logprobs
+        forbidden[id(out[3])] = out[3]  # values
+        return out
+
+    def make_guard(real):
+        def guarded(obj, *args, **kwargs):
+            if id(obj) in forbidden:
+                raise AssertionError(
+                    "per-step host pull of values/logprobs from the PPO hot loop"
+                )
+            return real(obj, *args, **kwargs)
+
+        return guarded
+
+    monkeypatch_ctx.setattr(PPOPlayer, "act_raw", spy_act_raw)
+    monkeypatch_ctx.setattr(np, "asarray", make_guard(np.asarray))
+    monkeypatch_ctx.setattr(np, "array", make_guard(np.array))
+    monkeypatch_ctx.setattr(jax, "device_get", make_guard(jax.device_get))
+    return forbidden
+
+
+def test_ppo_device_backend_never_pulls_policy_outputs(standard_args, tmp_path, monkeypatch):
+    """The device-backend hot loop's only device->host sync is the env actions:
+    values/logprobs must reach the train fn without ever touching host."""
+    monkeypatch.chdir(tmp_path)
+    with monkeypatch.context() as m:
+        forbidden = _poison_policy_outputs(m)
+        run(overrides=standard_args + _PPO_ARGS + ["buffer.backend=device"])
+    assert forbidden, "instrumentation never saw an act_raw call"
+
+
+def test_ppo_host_backend_does_pull_policy_outputs(standard_args, tmp_path, monkeypatch):
+    """Sanity check on the instrumentation itself: the host-backend reference
+    loop MUST trip the same poison (np.asarray per step), proving the
+    device-backend test above would catch a regression."""
+    monkeypatch.chdir(tmp_path)
+    with monkeypatch.context() as m:
+        forbidden = _poison_policy_outputs(m)
+        with pytest.raises(AssertionError, match="host pull"):
+            run(overrides=standard_args + _PPO_ARGS + ["buffer.backend=host"])
+    assert forbidden

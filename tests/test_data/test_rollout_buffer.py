@@ -1,0 +1,197 @@
+"""DeviceRolloutBuffer: the HBM-resident on-policy rollout store.
+
+Covers the contracts the PPO/A2C loops lean on: value parity with the host
+path's float32 arrays, donation safety (a consumed rollout is never aliased by
+the next iteration's donated writes), strict no-wraparound semantics, and the
+de-layouted checkpoint state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.rollout_buffer import DeviceRolloutBuffer
+
+T, B = 4, 3
+
+
+def _fill_one_rollout(rb, rng):
+    """Write T rows of policy + env leaves; return the expected host arrays."""
+    ref = {
+        k: np.zeros((T, B, d), np.float32)
+        for k, d in [("values", 1), ("actions", 2), ("state", 5), ("rewards", 1), ("dones", 1)]
+    }
+    for t in range(T):
+        policy = {
+            "values": rng.random((B, 1), dtype=np.float32),
+            "actions": rng.random((B, 2), dtype=np.float32),
+        }
+        rb.add_policy({k: jnp.asarray(v) for k, v in policy.items()})
+        env = {
+            "state": rng.random((B, 5), dtype=np.float32),
+            "rewards": rng.random((B, 1), dtype=np.float32),
+            # uint8 like the loops' dones: must land as float32 (host-path parity)
+            "dones": (rng.random((B, 1)) < 0.3).astype(np.uint8),
+        }
+        rb.add_env(env)
+        for k, v in policy.items():
+            ref[k][t] = v
+        for k, v in env.items():
+            ref[k][t] = v.astype(np.float32)
+    return ref
+
+
+def test_fill_and_rollout_bit_parity():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    ref = _fill_one_rollout(rb, np.random.default_rng(0))
+    assert rb.full and rb.step == T
+    out = rb.rollout()
+    assert set(out) == set(ref)
+    for k in ref:
+        assert out[k].shape == (T, B, ref[k].shape[-1])
+        assert out[k].dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(out[k]), ref[k])
+
+
+def test_rollout_host_is_numpy():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    ref = _fill_one_rollout(rb, np.random.default_rng(1))
+    host = rb.rollout_host()
+    for k in ref:
+        assert isinstance(host[k], np.ndarray)
+        np.testing.assert_array_equal(host[k], ref[k])
+
+
+def test_donation_safety_consumed_rollout_never_aliased():
+    """rollout() transfers ownership: the consumer's arrays must stay readable
+    and unchanged while the NEXT iteration's donated writes fill new storage."""
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    ref = _fill_one_rollout(rb, np.random.default_rng(2))
+    first = rb.rollout()
+    for t in range(T):
+        rb.add_policy({"values": jnp.ones((B, 1)), "actions": jnp.ones((B, 2))})
+        rb.add_env(
+            {
+                "state": np.ones((B, 5), np.float32),
+                "rewards": np.ones((B, 1), np.float32),
+                "dones": np.zeros((B, 1), np.float32),
+            }
+        )
+    # the first rollout still holds iteration-1 data (no use-after-donate)
+    for k in ref:
+        np.testing.assert_array_equal(np.asarray(first[k]), ref[k])
+    second = rb.rollout()
+    assert float(np.asarray(second["values"]).sum()) == T * B
+
+
+def test_overfill_raises_instead_of_wrapping():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    _fill_one_rollout(rb, np.random.default_rng(3))
+    with pytest.raises(RuntimeError, match="full"):
+        rb.add_env({"rewards": np.ones((B, 1), np.float32)})
+    with pytest.raises(RuntimeError, match="full"):
+        rb.add_policy({"values": jnp.ones((B, 1))})
+
+
+def test_incomplete_rollout_raises():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    rb.add_policy({"values": jnp.ones((B, 1))})
+    rb.add_env({"rewards": np.ones((B, 1), np.float32)})
+    with pytest.raises(RuntimeError, match="incomplete"):
+        rb.rollout()
+
+
+def test_reset_drops_partial_rollout():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    rb.add_policy({"values": jnp.ones((B, 1))})
+    rb.add_env({"rewards": np.ones((B, 1), np.float32)})
+    rb.reset()
+    assert rb.step == 0
+    ref = _fill_one_rollout(rb, np.random.default_rng(4))
+    np.testing.assert_array_equal(rb.rollout_host()["values"], ref["values"])
+
+
+def test_leaf_shape_mismatch_raises():
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    rb.add_policy({"values": jnp.ones((B, 1))})
+    rb.add_env({"rewards": np.ones((B, 1), np.float32)})
+    with pytest.raises(ValueError, match="must be"):
+        rb.add_env({"rewards": np.ones((B, 2), np.float32)})
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError):
+        DeviceRolloutBuffer(0, B)
+    with pytest.raises(ValueError):
+        DeviceRolloutBuffer(T, 0)
+
+
+def test_state_dict_roundtrip_resumes_mid_rollout():
+    dev = jax.devices()[0]
+    rb = DeviceRolloutBuffer(T, B, device=dev)
+    for t in range(2):
+        rb.add_policy({"values": jnp.full((B, 1), t + 1.0)})
+        rb.add_env({"rewards": np.full((B, 1), t + 2.0, np.float32)})
+    state = rb.state_dict()
+    # de-layouted: checkpoints must be device-agnostic numpy
+    assert all(isinstance(v, np.ndarray) for v in state["rollout"].values())
+    assert state["t"] == 2
+    rb2 = DeviceRolloutBuffer(T, B, device=dev).load_state_dict(state)
+    assert rb2.step == 2
+    for t in range(2):
+        rb2.add_policy({"values": jnp.zeros((B, 1))})
+        rb2.add_env({"rewards": np.zeros((B, 1), np.float32)})
+    out = rb2.rollout_host()
+    assert out["values"][0, 0, 0] == 1.0 and out["values"][1, 0, 0] == 2.0
+    assert out["rewards"][0, 0, 0] == 2.0 and out["rewards"][1, 0, 0] == 3.0
+
+
+def test_state_dict_shape_guard():
+    state = DeviceRolloutBuffer(T, B, device=jax.devices()[0]).state_dict()
+    assert state == {"rollout": None, "t": 0}
+    rb = DeviceRolloutBuffer(T, B, device=jax.devices()[0])
+    rb.add_policy({"values": jnp.ones((B, 1))})
+    rb.add_env({"rewards": np.ones((B, 1), np.float32)})
+    with pytest.raises(ValueError, match="configured"):
+        DeviceRolloutBuffer(T + 1, B, device=jax.devices()[0]).load_state_dict(rb.state_dict())
+
+
+def test_factory_backend_switch():
+    from types import SimpleNamespace
+
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+    from sheeprl_tpu.data.factory import buffer_backend, make_rollout_buffer
+
+    class _Buf(dict):
+        # OmegaConf-style: attribute access on top of .get()
+        def __getattr__(self, k):
+            try:
+                return self[k]
+            except KeyError:
+                raise AttributeError(k)
+
+    def cfg(backend=None, device=False, memmap=False, size=T):
+        buf = _Buf(memmap=memmap, device=device, size=size)
+        if backend is not None:
+            buf["backend"] = backend
+        return SimpleNamespace(buffer=buf, algo=SimpleNamespace(rollout_steps=T))
+
+    rt = SimpleNamespace(player_device=jax.devices()[0], global_rank=0)
+    assert buffer_backend(cfg()) == "host"
+    assert buffer_backend(cfg(backend="device")) == "device"
+    assert buffer_backend(cfg(device=True)) == "device"  # legacy alias
+    # the alias wins over the (defaulted) backend=host so existing
+    # buffer.device=True override lines keep selecting the HBM path
+    assert buffer_backend(cfg(backend="host", device=True)) == "device"
+    with pytest.raises(ValueError, match="backend"):
+        buffer_backend(cfg(backend="hbm"))
+    assert isinstance(make_rollout_buffer(cfg(backend="device"), rt, B, (), None), DeviceRolloutBuffer)
+    assert isinstance(make_rollout_buffer(cfg(), rt, B, ("state",), None), ReplayBuffer)
+    # memmap defaults True on the host path: backend=device alone must still
+    # work (advisory warning, not an error)
+    with pytest.warns(UserWarning, match="memmap"):
+        rb = make_rollout_buffer(cfg(backend="device", memmap=True), rt, B, (), None)
+    assert isinstance(rb, DeviceRolloutBuffer)
+    with pytest.raises(ValueError, match="history"):
+        make_rollout_buffer(cfg(backend="device", size=T + 1), rt, B, (), None)

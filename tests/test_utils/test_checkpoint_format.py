@@ -129,3 +129,54 @@ def test_read_manifest_never_unpickles_legacy_payload(tmp_path, monkeypatch):
     ckpt_mod.save_state(str(v1), {"agent": np.ones((2, 2))})
     manifest = ckpt_mod.read_manifest(str(v1))
     assert manifest is not None and any("agent" in k for k in manifest)
+
+
+def test_read_manifest_rejects_legacy_with_embedded_magic(tmp_path, monkeypatch):
+    """The v1 sniff checks the opcode structure at the header's FIXED offsets,
+    not 'magic substring anywhere in the first 256 bytes': a legacy state dict
+    whose first key merely CONTAINS the magic (so the magic bytes sit in the
+    head) must still be classified legacy -> None, without unpickling."""
+    from sheeprl_tpu.utils import checkpoint as ckpt_mod
+
+    for name, legacy_state in [
+        # magic bytes land in the head via the first dict key
+        ("keyed.ckpt", {"sheeprl_tpu_ckpt_dir": "/x", "agent": np.zeros((4,), np.float32)}),
+        # exact magic as the first key, but NOT under a "__format__" key
+        ("exact.ckpt", {"sheeprl_tpu_ckpt": 1, "agent": np.zeros((4,), np.float32)}),
+        # "__format__" present with the WRONG magic value
+        ("wrongmagic.ckpt", {"__format__": "someone_elses_ckpt", "agent": 1}),
+    ]:
+        path = tmp_path / name
+        with open(path, "wb") as f:
+            pickle.dump(legacy_state, f, protocol=pickle.HIGHEST_PROTOCOL)
+        assert b"sheeprl_tpu_ckpt" in open(path, "rb").read(256) or name == "wrongmagic.ckpt"
+
+        def boom(*a, **k):
+            raise AssertionError(f"read_manifest unpickled legacy file {name}")
+
+        with monkeypatch.context() as m:
+            m.setattr(ckpt_mod.pickle, "load", boom)
+            assert ckpt_mod.read_manifest(str(path)) is None
+
+
+def test_read_manifest_v1_header_across_pickle_protocols(tmp_path):
+    """The fixed-offset walk must accept the header layout of every protocol a
+    writer could plausibly use (2/3: no FRAME, BINPUT memo, BINUNICODE strings;
+    4/5: FRAME, MEMOIZE, SHORT_BINUNICODE)."""
+    import zlib
+
+    from sheeprl_tpu.utils.checkpoint import CKPT_FORMAT_VERSION as V
+
+    manifest = {"['agent']": ((2, 2), "float32")}
+    payload = pickle.dumps({"agent": np.zeros((2, 2), np.float32)}, protocol=pickle.HIGHEST_PROTOCOL)
+    for proto in range(2, pickle.HIGHEST_PROTOCOL + 1):
+        path = tmp_path / f"proto{proto}.ckpt"
+        with open(path, "wb") as f:
+            pickle.dump(
+                {"__format__": "sheeprl_tpu_ckpt", "format_version": V, "manifest": manifest},
+                f,
+                protocol=proto,
+            )
+            f.write(payload)
+            pickle.dump({"crc32": zlib.crc32(payload)}, f, protocol=proto)
+        assert read_manifest(str(path)) == manifest, f"v1 header missed at protocol {proto}"

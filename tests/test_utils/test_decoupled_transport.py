@@ -111,3 +111,37 @@ def test_resume_digest_scoped_per_run(transport_pair, tmp_path):
     trainer.set_scope("logs/runs/a/version_1")  # different incarnation
     with pytest.raises(TimeoutError):
         trainer.verify_resume_digest(str(ckpt))
+
+
+def test_ckpt_digest_sees_mid_file_divergence(tmp_path):
+    """Two same-size checkpoints with identical head/tail bookkeeping but
+    different params mid-stream must digest differently (the middle chunk);
+    with a 4 KiB chunk the 3x4KiB samples never reach the middle of 64 KiB."""
+    chunk = 4 * 1024
+    size = 64 * 1024
+    base = bytearray(size)
+    a = tmp_path / "a.ckpt"
+    a.write_bytes(bytes(base))
+    diverged = bytearray(base)
+    diverged[size // 2] = 0xFF  # outside head [0, 4K) and tail [60K, 64K)
+    b = tmp_path / "b.ckpt"
+    b.write_bytes(bytes(diverged))
+
+    da = decoupled_mod._ckpt_digest(str(a), chunk=chunk)
+    db = decoupled_mod._ckpt_digest(str(b), chunk=chunk)
+    assert da != db
+    assert da.startswith(f"{size}:") and db.startswith(f"{size}:")
+
+
+def test_ckpt_digest_small_and_boundary_files(tmp_path):
+    """Files at/below one or two chunks stay well-defined and content-sensitive."""
+    chunk = 1024
+    for size in (0, 1, chunk, chunk + 1, 2 * chunk, 2 * chunk + 1, 3 * chunk):
+        p = tmp_path / f"f_{size}.ckpt"
+        p.write_bytes(b"\x01" * size)
+        d1 = decoupled_mod._ckpt_digest(str(p), chunk=chunk)
+        assert d1.startswith(f"{size}:")
+        if size:
+            q = tmp_path / f"g_{size}.ckpt"
+            q.write_bytes(b"\x01" * (size - 1) + b"\x02")
+            assert decoupled_mod._ckpt_digest(str(q), chunk=chunk) != d1
