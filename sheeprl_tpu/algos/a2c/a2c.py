@@ -24,8 +24,9 @@ from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.data.factory import make_rollout_buffer
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
@@ -39,6 +40,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
     global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
     n_minibatches = max(n_data // global_bs, 1)
     data_sharding = NamedSharding(runtime.mesh, P("data"))
+    nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch):
         norm_obs = normalize_obs(batch, [], obs_keys)
@@ -90,12 +92,20 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
         (grads, pg_sum, v_sum), _ = jax.lax.scan(
             accumulate, (zero_grads, jnp.float32(0), jnp.float32(0)), perm
         )
-        updates, opt_state = tx.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        updates, new_opt_state = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        if nonfinite_guard:
+            # one accumulated update per iteration: guard that single apply
+            (params, opt_state), skipped = resilience.finite_or_skip(
+                (pg_sum, v_sum, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+            )
+        else:
+            params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
         flat_params = params_sync.ravel(params) if params_sync is not None else jnp.zeros(())
         return params, opt_state, flat_params, {
             "Loss/policy_loss": pg_sum / n_minibatches,
             "Loss/value_loss": v_sum / n_minibatches,
+            "Resilience/nonfinite_skips": skipped,
         }
 
     return jax.jit(train, donate_argnums=(0, 1))
@@ -120,13 +130,15 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
+    ft = resilience.resolve(cfg)
     n_envs = cfg.env.num_envs * world_size
-    envs = vectorized_env(
+    envs = resilience.make_supervised_env(
         [
             make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
             for i in range(n_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -178,133 +190,162 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
+    if state and "rng" in state:
+        rng = jnp.asarray(state["rng"])
+        player_rng = jax.device_put(jnp.asarray(state["player_rng"]), runtime.player_device)
 
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
         step_data[k] = next_obs[k][np.newaxis]
 
-    for iter_num in range(start_iter, total_iters + 1):
-        profiler.step(policy_step)
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += n_envs
+    def _ckpt_state():
+        # shared by the periodic checkpoint and the preemption emergency save so
+        # both are resumable through the identical path; the rng chains make the
+        # resumed run BIT-IDENTICAL to an uninterrupted one
+        return {
+            "agent": jax.device_get(params),
+            "optimizer": jax.device_get(opt_state),
+            "iter_num": iter_num * world_size,
+            "batch_size": cfg.algo.per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": jax.device_get(rng),
+            "player_rng": jax.device_get(player_rng),
+        }
 
-            with timer("Time/env_interaction_time", SumMetric()):
-                # raw obs straight into the player jit (see PPOPlayer.act_raw;
-                # A2C reuses the PPO agent, vector obs only)
-                cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+    guard = resilience.PreemptionGuard(
+        enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
+    )
+    with guard:
+        for iter_num in range(start_iter, total_iters + 1):
+            profiler.step(policy_step)
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += n_envs
+
+                with timer("Time/env_interaction_time", SumMetric()):
+                    # raw obs straight into the player jit (see PPOPlayer.act_raw;
+                    # A2C reuses the PPO agent, vector obs only)
+                    cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                    if device_rollout:
+                        # in-graph scatter: actions/values stay in HBM (A2C's loss
+                        # recomputes logprobs, so only these two leaves are stored)
+                        rb.add_policy({"actions": cat_actions, "values": values})
+                    # the one unavoidable per-step device->host sync: env actions
+                    real_actions = np.asarray(env_actions)
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                    rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
+
                 if device_rollout:
-                    # in-graph scatter: actions/values stay in HBM (A2C's loss
-                    # recomputes logprobs, so only these two leaves are stored)
-                    rb.add_policy({"actions": cat_actions, "values": values})
-                # the one unavoidable per-step device->host sync: env actions
-                real_actions = np.asarray(env_actions)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
-                dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
-                rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
+                    rb.add_env(
+                        {
+                            "rewards": rewards,
+                            "dones": dones,
+                            **{k: next_obs[k] for k in obs_keys},
+                        }
+                    )
+                else:
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(values)[np.newaxis]
+                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
+                    step_data["rewards"] = rewards[np.newaxis]
+                    if cfg.buffer.memmap:
+                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-            if device_rollout:
-                rb.add_env(
-                    {
-                        "rewards": rewards,
-                        "dones": dones,
-                        **{k: next_obs[k] for k in obs_keys},
+                next_obs = {}
+                for k in obs_keys:
+                    step_data[k] = obs[k][np.newaxis]
+                    next_obs[k] = obs[k]
+
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            if not device_rollout:
+                local_data = rb.to_arrays(dtype=np.float32)
+                if cfg.buffer.size > cfg.algo.rollout_steps:
+                    idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                    local_data = {k: v[idx] for k, v in local_data.items()}
+            with timer("Time/train_time", SumMetric()):
+                jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
+                rng, train_key = jax.random.split(rng)
+                if device_rollout:
+                    # HBM rollout + bootstrap values: player-device -> trainer-mesh,
+                    # no host round-trip
+                    device_data, next_values = runtime.replicate(
+                        (rb.rollout(), player.get_values(jax_obs))
+                    )
+                else:
+                    next_values = np.asarray(player.get_values(jax_obs))
+                    device_data = {
+                        k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
                     }
+                params, opt_state, flat_params, train_metrics = train_fn(
+                    params, opt_state, device_data, next_values, train_key
                 )
-            else:
-                step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(values)[np.newaxis]
-                step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
-                step_data["rewards"] = rewards[np.newaxis]
-                if cfg.buffer.memmap:
-                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs = {}
-            for k in obs_keys:
-                step_data[k] = obs[k][np.newaxis]
-                next_obs[k] = obs[k]
+                player.params = params_sync.pull(flat_params, runtime.player_device)
+                if not timer.disabled:
+                    jax.block_until_ready(params)
+            train_step += world_size
 
             if cfg.metric.log_level > 0:
-                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                    if aggregator and "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if aggregator and "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                if aggregator:
+                    aggregator.update_from_device(train_metrics)
+                if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    if aggregator and not aggregator.disabled:
+                        logger.log_metrics(aggregator.compute(), policy_step)
+                        aggregator.reset()
+                    if not timer.disabled:
+                        timer_metrics = timer.compute()
+                        if timer_metrics.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                    )
+                                    / timer_metrics["Time/env_interaction_time"]
+                                },
+                                policy_step,
+                            )
+                        timer.reset()
+                    last_log = policy_step
+                    last_train = train_step
 
-        if not device_rollout:
-            local_data = rb.to_arrays(dtype=np.float32)
-            if cfg.buffer.size > cfg.algo.rollout_steps:
-                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-                local_data = {k: v[idx] for k, v in local_data.items()}
-        with timer("Time/train_time", SumMetric()):
-            jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-            rng, train_key = jax.random.split(rng)
-            if device_rollout:
-                # HBM rollout + bootstrap values: player-device -> trainer-mesh,
-                # no host round-trip
-                device_data, next_values = runtime.replicate(
-                    (rb.rollout(), player.get_values(jax_obs))
+            resilience.enforce_nonfinite_policy(ft, train_metrics)
+            resilience.drain_env_counters(envs, aggregator)
+
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+
+            guard.completed_iteration()
+            if guard.should_stop:
+                if last_checkpoint != policy_step:  # periodic save above already covered this step
+                    last_checkpoint = policy_step
+                    ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.print(
+                    f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
+                    "checkpoint saved, exiting cleanly for resume."
                 )
-            else:
-                next_values = np.asarray(player.get_values(jax_obs))
-                device_data = {
-                    k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                }
-            params, opt_state, flat_params, train_metrics = train_fn(
-                params, opt_state, device_data, next_values, train_key
-            )
-            player.params = params_sync.pull(flat_params, runtime.player_device)
-            if not timer.disabled:
-                jax.block_until_ready(params)
-        train_step += world_size
-
-        if cfg.metric.log_level > 0:
-            if aggregator:
-                aggregator.update_from_device(train_metrics)
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_metrics(aggregator.compute(), policy_step)
-                    aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time", 0) > 0:
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
-                last_log = policy_step
-                last_train = train_step
-
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "optimizer": jax.device_get(opt_state),
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+                break
 
     profiler.close()
     envs.close()

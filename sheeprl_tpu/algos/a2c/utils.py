@@ -10,7 +10,15 @@ import numpy as np
 
 from sheeprl_tpu.algos.ppo.utils import test  # noqa: F401  (same greedy test loop)
 
-AGGREGATOR_KEYS = {"Rewards/rew_avg", "Game/ep_len_avg", "Loss/value_loss", "Loss/policy_loss"}
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Resilience/env_restarts",
+    "Resilience/env_timeouts",
+    "Resilience/nonfinite_skips",
+}
 MODELS_TO_REGISTER = {"agent"}
 
 

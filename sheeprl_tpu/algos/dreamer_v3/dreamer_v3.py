@@ -37,6 +37,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
@@ -101,6 +102,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         )
     imagination_unroll = int(cfg.algo.get("imagination_scan_unroll", 1))
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+    nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     world_tx = with_clipping(
         instantiate(dict(cfg.algo.world_model.optimizer))(), cfg.algo.world_model.clip_gradients
@@ -197,6 +199,13 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         world_grad_norm = optax_global_norm(world_grads)
         world_updates, world_opt = world_tx.update(world_grads, opt_states.world, params["world_model"])
         new_wm = apply_updates(params["world_model"], world_updates)
+        if nonfinite_guard:
+            # a skipped world update also feeds the OLD world model to imagination below
+            (new_wm, world_opt), wm_skipped = resilience.finite_or_skip(
+                (world_loss, world_grad_norm), (new_wm, world_opt), (params["world_model"], opt_states.world)
+            )
+        else:
+            wm_skipped = jnp.float32(0.0)
 
         # ---- behaviour learning: imagination with the freshly-updated world model
         posteriors = jax.lax.stop_gradient(aux["posteriors"])  # [T, B, S, D]
@@ -309,6 +318,12 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         actor_grad_norm = optax_global_norm(actor_grads)
         actor_updates, actor_opt = actor_tx.update(actor_grads, opt_states.actor, params["actor"])
         new_actor = apply_updates(params["actor"], actor_updates)
+        if nonfinite_guard:
+            (new_actor, actor_opt), actor_skipped = resilience.finite_or_skip(
+                (policy_loss, actor_grad_norm), (new_actor, actor_opt), (params["actor"], opt_states.actor)
+            )
+        else:
+            actor_skipped = jnp.float32(0.0)
 
         # ---- critic update (Eq. 10) on the pre-update-actor trajectories
         trajectories = jax.lax.stop_gradient(aux_a["trajectories"])
@@ -329,6 +344,12 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         critic_grad_norm = optax_global_norm(critic_grads)
         critic_updates, critic_opt = critic_tx.update(critic_grads, opt_states.critic, params["critic"])
         new_critic = apply_updates(params["critic"], critic_updates)
+        if nonfinite_guard:
+            (new_critic, critic_opt), critic_skipped = resilience.finite_or_skip(
+                (value_loss, critic_grad_norm), (new_critic, critic_opt), (params["critic"], opt_states.critic)
+            )
+        else:
+            critic_skipped = jnp.float32(0.0)
 
         post_ent = Independent(OneHotCategorical(logits=aux["posteriors_logits"]), 1).entropy().mean()
         prior_ent = Independent(OneHotCategorical(logits=aux["priors_logits"]), 1).entropy().mean()
@@ -358,6 +379,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
                 # when a policy degrades under a healthy world model+critic
                 aux_a["moments"].low,
                 aux_a["moments"].high,
+                wm_skipped + actor_skipped + critic_skipped,
             ]
         )
         return (new_params, DV3OptStates(world_opt, actor_opt, critic_opt), aux_a["moments"], counter + 1), metrics
@@ -385,6 +407,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
             "Grads/critic": m[12],
             "State/moments_low": m[13],
             "State/moments_high": m[14],
+            "Resilience/nonfinite_skips": metrics[:, 15].sum(),
         }
         # raveled player subset computed in-graph: the host-player refresh is one
         # flat transfer, not a per-leaf pull (see DreamerPlayerSync)
@@ -429,23 +452,30 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
-    envs = vectorized_env(
-        [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * cfg.env.num_envs + i,
-                    rank * cfg.env.num_envs,
-                    log_dir if runtime.is_global_zero else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
-            )
-            for i in range(cfg.env.num_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
+    ft = resilience.resolve(cfg)
+    env_fns = [
+        make_env(
+            cfg,
+            cfg.seed + rank * cfg.env.num_envs + i,
+            rank * cfg.env.num_envs,
+            log_dir if runtime.is_global_zero else None,
+            "train",
+            vector_env_idx=i,
+        )
+        for i in range(cfg.env.num_envs)
+    ]
+    if ft.env_supervision.enabled:
+        # WorkerSupervisor supersedes RestartOnException: same restart-on-crash
+        # semantics (it emits the same `restart_on_exception` info key the buffer
+        # patching below consumes) plus bounded backoff, hang detection via the
+        # per-step deadline, and exported restart counters
+        envs = resilience.make_supervised_env(env_fns, sync=cfg.env.sync_env, ft=ft)
+    else:
+        envs = vectorized_env(
+            [partial(RestartOnException, fn) for fn in env_fns],
+            sync=cfg.env.sync_env,
+            step_timeout=ft.env_supervision.step_timeout_s,
+        )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 
@@ -554,6 +584,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
+    if state and "rng" in state:
+        rng = jnp.asarray(state["rng"])
     step_data: Dict[str, np.ndarray] = {}
     obs = envs.reset(seed=cfg.seed)[0]
     for k in obs_keys:
@@ -566,193 +598,218 @@ def main(runtime, cfg: Dict[str, Any]):
 
     cumulative_per_rank_gradient_steps = 0
     heartbeat_t0, heartbeat_iter = time.perf_counter(), start_iter
-    for iter_num in range(start_iter, total_iters + 1):
-        profiler.step(policy_step)
-        policy_step += policy_steps_per_iter
-        if iter_num % 100 == 0 and iter_num > heartbeat_iter:
-            now = time.perf_counter()
-            runtime.print(
-                f"[hb] iter={iter_num}/{total_iters} policy_step={policy_step} "
-                f"({(iter_num - heartbeat_iter) / (now - heartbeat_t0):.2f} it/s)",
-                flush=True,
-            )
-            heartbeat_t0, heartbeat_iter = now, iter_num
 
-        with timer("Time/env_interaction_time", SumMetric()):
-            if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():
-                real_actions = actions = np.array(envs.action_space.sample())
-                if not is_continuous:
-                    actions = np.concatenate(
-                        [
-                            np.eye(act_dim, dtype=np.float32)[act.reshape(-1)]
-                            for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
-                        ],
-                        axis=-1,
-                    )
-            else:
-                jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                mask = get_action_masks(jax_obs)
-                rng, act_key = jax.random.split(rng)
-                actions_list = player.get_actions(jax_obs, act_key, mask=mask)
-                actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
-                if is_continuous:
-                    real_actions = actions
+    def _save_checkpoint():
+        # shared by the periodic checkpoint and the preemption emergency save so
+        # both are resumable through the identical path; the rng chain makes the
+        # resumed action/train key sequence identical to an uninterrupted run
+        ckpt_state = {
+            "world_model": jax.device_get(params["world_model"]),
+            "actor": jax.device_get(params["actor"]),
+            "critic": jax.device_get(params["critic"]),
+            "target_critic": jax.device_get(params["target_critic"]),
+            "opt_states": jax.device_get(opt_states),
+            "moments": tuple(np.asarray(v) for v in moments_state),
+            "counter": int(counter),
+            "ratio": ratio.state_dict(),
+            "iter_num": iter_num * world_size,
+            "batch_size": cfg.algo.per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": jax.device_get(rng),
+        }
+        ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
+        runtime.call(
+            "on_checkpoint_coupled",
+            ckpt_path=ckpt_path,
+            state=ckpt_state,
+            replay_buffer=rb if cfg.buffer.checkpoint else None,
+            io_lock=prefetcher.guard(),
+        )
+
+    guard = resilience.PreemptionGuard(
+        enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
+    )
+    with guard:
+        for iter_num in range(start_iter, total_iters + 1):
+            profiler.step(policy_step)
+            policy_step += policy_steps_per_iter
+            if iter_num % 100 == 0 and iter_num > heartbeat_iter:
+                now = time.perf_counter()
+                runtime.print(
+                    f"[hb] iter={iter_num}/{total_iters} policy_step={policy_step} "
+                    f"({(iter_num - heartbeat_iter) / (now - heartbeat_t0):.2f} it/s)",
+                    flush=True,
+                )
+                heartbeat_t0, heartbeat_iter = now, iter_num
+
+            with timer("Time/env_interaction_time", SumMetric()):
+                if iter_num <= learning_starts and state is None and "minedojo" not in cfg.env.wrapper._target_.lower():
+                    real_actions = actions = np.array(envs.action_space.sample())
+                    if not is_continuous:
+                        actions = np.concatenate(
+                            [
+                                np.eye(act_dim, dtype=np.float32)[act.reshape(-1)]
+                                for act, act_dim in zip(actions.reshape(len(actions_dim), -1), actions_dim)
+                            ],
+                            axis=-1,
+                        )
                 else:
-                    real_actions = np.stack(
-                        [np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1
-                    )
+                    jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                    mask = get_action_masks(jax_obs)
+                    rng, act_key = jax.random.split(rng)
+                    actions_list = player.get_actions(jax_obs, act_key, mask=mask)
+                    actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
+                    if is_continuous:
+                        real_actions = actions
+                    else:
+                        real_actions = np.stack(
+                            [np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1
+                        )
 
-            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-            with prefetcher.guard():  # no torn rows under the worker's concurrent sample
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+                with prefetcher.guard():  # no torn rows under the worker's concurrent sample
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                real_actions.reshape(envs.action_space.shape)
-            )
-            dones = np.logical_or(terminated, truncated).astype(np.uint8)
+                next_obs, rewards, terminated, truncated, infos = envs.step(
+                    real_actions.reshape(envs.action_space.shape)
+                )
+                dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
-        step_data["is_first"] = np.zeros_like(step_data["terminated"])
-        if "restart_on_exception" in infos:
-            for i, agent_roe in enumerate(infos["restart_on_exception"]):
-                if agent_roe and not dones[i]:
-                    # crash-restart boundary: the last stored transition becomes a
-                    # truncation (works on host and HBM buffers alike)
-                    with prefetcher.guard():  # no torn flags under the worker's sample
-                        rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
-                    step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
+            step_data["is_first"] = np.zeros_like(step_data["terminated"])
+            if "restart_on_exception" in infos:
+                for i, agent_roe in enumerate(infos["restart_on_exception"]):
+                    if agent_roe and not dones[i]:
+                        # crash-restart boundary: the last stored transition becomes a
+                        # truncation (works on host and HBM buffers alike)
+                        with prefetcher.guard():  # no torn flags under the worker's sample
+                            rb.patch_last([i], {"terminated": 0.0, "truncated": 1.0, "is_first": 0.0})
+                        step_data["is_first"][0, i] = np.ones_like(step_data["is_first"][0, i])
 
-        if cfg.metric.log_level > 0:
-            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
-                if aggregator:
-                    if "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                    if aggregator:
+                        if "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # Save the real next observation (terminal obs for autoreset envs)
-        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
-        finals = final_observations(infos, obs_keys)
-        if finals:
-            for idx, final_obs in finals.items():
-                for k, v in final_obs.items():
-                    real_next_obs[k][idx] = v
+            # Save the real next observation (terminal obs for autoreset envs)
+            real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+            finals = final_observations(infos, obs_keys)
+            if finals:
+                for idx, final_obs in finals.items():
+                    for k, v in final_obs.items():
+                        real_next_obs[k][idx] = v
 
-        for k in obs_keys:
-            step_data[k] = np.asarray(next_obs[k])[np.newaxis]
-        obs = next_obs
-
-        rewards = np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        step_data["rewards"] = clip_rewards_fn(rewards)
-
-        dones_idxes = dones.nonzero()[0].tolist()
-        reset_envs = len(dones_idxes)
-        if reset_envs > 0:
-            reset_data = {}
             for k in obs_keys:
-                reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
-            reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
-            reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
-            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
-            reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
-            reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
-            with prefetcher.guard():
-                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+                step_data[k] = np.asarray(next_obs[k])[np.newaxis]
+            obs = next_obs
 
-            step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
-            step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
-            step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
-            step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
-            player.init_states(dones_idxes)
+            rewards = np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            step_data["rewards"] = clip_rewards_fn(rewards)
 
-        # ---- training phase
-        if iter_num >= learning_starts:
-            ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
-            per_rank_gradient_steps = ratio(ratio_steps / world_size)
-            if per_rank_gradient_steps > 0:
-                # steady-state: this consumes the batch prefetched during the previous
-                # train step and immediately starts speculating the next one
-                batches = prefetcher.get(
-                    batch_size=cfg.algo.per_rank_batch_size * world_size,
-                    sequence_length=cfg.algo.per_rank_sequence_length,
-                    n_samples=per_rank_gradient_steps,
-                )
-                with timer("Time/train_time", SumMetric()):
-                    rng, train_key = jax.random.split(rng)
-                    params, opt_states, moments_state, counter, flat_player, train_metrics = train_fn(
-                        params, opt_states, moments_state, counter, batches, train_key
+            dones_idxes = dones.nonzero()[0].tolist()
+            reset_envs = len(dones_idxes)
+            if reset_envs > 0:
+                reset_data = {}
+                for k in obs_keys:
+                    reset_data[k] = (real_next_obs[k][dones_idxes])[np.newaxis]
+                reset_data["terminated"] = step_data["terminated"][:, dones_idxes]
+                reset_data["truncated"] = step_data["truncated"][:, dones_idxes]
+                reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+                reset_data["rewards"] = step_data["rewards"][:, dones_idxes]
+                reset_data["is_first"] = np.zeros_like(reset_data["terminated"])
+                with prefetcher.guard():
+                    rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+
+                step_data["rewards"][:, dones_idxes] = np.zeros_like(reset_data["rewards"])
+                step_data["terminated"][:, dones_idxes] = np.zeros_like(step_data["terminated"][:, dones_idxes])
+                step_data["truncated"][:, dones_idxes] = np.zeros_like(step_data["truncated"][:, dones_idxes])
+                step_data["is_first"][:, dones_idxes] = np.ones_like(step_data["is_first"][:, dones_idxes])
+                player.init_states(dones_idxes)
+
+            # ---- training phase
+            if iter_num >= learning_starts:
+                ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
+                per_rank_gradient_steps = ratio(ratio_steps / world_size)
+                if per_rank_gradient_steps > 0:
+                    # steady-state: this consumes the batch prefetched during the previous
+                    # train step and immediately starts speculating the next one
+                    batches = prefetcher.get(
+                        batch_size=cfg.algo.per_rank_batch_size * world_size,
+                        sequence_length=cfg.algo.per_rank_sequence_length,
+                        n_samples=per_rank_gradient_steps,
                     )
-                    if not timer.disabled:
-                        # fence ONLY when timing: Time/train_time must include the
-                        # device work, but an unconditional sync would serialize the
-                        # loop on the dispatch round-trip
-                        jax.block_until_ready(params)
-                    psync.push(player, params, flat=flat_player)
-                    cumulative_per_rank_gradient_steps += per_rank_gradient_steps
-                    train_step += world_size * per_rank_gradient_steps
-                if aggregator:
-                    aggregator.update_from_device(train_metrics)
+                    with timer("Time/train_time", SumMetric()):
+                        rng, train_key = jax.random.split(rng)
+                        params, opt_states, moments_state, counter, flat_player, train_metrics = train_fn(
+                            params, opt_states, moments_state, counter, batches, train_key
+                        )
+                        if not timer.disabled:
+                            # fence ONLY when timing: Time/train_time must include the
+                            # device work, but an unconditional sync would serialize the
+                            # loop on the dispatch round-trip
+                            jax.block_until_ready(params)
+                        psync.push(player, params, flat=flat_player)
+                        cumulative_per_rank_gradient_steps += per_rank_gradient_steps
+                        train_step += world_size * per_rank_gradient_steps
+                    if aggregator:
+                        aggregator.update_from_device(train_metrics)
+                    resilience.enforce_nonfinite_policy(ft, train_metrics)
+            resilience.drain_env_counters(envs, aggregator)
 
-        # ---- logging
-        if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
-            if aggregator and not aggregator.disabled:
-                logger.log_metrics(aggregator.compute(), policy_step)
-                aggregator.reset()
-            if logger and policy_step > 0:
-                logger.log_metrics(
-                    {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
-                    policy_step,
-                )
-            if not timer.disabled:
-                timer_metrics = timer.compute()
-                if logger and timer_metrics.get("Time/train_time", 0) > 0:
+            # ---- logging
+            if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+                if aggregator and not aggregator.disabled:
+                    logger.log_metrics(aggregator.compute(), policy_step)
+                    aggregator.reset()
+                if logger and policy_step > 0:
                     logger.log_metrics(
-                        {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                        {"Params/replay_ratio": cumulative_per_rank_gradient_steps * world_size / policy_step},
                         policy_step,
                     )
-                if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
-                    logger.log_metrics(
-                        {
-                            "Time/sps_env_interaction": (
-                                (policy_step - last_log) / world_size * cfg.env.action_repeat
-                            )
-                            / timer_metrics["Time/env_interaction_time"]
-                        },
-                        policy_step,
-                    )
-                timer.reset()
-            last_log = policy_step
-            last_train = train_step
+                if not timer.disabled:
+                    timer_metrics = timer.compute()
+                    if logger and timer_metrics.get("Time/train_time", 0) > 0:
+                        logger.log_metrics(
+                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                            policy_step,
+                        )
+                    if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                        logger.log_metrics(
+                            {
+                                "Time/sps_env_interaction": (
+                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                )
+                                / timer_metrics["Time/env_interaction_time"]
+                            },
+                            policy_step,
+                        )
+                    timer.reset()
+                last_log = policy_step
+                last_train = train_step
 
-        # ---- checkpoint
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "world_model": jax.device_get(params["world_model"]),
-                "actor": jax.device_get(params["actor"]),
-                "critic": jax.device_get(params["critic"]),
-                "target_critic": jax.device_get(params["target_critic"]),
-                "opt_states": jax.device_get(opt_states),
-                "moments": tuple(np.asarray(v) for v in moments_state),
-                "counter": int(counter),
-                "ratio": ratio.state_dict(),
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{rank}.ckpt")
-            runtime.call(
-                "on_checkpoint_coupled",
-                ckpt_path=ckpt_path,
-                state=ckpt_state,
-                replay_buffer=rb if cfg.buffer.checkpoint else None,
-                io_lock=prefetcher.guard(),
-            )
+            # ---- checkpoint
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                _save_checkpoint()
+
+            guard.completed_iteration()
+            if guard.should_stop:
+                if last_checkpoint != policy_step:  # periodic save above already covered this step
+                    last_checkpoint = policy_step
+                    _save_checkpoint()
+                runtime.print(
+                    f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
+                    "checkpoint saved, exiting cleanly for resume."
+                )
+                break
 
     prefetcher.close()
     profiler.close()

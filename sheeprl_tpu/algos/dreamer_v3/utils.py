@@ -32,6 +32,9 @@ AGGREGATOR_KEYS = {
     "Grads/world_model",
     "Grads/actor",
     "Grads/critic",
+    "Resilience/env_restarts",
+    "Resilience/env_timeouts",
+    "Resilience/nonfinite_skips",
 }
 MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
 

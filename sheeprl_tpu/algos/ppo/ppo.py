@@ -30,8 +30,9 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.data.factory import make_rollout_buffer
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
@@ -60,6 +61,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
     n_minibatches = max(n_data // global_bs, 1)
     data_sharding = NamedSharding(runtime.mesh, P("data"))
     actions_dim = None  # bound lazily from agent
+    nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -109,9 +111,15 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
                 lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
             )
             (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), jnp.stack([pg, vl, ent])
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if nonfinite_guard:
+                (params, opt_state), skipped = resilience.finite_or_skip(
+                    (loss, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+                )
+            else:
+                params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
+            return (params, opt_state), jnp.stack([pg, vl, ent, skipped])
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
@@ -120,6 +128,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
             "Loss/policy_loss": metrics[0],
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
+            "Resilience/nonfinite_skips": losses[:, 3].sum(),
         }
 
     return jax.jit(train, donate_argnums=(0, 1))
@@ -153,8 +162,9 @@ def main(runtime, cfg: Dict[str, Any]):
 
     # Environment setup: one process drives world_size * num_envs envs (per-rank
     # semantics of the reference are per-device here).
+    ft = resilience.resolve(cfg)
     n_envs = cfg.env.num_envs * world_size
-    envs = vectorized_env(
+    envs = resilience.make_supervised_env(
         [
             make_env(
                 cfg,
@@ -167,6 +177,7 @@ def main(runtime, cfg: Dict[str, Any]):
             for i in range(n_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -263,6 +274,11 @@ def main(runtime, cfg: Dict[str, Any]):
     # Separate rollout key committed to the player device: the policy forward then
     # runs entirely there (mixing committed arrays across backends is an error).
     player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
+    if state and "rng" in state:
+        # restore the EXACT key chains so a preempted run resumes bit-identically
+        # to the uninterrupted one (older checkpoints lack these: seed restart)
+        rng = jnp.asarray(state["rng"])
+        player_rng = jax.device_put(jnp.asarray(state["player_rng"]), runtime.player_device)
 
     step_data = {}
     next_obs = envs.reset(seed=cfg.seed)[0]
@@ -271,186 +287,214 @@ def main(runtime, cfg: Dict[str, Any]):
             next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
         step_data[k] = next_obs[k][np.newaxis]
 
-    for iter_num in range(start_iter, total_iters + 1):
-        profiler.step(policy_step)
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += n_envs
+    def _ckpt_state():
+        # shared by the periodic checkpoint and the preemption emergency save so
+        # both are resumable through the identical path; the rng chains make the
+        # resumed run BIT-IDENTICAL to an uninterrupted one
+        return {
+            "agent": jax.device_get(params),
+            "optimizer": jax.device_get(opt_state),
+            "iter_num": iter_num * world_size,
+            "batch_size": cfg.algo.per_rank_batch_size * world_size,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": jax.device_get(rng),
+            "player_rng": jax.device_get(player_rng),
+        }
 
-            with timer("Time/env_interaction_time", SumMetric()):
-                # raw obs straight into the player jit: normalization runs inside
-                # the one dispatch instead of as a per-step eager prep (see
-                # PPOPlayer.act_raw)
-                cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+    guard = resilience.PreemptionGuard(
+        enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
+    )
+    with guard:
+        for iter_num in range(start_iter, total_iters + 1):
+            profiler.step(policy_step)
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += n_envs
+
+                with timer("Time/env_interaction_time", SumMetric()):
+                    # raw obs straight into the player jit: normalization runs inside
+                    # the one dispatch instead of as a per-step eager prep (see
+                    # PPOPlayer.act_raw)
+                    cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                    if device_rollout:
+                        # in-graph scatter straight from the player step's outputs:
+                        # values/logprobs/actions stay in HBM, no host pull
+                        rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
+                    # the ONE unavoidable per-step device->host sync: the env needs
+                    # the actions on host to step
+                    real_actions = np.asarray(env_actions)
+
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        # bootstrap on truncation (reference ppo.py:292-309)
+                        final_obs_arr = np.asarray(info["final_obs"], dtype=object)
+                        real_next_obs = {k: [] for k in obs_keys}
+                        valid_idx = []
+                        for te in truncated_envs:
+                            fo = final_obs_arr[te]
+                            if fo is None:
+                                continue
+                            valid_idx.append(te)
+                            for k in obs_keys:
+                                v = np.asarray(fo[k], dtype=np.float32)
+                                if k in cnn_keys:
+                                    v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+                                real_next_obs[k].append(v)
+                        if valid_idx:
+                            stacked = {
+                                k: jax.device_put(np.stack(v), runtime.player_device)
+                                for k, v in real_next_obs.items()
+                            }
+                            vals = np.asarray(player.get_values(stacked)).reshape(len(valid_idx))
+                            rewards = np.asarray(rewards, dtype=np.float32)
+                            rewards[valid_idx] += cfg.algo.gamma * vals
+                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                    rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
+
                 if device_rollout:
-                    # in-graph scatter straight from the player step's outputs:
-                    # values/logprobs/actions stay in HBM, no host pull
-                    rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
-                # the ONE unavoidable per-step device->host sync: the env needs
-                # the actions on host to step
-                real_actions = np.asarray(env_actions)
-
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0 and "final_obs" in info:
-                    # bootstrap on truncation (reference ppo.py:292-309)
-                    final_obs_arr = np.asarray(info["final_obs"], dtype=object)
-                    real_next_obs = {k: [] for k in obs_keys}
-                    valid_idx = []
-                    for te in truncated_envs:
-                        fo = final_obs_arr[te]
-                        if fo is None:
-                            continue
-                        valid_idx.append(te)
-                        for k in obs_keys:
-                            v = np.asarray(fo[k], dtype=np.float32)
-                            if k in cnn_keys:
-                                v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
-                            real_next_obs[k].append(v)
-                    if valid_idx:
-                        stacked = {
-                            k: jax.device_put(np.stack(v), runtime.player_device)
-                            for k, v in real_next_obs.items()
+                    # env products (pre-step obs + rewards + dones) ride ONE packed
+                    # device_put; the row index goes in-band, unpacked in-graph
+                    rb.add_env(
+                        {
+                            "rewards": rewards,
+                            "dones": dones,
+                            **{k: next_obs[k] for k in obs_keys},
                         }
-                        vals = np.asarray(player.get_values(stacked)).reshape(len(valid_idx))
-                        rewards = np.asarray(rewards, dtype=np.float32)
-                        rewards[valid_idx] += cfg.algo.gamma * vals
-                dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
-                rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
+                    )
+                else:
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(values)[np.newaxis]
+                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
+                    step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
+                    step_data["rewards"] = rewards[np.newaxis]
+                    if cfg.buffer.memmap:
+                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-            if device_rollout:
-                # env products (pre-step obs + rewards + dones) ride ONE packed
-                # device_put; the row index goes in-band, unpacked in-graph
-                rb.add_env(
-                    {
-                        "rewards": rewards,
-                        "dones": dones,
-                        **{k: next_obs[k] for k in obs_keys},
+                next_obs = {}
+                for k in obs_keys:
+                    _obs = obs[k]
+                    if k in cnn_keys:
+                        _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                    step_data[k] = _obs[np.newaxis]
+                    next_obs[k] = _obs
+
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
+            if not device_rollout:
+                local_data = rb.to_arrays(dtype=np.float32)
+                if cfg.buffer.size > cfg.algo.rollout_steps:
+                    # keep only the last rollout in chronological order (stale/zero rows
+                    # beyond the write head would corrupt GAE)
+                    idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                    local_data = {k: v[idx] for k, v in local_data.items()}
+            with timer("Time/train_time", SumMetric()):
+                jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                rng, train_key = jax.random.split(rng)
+                if device_rollout:
+                    # zero bulk host->device transfer: the completed HBM rollout and
+                    # the bootstrap values move player-device -> trainer-mesh directly
+                    # (ownership transfers out of the buffer, so the train fn's view
+                    # is never aliased by next iteration's donated writes)
+                    device_data, next_values = runtime.replicate(
+                        (rb.rollout(), player.get_values(jax_obs))
+                    )
+                else:
+                    # bootstrap values come from the player device; re-enter the mesh
+                    # uncommitted so the jitted train step can place them freely
+                    next_values = np.asarray(player.get_values(jax_obs))
+                    device_data = {
+                        k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
                     }
+                params, opt_state, flat_params, train_metrics = train_fn(
+                    params,
+                    opt_state,
+                    device_data,
+                    next_values,
+                    train_key,
+                    jnp.float32(cfg.algo.clip_coef),
+                    jnp.float32(cfg.algo.ent_coef),
                 )
-            else:
-                step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(values)[np.newaxis]
-                step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
-                step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-                step_data["rewards"] = rewards[np.newaxis]
-                if cfg.buffer.memmap:
-                    step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-            next_obs = {}
-            for k in obs_keys:
-                _obs = obs[k]
-                if k in cnn_keys:
-                    _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                step_data[k] = _obs[np.newaxis]
-                next_obs[k] = _obs
+                # refresh the player's copy with ONE cross-backend transfer; the next
+                # rollout implicitly waits for (only) the params it needs
+                player.params = params_sync.pull(flat_params, runtime.player_device)
+                if not timer.disabled:  # sync only when the train phase is being timed
+                    jax.block_until_ready(params)
+            train_step += world_size
 
             if cfg.metric.log_level > 0:
-                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                    if aggregator and "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if aggregator and "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                if aggregator:
+                    aggregator.update_from_device(train_metrics)
+                logger.log_metrics({"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step)
+                if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    if aggregator and not aggregator.disabled:
+                        logger.log_metrics(aggregator.compute(), policy_step)
+                        aggregator.reset()
+                    if not timer.disabled:
+                        timer_metrics = timer.compute()
+                        if timer_metrics.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                    )
+                                    / timer_metrics["Time/env_interaction_time"]
+                                },
+                                policy_step,
+                            )
+                        timer.reset()
+                    last_log = policy_step
+                    last_train = train_step
 
-        # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
-        if not device_rollout:
-            local_data = rb.to_arrays(dtype=np.float32)
-            if cfg.buffer.size > cfg.algo.rollout_steps:
-                # keep only the last rollout in chronological order (stale/zero rows
-                # beyond the write head would corrupt GAE)
-                idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-                local_data = {k: v[idx] for k, v in local_data.items()}
-        with timer("Time/train_time", SumMetric()):
-            jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-            rng, train_key = jax.random.split(rng)
-            if device_rollout:
-                # zero bulk host->device transfer: the completed HBM rollout and
-                # the bootstrap values move player-device -> trainer-mesh directly
-                # (ownership transfers out of the buffer, so the train fn's view
-                # is never aliased by next iteration's donated writes)
-                device_data, next_values = runtime.replicate(
-                    (rb.rollout(), player.get_values(jax_obs))
+            # Anneal coefficients (lr annealing lives in the optax schedule)
+            if cfg.algo.anneal_clip_coef:
+                cfg.algo.clip_coef = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
                 )
-            else:
-                # bootstrap values come from the player device; re-enter the mesh
-                # uncommitted so the jitted train step can place them freely
-                next_values = np.asarray(player.get_values(jax_obs))
-                device_data = {
-                    k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                }
-            params, opt_state, flat_params, train_metrics = train_fn(
-                params,
-                opt_state,
-                device_data,
-                next_values,
-                train_key,
-                jnp.float32(cfg.algo.clip_coef),
-                jnp.float32(cfg.algo.ent_coef),
-            )
-            # refresh the player's copy with ONE cross-backend transfer; the next
-            # rollout implicitly waits for (only) the params it needs
-            player.params = params_sync.pull(flat_params, runtime.player_device)
-            if not timer.disabled:  # sync only when the train phase is being timed
-                jax.block_until_ready(params)
-        train_step += world_size
+            if cfg.algo.anneal_ent_coef:
+                cfg.algo.ent_coef = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
 
-        if cfg.metric.log_level > 0:
-            if aggregator:
-                aggregator.update_from_device(train_metrics)
-            logger.log_metrics({"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step)
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_metrics(aggregator.compute(), policy_step)
-                    aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time", 0) > 0:
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
-                last_log = policy_step
-                last_train = train_step
+            resilience.enforce_nonfinite_policy(ft, train_metrics)
+            resilience.drain_env_counters(envs, aggregator)
 
-        # Anneal coefficients (lr annealing lives in the optax schedule)
-        if cfg.algo.anneal_clip_coef:
-            cfg.algo.clip_coef = polynomial_decay(
-                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
-        if cfg.algo.anneal_ent_coef:
-            cfg.algo.ent_coef = polynomial_decay(
-                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "optimizer": jax.device_get(opt_state),
-                "iter_num": iter_num * world_size,
-                "batch_size": cfg.algo.per_rank_batch_size * world_size,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+            guard.completed_iteration()
+            if guard.should_stop:
+                if last_checkpoint != policy_step:  # periodic save above already covered this step
+                    last_checkpoint = policy_step
+                    ckpt_path = os.path.join(
+                        log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt"
+                    )
+                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.print(
+                    f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
+                    "checkpoint saved, exiting cleanly for resume."
+                )
+                break
 
     profiler.close()
     envs.close()

@@ -40,9 +40,10 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
@@ -87,8 +88,15 @@ def main(runtime, cfg: Dict[str, Any]):
     if logger:
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
+    ft = resilience.resolve(cfg)
     if transport is not None:
         transport.set_scope(log_dir)  # run-scope the KV spec exchange (coordinator store outlives runs)
+        transport.configure_faults(
+            op_timeout_ms=ft.transport.op_timeout_ms,
+            retries=ft.transport.retries,
+            backoff_base_s=ft.transport.backoff_base_s,
+            backoff_max_s=ft.transport.backoff_max_s,
+        )
         if cfg.checkpoint.resume_from:
             # every process loaded its own copy of the checkpoint: verify they
             # are the SAME file before any of its state drives a collective
@@ -105,12 +113,13 @@ def main(runtime, cfg: Dict[str, Any]):
     # reference ships agent_args to trainers via object broadcast, :114-117)
     n_envs = cfg.env.num_envs
     if is_player:
-        envs = vectorized_env(
+        envs = resilience.make_supervised_env(
             [
                 make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
                 for i in range(n_envs)
             ],
             sync=cfg.env.sync_env,
+            ft=ft,
         )
         observation_space = envs.single_observation_space
         action_space = envs.single_action_space
@@ -218,9 +227,11 @@ def main(runtime, cfg: Dict[str, Any]):
         # Cross-host: one broadcast collective replaces the reference's pickled
         # object scatter (ppo_decoupled.py:294-299).
         if transport is None:
-            device_data, next_values, train_key, clip_coef, ent_coef = trainer_rt.replicate(payload)
+            device_data, next_values, train_key, clip_coef, ent_coef, stop_flag = trainer_rt.replicate(payload)
         else:
-            device_data, next_values, train_key, clip_coef, ent_coef = transport.rollout_to_trainers(payload)
+            device_data, next_values, train_key, clip_coef, ent_coef, stop_flag = (
+                transport.rollout_to_trainers(payload)
+            )
         train_key = jnp.asarray(train_key).astype(jnp.uint32)
         new_params, new_opt, _flat, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
@@ -235,10 +246,13 @@ def main(runtime, cfg: Dict[str, Any]):
             player_params = jax.device_put(new_params, player_rt.replicated)
         else:
             player_params = transport.params_to_player(new_params)
-        return player_params, metrics
+        return player_params, metrics, stop_flag
 
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
+    if state and "rng" in state:
+        # restore the exact key chain so a preempted run resumes where it left off
+        rng = jnp.asarray(state["rng"])
     step_data = {}
     if is_player:
         next_obs = envs.reset(seed=cfg.seed)[0]
@@ -247,7 +261,23 @@ def main(runtime, cfg: Dict[str, Any]):
                 next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
             step_data[k] = next_obs[k][np.newaxis]
 
-    for iter_num in range(start_iter, total_iters + 1):
+    def _ckpt_state():
+        pull = jax.device_get if transport is None else transport.pull_replicated
+        return {
+            "agent": pull(trainer_state["params"]),
+            "optimizer": pull(trainer_state["opt_state"]),
+            "iter_num": iter_num,
+            "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": jax.device_get(rng),
+        }
+
+    guard = resilience.PreemptionGuard(
+        enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
+    )
+    with guard:
+        for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
             # Only the player process steps envs; trainer processes skip straight
             # to the training collective (their policy_step advances below so the
@@ -361,14 +391,29 @@ def main(runtime, cfg: Dict[str, Any]):
                     next_values = flat.pop("__next_values__")
                     host_data = flat
                 rng, train_key = jax.random.split(rng)
-                player_params, train_metrics = trainer_step(
+                # The player's preemption flag rides the payload broadcast, so every
+                # process agrees on the SAME final iteration (a unilateral break would
+                # desync the next collective). Trainer-process signals are not watched:
+                # fleet preemption delivers SIGTERM to process 0 too, and its next
+                # broadcast carries the stop.
+                stop_agreed = guard.stop_at_iteration_end()
+                player_params, train_metrics, stop_flag = trainer_step(
                     (host_data, next_values, np.asarray(train_key),
-                     np.float32(cfg.algo.clip_coef), np.float32(cfg.algo.ent_coef))
+                     np.float32(cfg.algo.clip_coef), np.float32(cfg.algo.ent_coef),
+                     np.float32(stop_agreed))
                 )
                 if is_player:
                     jax.block_until_ready(player_params)
                     player.params = player_params
+                else:
+                    stop_agreed = bool(np.asarray(stop_flag))
             train_step += trainer_world
+
+            if ft.nonfinite.policy == "halt":
+                resilience.enforce_nonfinite_policy(
+                    ft, transport.pull_replicated(train_metrics) if transport is not None else train_metrics
+                )
+            resilience.drain_env_counters(envs, aggregator)
 
             if is_player and cfg.metric.log_level > 0:
                 if aggregator:
@@ -417,17 +462,22 @@ def main(runtime, cfg: Dict[str, Any]):
                 or (iter_num == total_iters and cfg.checkpoint.save_last)
             ):
                 last_checkpoint = policy_step
-                pull = jax.device_get if transport is None else transport.pull_replicated
-                ckpt_state = {
-                    "agent": pull(trainer_state["params"]),
-                    "optimizer": pull(trainer_state["opt_state"]),
-                    "iter_num": iter_num,
-                    "batch_size": cfg.algo.per_rank_batch_size * trainer_world,
-                    "last_log": last_log,
-                    "last_checkpoint": last_checkpoint,
-                }
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=ckpt_state)
+                runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state())
+
+            guard.completed_iteration()
+            if stop_agreed if transport is not None else guard.should_stop:
+                if is_player and last_checkpoint != policy_step:
+                    last_checkpoint = policy_step
+                    ckpt_path = os.path.join(
+                        log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt"
+                    )
+                    runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.print(
+                    f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
+                    "checkpoint saved, exiting cleanly for resume."
+                )
+                break
 
     profiler.close()
     if envs is not None:

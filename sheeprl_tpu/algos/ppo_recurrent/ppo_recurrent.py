@@ -24,8 +24,9 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.data.factory import make_rollout_buffer
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
@@ -43,6 +44,7 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
     update_epochs = int(cfg.algo.update_epochs)
     n_batches = max(int(cfg.algo.per_rank_num_batches), 1)
     data_sharding = NamedSharding(runtime.mesh, P(None, "data"))
+    nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch, clip_coef, ent_coef):
         norm_obs = normalize_obs(batch, cnn_keys, obs_keys)
@@ -97,9 +99,15 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
             batch["prev_hx"] = batch["prev_hx"][0]
             batch["prev_cx"] = batch["prev_cx"][0]
             (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
-            updates, opt_state = tx.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), jnp.stack([pg, vl, ent])
+            updates, new_opt_state = tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            if nonfinite_guard:
+                (params, opt_state), skipped = resilience.finite_or_skip(
+                    (loss, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+                )
+            else:
+                params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
+            return (params, opt_state), jnp.stack([pg, vl, ent, skipped])
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
@@ -108,6 +116,7 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
             "Loss/policy_loss": metrics[0],
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
+            "Resilience/nonfinite_skips": losses[:, 3].sum(),
         }
 
     return jax.jit(train, donate_argnums=(0, 1))
@@ -176,13 +185,15 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
+    ft = resilience.resolve(cfg)
     n_envs = cfg.env.num_envs * world_size
-    envs = vectorized_env(
+    envs = resilience.make_supervised_env(
         [
             make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
             for i in range(n_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
@@ -240,6 +251,9 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler = TraceProfiler(cfg.metric.get("profiler"), log_dir if runtime.is_global_zero else None)
     rng = jax.random.PRNGKey(cfg.seed)
     player_rng = jax.device_put(jax.random.PRNGKey(cfg.seed + 1), runtime.player_device)
+    if state and "rng" in state:
+        rng = jnp.asarray(state["rng"])
+        player_rng = jax.device_put(jnp.asarray(state["player_rng"]), runtime.player_device)
     h = cfg.algo.rnn.lstm.hidden_size
 
     step_data = {}
@@ -251,199 +265,225 @@ def main(runtime, cfg: Dict[str, Any]):
     prev_states = player.initial_states(h)
     prev_actions = np.zeros((n_envs, sum(actions_dim)), dtype=np.float32)
 
-    for iter_num in range(start_iter, total_iters + 1):
-        profiler.step(policy_step)
-        for _ in range(cfg.algo.rollout_steps):
-            policy_step += n_envs
+    def _ckpt_state():
+        # shared by the periodic checkpoint and the preemption emergency save so
+        # both are resumable through the identical path; the rng chains make the
+        # resumed run BIT-IDENTICAL to an uninterrupted one
+        return {
+            "agent": jax.device_get(params),
+            "optimizer": jax.device_get(opt_state),
+            "iter_num": iter_num * world_size,
+            "batch_size": -1,
+            "last_log": last_log,
+            "last_checkpoint": last_checkpoint,
+            "rng": jax.device_get(rng),
+            "player_rng": jax.device_get(player_rng),
+        }
 
-            with timer("Time/env_interaction_time", SumMetric()):
-                # raw obs + prev actions straight into the player jit (see
-                # RecurrentPPOPlayer.act_raw): one dispatch per env step
-                cat_actions, env_actions, logprobs, values, states, player_rng = player.act_raw(
-                    next_obs,
-                    prev_actions,
-                    prev_states,
-                    player_rng,
-                )
-                real_actions = np.asarray(env_actions)
-                obs, rewards, terminated, truncated, info = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
-                rewards = np.asarray(rewards, dtype=np.float32)
-                # bootstrap on truncation (reference ppo_recurrent.py:312-336)
-                truncated_envs = np.nonzero(truncated)[0]
-                if len(truncated_envs) > 0 and "final_obs" in info:
-                    final_obs_arr = np.asarray(info["final_obs"], dtype=object)
-                    for te in truncated_envs:
-                        fo = final_obs_arr[te]
-                        if fo is None:
-                            continue
-                        f_obs = {}
-                        for k in obs_keys:
-                            v = np.asarray(fo[k], dtype=np.float32)
-                            if k in cnn_keys:
-                                v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
-                            f_obs[k] = jnp.asarray(v)[None, None]
-                        te_states = tuple(s[te : te + 1] for s in states)
-                        te_prev_act = jnp.asarray(cat_actions).reshape(n_envs, -1)[te : te + 1][None]
-                        val, _ = player.get_values(f_obs, te_prev_act, te_states)
-                        rewards[te] += cfg.algo.gamma * float(np.asarray(val).reshape(-1)[0])
-                dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.float32)
-                rewards = rewards.reshape(n_envs, -1)
+    guard = resilience.PreemptionGuard(
+        enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
+    )
+    with guard:
+        for iter_num in range(start_iter, total_iters + 1):
+            profiler.step(policy_step)
+            for _ in range(cfg.algo.rollout_steps):
+                policy_step += n_envs
 
-            if device_rollout:
-                # policy outputs + the recurrent state that PRODUCED this step:
-                # all scattered in-graph, no per-step host pull
-                rb.add_policy(
-                    {
-                        "values": jnp.reshape(values, (n_envs, 1)),
-                        "actions": jnp.reshape(cat_actions, (n_envs, -1)),
-                        "logprobs": jnp.reshape(logprobs, (n_envs, 1)),
-                        "prev_hx": jnp.reshape(prev_states[0], (n_envs, -1)),
-                        "prev_cx": jnp.reshape(prev_states[1], (n_envs, -1)),
-                        "prev_actions": jnp.reshape(jnp.asarray(prev_actions), (n_envs, -1)),
-                    }
-                )
-                rb.add_env(
-                    {
-                        "rewards": rewards,
-                        "dones": dones,
-                        **{k: next_obs[k] for k in obs_keys},
-                    }
-                )
-                # prev action feedback stays device-side (dones ride up with the
-                # packed env put's sibling transfer; small and async)
-                prev_actions = jnp.asarray(1.0 - dones, dtype=jnp.float32) * jnp.reshape(
-                    cat_actions, (n_envs, -1)
-                )
-            else:
-                step_data["dones"] = dones[np.newaxis]
-                step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
-                step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
-                step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
-                step_data["rewards"] = rewards[np.newaxis]
-                step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
-                step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
-                step_data["prev_actions"] = np.asarray(prev_actions).reshape(1, n_envs, -1)
-                rb.add(step_data, validate_args=cfg.buffer.validate_args)
-                prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
+                with timer("Time/env_interaction_time", SumMetric()):
+                    # raw obs + prev actions straight into the player jit (see
+                    # RecurrentPPOPlayer.act_raw): one dispatch per env step
+                    cat_actions, env_actions, logprobs, values, states, player_rng = player.act_raw(
+                        next_obs,
+                        prev_actions,
+                        prev_states,
+                        player_rng,
+                    )
+                    real_actions = np.asarray(env_actions)
+                    obs, rewards, terminated, truncated, info = envs.step(
+                        real_actions.reshape(envs.action_space.shape)
+                    )
+                    rewards = np.asarray(rewards, dtype=np.float32)
+                    # bootstrap on truncation (reference ppo_recurrent.py:312-336)
+                    truncated_envs = np.nonzero(truncated)[0]
+                    if len(truncated_envs) > 0 and "final_obs" in info:
+                        final_obs_arr = np.asarray(info["final_obs"], dtype=object)
+                        for te in truncated_envs:
+                            fo = final_obs_arr[te]
+                            if fo is None:
+                                continue
+                            f_obs = {}
+                            for k in obs_keys:
+                                v = np.asarray(fo[k], dtype=np.float32)
+                                if k in cnn_keys:
+                                    v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+                                f_obs[k] = jnp.asarray(v)[None, None]
+                            te_states = tuple(s[te : te + 1] for s in states)
+                            te_prev_act = jnp.asarray(cat_actions).reshape(n_envs, -1)[te : te + 1][None]
+                            val, _ = player.get_values(f_obs, te_prev_act, te_states)
+                            rewards[te] += cfg.algo.gamma * float(np.asarray(val).reshape(-1)[0])
+                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.float32)
+                    rewards = rewards.reshape(n_envs, -1)
 
-            # reset recurrent state on done (reference :356-371)
-            if cfg.algo.reset_recurrent_state_on_done:
-                not_done = jnp.asarray(1.0 - dones, dtype=jnp.float32)
-                prev_states = tuple(not_done * s for s in states)
-            else:
-                prev_states = states
+                if device_rollout:
+                    # policy outputs + the recurrent state that PRODUCED this step:
+                    # all scattered in-graph, no per-step host pull
+                    rb.add_policy(
+                        {
+                            "values": jnp.reshape(values, (n_envs, 1)),
+                            "actions": jnp.reshape(cat_actions, (n_envs, -1)),
+                            "logprobs": jnp.reshape(logprobs, (n_envs, 1)),
+                            "prev_hx": jnp.reshape(prev_states[0], (n_envs, -1)),
+                            "prev_cx": jnp.reshape(prev_states[1], (n_envs, -1)),
+                            "prev_actions": jnp.reshape(jnp.asarray(prev_actions), (n_envs, -1)),
+                        }
+                    )
+                    rb.add_env(
+                        {
+                            "rewards": rewards,
+                            "dones": dones,
+                            **{k: next_obs[k] for k in obs_keys},
+                        }
+                    )
+                    # prev action feedback stays device-side (dones ride up with the
+                    # packed env put's sibling transfer; small and async)
+                    prev_actions = jnp.asarray(1.0 - dones, dtype=jnp.float32) * jnp.reshape(
+                        cat_actions, (n_envs, -1)
+                    )
+                else:
+                    step_data["dones"] = dones[np.newaxis]
+                    step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
+                    step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
+                    step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
+                    step_data["rewards"] = rewards[np.newaxis]
+                    step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
+                    step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
+                    step_data["prev_actions"] = np.asarray(prev_actions).reshape(1, n_envs, -1)
+                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                    prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
 
-            next_obs = {}
-            for k in obs_keys:
-                _obs = obs[k]
-                if k in cnn_keys:
-                    _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                step_data[k] = _obs[np.newaxis]
-                next_obs[k] = _obs
+                # reset recurrent state on done (reference :356-371)
+                if cfg.algo.reset_recurrent_state_on_done:
+                    not_done = jnp.asarray(1.0 - dones, dtype=jnp.float32)
+                    prev_states = tuple(not_done * s for s in states)
+                else:
+                    prev_states = states
+
+                next_obs = {}
+                for k in obs_keys:
+                    _obs = obs[k]
+                    if k in cnn_keys:
+                        _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                    step_data[k] = _obs[np.newaxis]
+                    next_obs[k] = _obs
+
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+
+            # device path: ONE bulk de-layout pull feeds the host-side episode
+            # chunking (variable-length episode splitting is inherently host work)
+            local_data = rb.rollout_host() if device_rollout else rb.to_arrays(dtype=np.float32)
+            with timer("Time/train_time", SumMetric()):
+                jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                jax_obs = {k: v[None] for k, v in jax_obs.items()}
+                next_values = np.asarray(
+                    player.get_values(
+                        jax_obs,
+                        jax.device_put(np.asarray(prev_actions)[None], runtime.player_device),
+                        prev_states,
+                    )[0]
+                )
+                returns, advantages = gae(
+                    jnp.asarray(local_data["rewards"]),
+                    jnp.asarray(local_data["values"]),
+                    jnp.asarray(local_data["dones"]),
+                    next_values,
+                    cfg.algo.rollout_steps,
+                    cfg.algo.gamma,
+                    cfg.algo.gae_lambda,
+                )
+                local_data["returns"] = np.asarray(returns, dtype=np.float32)
+                local_data["advantages"] = np.asarray(advantages, dtype=np.float32)
+                padded = _chunk_and_pad(
+                    local_data, local_data["dones"], cfg.algo.per_rank_sequence_length, n_envs
+                )
+                device_data = {k: jnp.asarray(v) for k, v in padded.items()}
+                rng, train_key = jax.random.split(rng)
+                params, opt_state, flat_params, train_metrics = train_fn(
+                    params,
+                    opt_state,
+                    device_data,
+                    train_key,
+                    jnp.float32(cfg.algo.clip_coef),
+                    jnp.float32(cfg.algo.ent_coef),
+                )
+                player.params = params_sync.pull(flat_params, runtime.player_device)
+                if not timer.disabled:
+                    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+            train_step += world_size
 
             if cfg.metric.log_level > 0:
-                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                    if aggregator and "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if aggregator and "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+                if aggregator:
+                    aggregator.update_from_device(train_metrics)
+                if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    if aggregator and not aggregator.disabled:
+                        logger.log_metrics(aggregator.compute(), policy_step)
+                        aggregator.reset()
+                    if not timer.disabled:
+                        timer_metrics = timer.compute()
+                        if timer_metrics.get("Time/train_time", 0) > 0:
+                            logger.log_metrics(
+                                {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
+                                policy_step,
+                            )
+                        if timer_metrics.get("Time/env_interaction_time", 0) > 0:
+                            logger.log_metrics(
+                                {
+                                    "Time/sps_env_interaction": (
+                                        (policy_step - last_log) / world_size * cfg.env.action_repeat
+                                    )
+                                    / timer_metrics["Time/env_interaction_time"]
+                                },
+                                policy_step,
+                            )
+                        timer.reset()
+                    last_log = policy_step
+                    last_train = train_step
 
-        # device path: ONE bulk de-layout pull feeds the host-side episode
-        # chunking (variable-length episode splitting is inherently host work)
-        local_data = rb.rollout_host() if device_rollout else rb.to_arrays(dtype=np.float32)
-        with timer("Time/train_time", SumMetric()):
-            jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-            jax_obs = {k: v[None] for k, v in jax_obs.items()}
-            next_values = np.asarray(
-                player.get_values(
-                    jax_obs,
-                    jax.device_put(np.asarray(prev_actions)[None], runtime.player_device),
-                    prev_states,
-                )[0]
-            )
-            returns, advantages = gae(
-                jnp.asarray(local_data["rewards"]),
-                jnp.asarray(local_data["values"]),
-                jnp.asarray(local_data["dones"]),
-                next_values,
-                cfg.algo.rollout_steps,
-                cfg.algo.gamma,
-                cfg.algo.gae_lambda,
-            )
-            local_data["returns"] = np.asarray(returns, dtype=np.float32)
-            local_data["advantages"] = np.asarray(advantages, dtype=np.float32)
-            padded = _chunk_and_pad(
-                local_data, local_data["dones"], cfg.algo.per_rank_sequence_length, n_envs
-            )
-            device_data = {k: jnp.asarray(v) for k, v in padded.items()}
-            rng, train_key = jax.random.split(rng)
-            params, opt_state, flat_params, train_metrics = train_fn(
-                params,
-                opt_state,
-                device_data,
-                train_key,
-                jnp.float32(cfg.algo.clip_coef),
-                jnp.float32(cfg.algo.ent_coef),
-            )
-            player.params = params_sync.pull(flat_params, runtime.player_device)
-            if not timer.disabled:
-                jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
-        train_step += world_size
+            if cfg.algo.anneal_clip_coef:
+                cfg.algo.clip_coef = polynomial_decay(
+                    iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
+            if cfg.algo.anneal_ent_coef:
+                cfg.algo.ent_coef = polynomial_decay(
+                    iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
+                )
 
-        if cfg.metric.log_level > 0:
-            if aggregator:
-                aggregator.update_from_device(train_metrics)
-            if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
-                if aggregator and not aggregator.disabled:
-                    logger.log_metrics(aggregator.compute(), policy_step)
-                    aggregator.reset()
-                if not timer.disabled:
-                    timer_metrics = timer.compute()
-                    if timer_metrics.get("Time/train_time", 0) > 0:
-                        logger.log_metrics(
-                            {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
-                            policy_step,
-                        )
-                    if timer_metrics.get("Time/env_interaction_time", 0) > 0:
-                        logger.log_metrics(
-                            {
-                                "Time/sps_env_interaction": (
-                                    (policy_step - last_log) / world_size * cfg.env.action_repeat
-                                )
-                                / timer_metrics["Time/env_interaction_time"]
-                            },
-                            policy_step,
-                        )
-                    timer.reset()
-                last_log = policy_step
-                last_train = train_step
+            resilience.enforce_nonfinite_policy(ft, train_metrics)
+            resilience.drain_env_counters(envs, aggregator)
 
-        if cfg.algo.anneal_clip_coef:
-            cfg.algo.clip_coef = polynomial_decay(
-                iter_num, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
-        if cfg.algo.anneal_ent_coef:
-            cfg.algo.ent_coef = polynomial_decay(
-                iter_num, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters, power=1.0
-            )
+            if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
+                iter_num == total_iters and cfg.checkpoint.save_last
+            ):
+                last_checkpoint = policy_step
+                ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
 
-        if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
-            iter_num == total_iters and cfg.checkpoint.save_last
-        ):
-            last_checkpoint = policy_step
-            ckpt_state = {
-                "agent": jax.device_get(params),
-                "optimizer": jax.device_get(opt_state),
-                "iter_num": iter_num * world_size,
-                "batch_size": -1,
-                "last_log": last_log,
-                "last_checkpoint": last_checkpoint,
-            }
-            ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-            runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=ckpt_state)
+            guard.completed_iteration()
+            if guard.should_stop:
+                if last_checkpoint != policy_step:  # periodic save above already covered this step
+                    last_checkpoint = policy_step
+                    ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
+                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.print(
+                    f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
+                    "checkpoint saved, exiting cleanly for resume."
+                )
+                break
 
     profiler.close()
     envs.close()

@@ -16,6 +16,9 @@ AGGREGATOR_KEYS = {
     "Loss/value_loss",
     "Loss/policy_loss",
     "Loss/entropy_loss",
+    "Resilience/env_restarts",
+    "Resilience/env_timeouts",
+    "Resilience/nonfinite_skips",
 }
 MODELS_TO_REGISTER = {"agent"}
 

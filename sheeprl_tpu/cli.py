@@ -96,6 +96,11 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     merged.root_dir = cfg.root_dir
     merged.seed = cfg.seed
     merged.fabric = cfg.fabric
+    # Fault-tolerance knobs describe the RESUMING environment (deadlines,
+    # restart budgets, a test run's stop_after_iters), not the experiment
+    # identity — always take the new invocation's values over the sidecar's.
+    if cfg.get("fault_tolerance") is not None:
+        merged.fault_tolerance = cfg.fault_tolerance
     return merged
 
 

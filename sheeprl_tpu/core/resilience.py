@@ -1,0 +1,465 @@
+"""Fault-tolerant training runtime: preemption, env-worker, and NaN guards.
+
+TPU fleets fail in three characteristic ways, and each gets a dedicated layer
+here, wired through every training loop:
+
+- **Preemption** (spot/queued TPU VMs receive SIGTERM): :class:`PreemptionGuard`
+  converts the signal into an end-of-iteration flag; the loop writes an
+  emergency checkpoint through the normal ``CheckpointCallback`` path and exits
+  cleanly, so the rescheduled run resumes bit-identically.
+- **Env workers crash or hang**: :class:`WorkerSupervisor` (per-env, survives
+  in ``AsyncVectorEnv`` subprocesses) restarts a crashed env from its thunk
+  with bounded exponential backoff; :class:`SupervisedVectorEnv` (parent-side)
+  additionally catches the per-step deadline of a WEDGED worker
+  (``utils/env.py:vectorized_env(step_timeout=...)``) and rebuilds the vector
+  env. Both truncate the affected episode and export restart/timeout counters
+  through ``utils/metric.py``.
+- **Non-finite updates** (a long ``jit`` step diverges to NaN/inf):
+  :func:`finite_or_skip` is an IN-GRAPH guard — loss/grad-global-norm
+  ``isfinite`` selects between the updated and the previous (params, opt_state)
+  without a host sync; policy ``skip_update`` counts the skip, ``halt`` raises
+  host-side.
+
+Config lives in the ``fault_tolerance`` group; every read goes through
+:func:`resolve` so checkpoints written before this subsystem existed (whose
+sidecar configs lack the group) still resume.
+
+Worker-side note: :class:`WorkerSupervisor` is (cloud)pickled into vector-env
+worker processes — keep module-level imports free of jax.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+
+# Env var naming a file the guard touches once its handlers are LIVE; the chaos
+# harness (scripts/chaos_smoke.py) polls it so its SIGTERM lands mid-iteration
+# instead of racing process startup.
+READY_FILE_ENV_VAR = "SHEEPRL_PREEMPTION_READY_FILE"
+
+_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    "preemption": {"enabled": True, "stop_after_iters": None},
+    "nonfinite": {"policy": "skip_update"},
+    "env_supervision": {
+        "enabled": True,
+        "step_timeout_s": None,
+        "max_restarts": 3,
+        "backoff_base_s": 0.5,
+        "backoff_max_s": 30.0,
+    },
+    "transport": {
+        "op_timeout_ms": None,
+        "retries": 2,
+        "backoff_base_s": 1.0,
+        "backoff_max_s": 30.0,
+    },
+}
+
+
+class _View:
+    """Attribute view over a plain dict (so loops read ``ft.nonfinite.policy``)."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self._d = d
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            v = self._d[name]
+        except KeyError:
+            raise AttributeError(name) from None
+        return _View(v) if isinstance(v, dict) else v
+
+
+def resolve(cfg: Any) -> _View:
+    """Defaults-filled view of ``cfg.fault_tolerance``.
+
+    Tolerates a MISSING group entirely: ``resume_from_checkpoint`` merges the
+    old run's sidecar config wholesale, and runs recorded before this subsystem
+    existed have no ``fault_tolerance`` section.
+    """
+    try:
+        group = cfg.get("fault_tolerance") if hasattr(cfg, "get") else None
+    except Exception:
+        group = None
+    merged: Dict[str, Any] = {}
+    for section, defaults in _DEFAULTS.items():
+        got = None
+        if group is not None:
+            got = group.get(section) if hasattr(group, "get") else getattr(group, section, None)
+        merged[section] = dict(defaults)
+        if got is not None:
+            for k in defaults:
+                v = got.get(k, defaults[k]) if hasattr(got, "get") else getattr(got, k, defaults[k])
+                merged[section][k] = v
+    return _View(merged)
+
+
+class NonFiniteUpdateError(RuntimeError):
+    """Raised under ``fault_tolerance.nonfinite.policy=halt`` when a train step
+    produced a non-finite loss or gradient norm."""
+
+
+class WorkerSupervisionError(RuntimeError):
+    """An env worker kept failing past ``max_restarts``: the fault is
+    persistent (bad ROM path, OOM loop, poisoned seed), not transient."""
+
+
+# --------------------------------------------------------------------------- #
+# Preemption
+# --------------------------------------------------------------------------- #
+
+
+class PreemptionGuard:
+    """Convert SIGTERM/SIGINT into a clean end-of-iteration stop.
+
+    Usage::
+
+        with PreemptionGuard(enabled=ft.preemption.enabled,
+                             stop_after_iters=ft.preemption.stop_after_iters) as guard:
+            for iter_num in ...:
+                ...
+                guard.completed_iteration()
+                if guard.should_stop:
+                    <emergency checkpoint>; break
+
+    ``stop_after_iters`` is the deterministic test knob: trip the guard after N
+    completed iterations exactly as if the signal had arrived, so resume tests
+    don't depend on delivery timing. Handlers are only installed in the main
+    thread (``signal.signal`` raises ValueError elsewhere) and the previous
+    handlers are restored on exit.
+    """
+
+    def __init__(self, enabled: bool = True, stop_after_iters: Optional[int] = None):
+        self._enabled = bool(enabled)
+        self._stop_after = int(stop_after_iters) if stop_after_iters else None
+        self._completed = 0
+        self._triggered = False
+        self._signum: Optional[int] = None
+        self._prev: Dict[int, Any] = {}
+
+    def _handle(self, signum, frame) -> None:  # signal-handler signature
+        self._triggered = True
+        self._signum = signum
+
+    def __enter__(self) -> "PreemptionGuard":
+        if self._enabled and threading.current_thread() is threading.main_thread():
+            for signum in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    self._prev[signum] = signal.signal(signum, self._handle)
+                except (ValueError, OSError):  # embedded interpreter / odd platform
+                    pass
+        ready = os.environ.get(READY_FILE_ENV_VAR)
+        if self._enabled and ready:
+            try:
+                with open(ready, "w") as f:
+                    f.write(str(os.getpid()))
+            except OSError:
+                pass
+        return self
+
+    def __exit__(self, *exc) -> None:
+        for signum, prev in self._prev.items():
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):
+                pass
+        self._prev.clear()
+
+    def completed_iteration(self) -> None:
+        self._completed += 1
+        if self._stop_after is not None and self._completed >= self._stop_after:
+            self._triggered = True
+
+    @property
+    def should_stop(self) -> bool:
+        return self._triggered
+
+    def stop_at_iteration_end(self) -> bool:
+        """Will the guard have tripped by the END of the current iteration?
+
+        Usable MID-iteration (before ``completed_iteration``), so a distributed
+        loop can broadcast the decision in-band and every process agrees on the
+        same final iteration."""
+        if self._triggered:
+            return True
+        return self._stop_after is not None and self._completed + 1 >= self._stop_after
+
+    @property
+    def signum(self) -> Optional[int]:
+        return self._signum
+
+    def describe(self) -> str:
+        if self._signum is not None:
+            return f"signal {signal.Signals(self._signum).name}"
+        return f"stop_after_iters={self._stop_after}"
+
+
+# --------------------------------------------------------------------------- #
+# Supervised env workers
+# --------------------------------------------------------------------------- #
+
+
+class WorkerSupervisor(gym.Wrapper):
+    """Per-env crash supervision: rebuild a crashed env from its thunk.
+
+    Lives INSIDE the vector env (so under ``AsyncVectorEnv`` it runs in the
+    worker subprocess and a crash never reaches the parent pipe). A crashed
+    ``step`` becomes a truncated transition whose obs is the rebuilt env's
+    reset obs; ``info`` carries ``worker_restarted=True`` (counted parent-side
+    by :class:`SupervisedVectorEnv`) and ``restart_on_exception=True`` (the key
+    dreamer_v3's buffer-patch logic already understands). Restarts are bounded:
+    past ``max_restarts`` the original exception is chained into a
+    :class:`WorkerSupervisionError`, because an env that keeps dying is a bug,
+    not weather.
+    """
+
+    def __init__(
+        self,
+        env_fn: Callable[[], gym.Env],
+        max_restarts: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+    ):
+        self._env_fn = env_fn
+        self._max_restarts = int(max_restarts)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_max_s = float(backoff_max_s)
+        self._restarts = 0
+        super().__init__(env_fn())
+
+    def _rebuild(self, err: BaseException) -> None:
+        self._restarts += 1
+        if self._restarts > self._max_restarts:
+            raise WorkerSupervisionError(
+                f"env worker failed {self._restarts} times, past max_restarts="
+                f"{self._max_restarts}; giving up. Last error: {type(err).__name__}: {err}"
+            ) from err
+        delay = min(self._backoff_base_s * (2 ** (self._restarts - 1)), self._backoff_max_s)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        self.env = self._env_fn()
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        try:
+            return self.env.reset(seed=seed, options=options)
+        except Exception as err:
+            self._rebuild(err)
+            return self.env.reset(seed=seed, options=options)
+
+    def step(self, action):
+        try:
+            return self.env.step(action)
+        except Exception as err:
+            self._rebuild(err)
+            obs, info = self.env.reset()
+            info = dict(info)
+            info["worker_restarted"] = True
+            info["restart_on_exception"] = True
+            # truncated (not terminated): the episode was cut by the fault, so
+            # value bootstrapping stays legal and GAE sees a clean boundary
+            return obs, 0.0, False, True, info
+
+
+class SupervisedVectorEnv:
+    """Vector env with parent-side hang supervision and restart accounting.
+
+    Crashes are already absorbed per-worker by :class:`WorkerSupervisor`; this
+    wrapper handles what only the parent can see — a WEDGED worker tripping the
+    async per-step deadline — by terminating and rebuilding the whole vector
+    env (the wedged subprocess cannot be revived individually), truncating
+    every in-flight episode. Restart/timeout counters accumulate in
+    ``self.counters`` and are drained into the metric aggregator by the
+    training loops (``drain_counters``).
+    """
+
+    _TIMEOUT_ERRORS: Tuple[type, ...]
+
+    def __init__(
+        self,
+        env_fns: List[Callable[[], gym.Env]],
+        sync: bool = True,
+        step_timeout_s: Optional[float] = None,
+        max_restarts: int = 3,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+    ):
+        import multiprocessing
+
+        from sheeprl_tpu.utils.env import vectorized_env
+
+        self._max_restarts = int(max_restarts)
+        self._backoff_base_s = float(backoff_base_s)
+        self._backoff_max_s = float(backoff_max_s)
+        supervised_fns = [
+            (lambda fn=fn: WorkerSupervisor(fn, max_restarts, backoff_base_s, backoff_max_s))
+            for fn in env_fns
+        ]
+        self._make = lambda: vectorized_env(supervised_fns, sync=sync, step_timeout=step_timeout_s)
+        self._TIMEOUT_ERRORS = (multiprocessing.TimeoutError, TimeoutError)
+        self._group_restarts = 0
+        self._last_reset_seed: Any = None
+        self.counters: Dict[str, int] = {"Resilience/env_restarts": 0, "Resilience/env_timeouts": 0}
+        self._drained: Dict[str, int] = dict.fromkeys(self.counters, 0)
+        self.venv = self._make()
+
+    def __getattr__(self, name: str) -> Any:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self.venv, name)
+
+    def reset(self, *, seed=None, options=None):
+        self._last_reset_seed = seed
+        return self.venv.reset(seed=seed, options=options)
+
+    def _count_worker_restarts(self, info: Dict[str, Any]) -> None:
+        # A restarted worker's step is always truncated, so under SAME_STEP
+        # autoreset its info (with worker_restarted) is folded into final_info
+        # while the top-level info is the reset's; count both containers.
+        for container in (info, info.get("final_info")):
+            if not isinstance(container, dict):
+                continue
+            flag = container.get("worker_restarted")
+            if flag is None:
+                continue
+            mask = container.get("_worker_restarted", flag)
+            self.counters["Resilience/env_restarts"] += int(np.sum(np.asarray(mask, dtype=bool)))
+
+    def step(self, actions):
+        try:
+            obs, rewards, terminated, truncated, info = self.venv.step(actions)
+        except self._TIMEOUT_ERRORS as err:
+            return self._recover_from_hang(err)
+        self._count_worker_restarts(info)
+        return obs, rewards, terminated, truncated, info
+
+    def _recover_from_hang(self, err: BaseException):
+        self.counters["Resilience/env_timeouts"] += 1
+        self._group_restarts += 1
+        if self._group_restarts > self._max_restarts:
+            raise WorkerSupervisionError(
+                f"vector env hit its step deadline {self._group_restarts} times, past "
+                f"max_restarts={self._max_restarts}; a worker is persistently wedged."
+            ) from err
+        delay = min(self._backoff_base_s * (2 ** (self._group_restarts - 1)), self._backoff_max_s)
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            # terminate=True SIGTERMs the wedged workers; a graceful close would
+            # block on the very pipe that just timed out
+            self.venv.close(terminate=True)
+        except Exception:
+            pass
+        self.venv = self._make()
+        obs, reset_info = self.venv.reset(seed=self._last_reset_seed)
+        n = int(self.venv.num_envs)
+        info = dict(reset_info)
+        info["vector_env_restarted"] = True
+        # every in-flight episode was cut: truncated, zero reward, no final_obs
+        # (loops then skip the truncation bootstrap for these envs)
+        return (
+            obs,
+            np.zeros(n, dtype=np.float32),
+            np.zeros(n, dtype=bool),
+            np.ones(n, dtype=bool),
+            info,
+        )
+
+    def drain_counters(self) -> Dict[str, int]:
+        """Counter DELTAS since the previous drain (aggregator-update friendly)."""
+        out = {}
+        for k, v in self.counters.items():
+            out[k] = v - self._drained[k]
+            self._drained[k] = v
+        return out
+
+    def close(self, **kwargs):
+        return self.venv.close(**kwargs)
+
+
+def make_supervised_env(
+    env_fns: List[Callable[[], gym.Env]], sync: bool, ft: Any
+):
+    """The vector env every training loop builds: supervised when
+    ``fault_tolerance.env_supervision.enabled``, plain otherwise."""
+    sup = ft.env_supervision
+    if not sup.enabled:
+        from sheeprl_tpu.utils.env import vectorized_env
+
+        return vectorized_env(env_fns, sync=sync, step_timeout=sup.step_timeout_s)
+    return SupervisedVectorEnv(
+        env_fns,
+        sync=sync,
+        step_timeout_s=sup.step_timeout_s,
+        max_restarts=sup.max_restarts,
+        backoff_base_s=sup.backoff_base_s,
+        backoff_max_s=sup.backoff_max_s,
+    )
+
+
+def drain_env_counters(envs: Any, aggregator: Any) -> None:
+    """Feed a SupervisedVectorEnv's restart/timeout counters to the aggregator
+    (no-op for plain vector envs or a disabled aggregator)."""
+    drain = getattr(envs, "drain_counters", None)
+    if drain is None or aggregator is None:
+        return
+    for k, v in drain().items():
+        if v and k in aggregator:
+            aggregator.update(k, v)
+
+
+# --------------------------------------------------------------------------- #
+# In-graph non-finite guard
+# --------------------------------------------------------------------------- #
+
+
+def guard_enabled(ft: Any) -> bool:
+    return ft.nonfinite.policy in ("skip_update", "halt")
+
+
+def finite_or_skip(checks: Tuple[Any, ...], new_state: Any, old_state: Any) -> Tuple[Any, Any]:
+    """In-graph guard: keep ``new_state`` iff every value in ``checks`` is
+    finite, else keep ``old_state``.
+
+    Returns ``(state, skipped)`` with ``skipped`` a float32 0/1 scalar the
+    caller accumulates into its metrics — NO host sync happens here, so the
+    guard costs one ``isfinite``-reduce plus an elementwise select inside the
+    already-jitted train step. Both policies use this same graph; ``halt`` is
+    enforced host-side from the exported skip counter.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    ok = jnp.asarray(True)
+    for c in checks:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(c)))
+    guarded = jax.tree_util.tree_map(lambda n, o: jnp.where(ok, n, o), new_state, old_state)
+    return guarded, 1.0 - ok.astype(jnp.float32)
+
+
+def enforce_nonfinite_policy(ft: Any, train_metrics: Dict[str, Any]) -> None:
+    """Host-side half of the ``halt`` policy: raise when the jitted step
+    reported any skipped (non-finite) update. Costs one device->host scalar
+    pull per iteration, and only under ``policy=halt``."""
+    if ft.nonfinite.policy != "halt":
+        return
+    skips = train_metrics.get("Resilience/nonfinite_skips")
+    if skips is None:
+        return
+    n = float(np.asarray(skips))
+    if n > 0:
+        raise NonFiniteUpdateError(
+            f"{n:g} update(s) this iteration produced a non-finite loss or gradient "
+            "norm and fault_tolerance.nonfinite.policy=halt. Inspect the run "
+            "(lr spike, reward scale, env NaN) or set policy=skip_update to ride through."
+        )

@@ -1,0 +1,105 @@
+"""ChaosEnv: deterministic fault injection for the resilience test suite.
+
+A ``gym.Wrapper`` that, on a fixed step schedule, (a) raises (worker crash),
+(b) sleeps (worker hang), or (c) poisons the observation/reward with NaN —
+the three production failure modes the fault-tolerant runtime
+(``core/resilience.py``) must survive. Schedules are STEP-INDEXED and
+deterministic so tests assert exact behavior instead of sampling flakiness.
+
+This module is imported inside ``AsyncVectorEnv`` worker processes; keep it
+free of jax imports (numpy + gymnasium only).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Iterable, Optional, Set
+
+import gymnasium as gym
+import numpy as np
+
+
+class ChaosCrashError(RuntimeError):
+    """The scheduled, injected worker crash (distinct from real env bugs)."""
+
+
+def _as_step_set(steps: Optional[Iterable[int]]) -> Set[int]:
+    return set(int(s) for s in steps) if steps else set()
+
+
+class ChaosEnv(gym.Wrapper):
+    """Inject crash/hang/NaN faults at scheduled global step counts.
+
+    The counter is cumulative across episodes (it survives ``reset``), so a
+    schedule addresses points in TRAINING time, matching how real faults land.
+    Each scheduled step fires at most once — a restarted worker rebuilt from
+    its thunk starts a fresh counter, so ``crash_at=[3]`` means "crash once,
+    at the 3rd step of each incarnation" for the restart tests.
+
+    ``nan_at`` poisons every float slot of the observation (and the reward),
+    which must flow through GAE into a non-finite loss for the in-graph guard
+    to catch.
+    """
+
+    def __init__(
+        self,
+        env: gym.Env,
+        crash_at: Optional[Iterable[int]] = None,
+        hang_at: Optional[Iterable[int]] = None,
+        hang_seconds: float = 30.0,
+        nan_at: Optional[Iterable[int]] = None,
+        crash_on_reset: bool = False,
+    ):
+        super().__init__(env)
+        self._crash_at = _as_step_set(crash_at)
+        self._hang_at = _as_step_set(hang_at)
+        self._nan_at = _as_step_set(nan_at)
+        self._hang_seconds = float(hang_seconds)
+        self._crash_on_reset = bool(crash_on_reset)
+        self._step_count = 0
+        self._fired: Set[int] = set()
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        if self._crash_on_reset and self._step_count > 0:
+            self._crash_on_reset = False  # once, so a supervised restart can succeed
+            raise ChaosCrashError("injected crash on reset")
+        return self.env.reset(seed=seed, options=options)
+
+    @staticmethod
+    def _poison(obs: Any) -> Any:
+        if isinstance(obs, dict):
+            return {k: ChaosEnv._poison(v) for k, v in obs.items()}
+        arr = np.asarray(obs)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return obs
+
+    def step(self, action):
+        self._step_count += 1
+        step = self._step_count
+        if step in self._crash_at and step not in self._fired:
+            self._fired.add(step)
+            raise ChaosCrashError(f"injected crash at step {step}")
+        if step in self._hang_at and step not in self._fired:
+            self._fired.add(step)
+            time.sleep(self._hang_seconds)
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        if step in self._nan_at:
+            obs = self._poison(obs)
+            reward = float("nan")
+        return obs, reward, terminated, truncated, info
+
+
+def chaos_dummy_env(id: str, chaos: Optional[dict] = None, **kwargs):
+    """Config-friendly factory: a dummy env wrapped in :class:`ChaosEnv`.
+
+    Meant as an ``env.wrapper._target_`` so CLI-driven chaos tests inject
+    faults without touching algorithm code, e.g.::
+
+        env.wrapper._target_=sheeprl_tpu.envs.chaos.chaos_dummy_env
+        env.wrapper.chaos.nan_at=[3]
+    """
+    from sheeprl_tpu.utils.env import get_dummy_env
+
+    chaos = dict(chaos or {})
+    return ChaosEnv(get_dummy_env(id, **kwargs), **chaos)

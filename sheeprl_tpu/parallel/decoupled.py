@@ -116,6 +116,14 @@ def split_runtime(runtime: Runtime) -> Tuple[Runtime, Runtime]:
     return player_rt, trainer_rt
 
 
+class TransportTimeoutError(RuntimeError):
+    """A CrossHostTransport KV operation exhausted its deadline + retries.
+
+    Raised instead of hanging forever when the peer that should have published
+    (or served) a key is dead/preempted — the message names the key, the scope,
+    and the deadline so the failing SIDE is diagnosable from one log line."""
+
+
 class CrossHostTransport:
     """Player-process <-> trainer-mesh bridge for multi-process decoupled runs.
 
@@ -137,6 +145,16 @@ class CrossHostTransport:
       reference's cross-process broadcast entirely.
     """
 
+    # Fault policy for the KV exchanges (configure_faults overrides from the
+    # fault_tolerance config group). op_timeout_ms=None keeps each call's own
+    # default — notably sync_payload_spec's day-long prefill allowance.
+    # Class-level so partially-constructed instances (unit tests build the
+    # transport via __new__ around a fake KV store) still get a valid policy.
+    op_timeout_ms: Optional[int] = None
+    op_retries: int = 0
+    op_backoff_base_s: float = 1.0
+    op_backoff_max_s: float = 30.0
+
     def __init__(self, trainer_mesh: Mesh, player_device: Any):
         self.trainer_mesh = trainer_mesh
         self.player_device = player_device
@@ -144,6 +162,63 @@ class CrossHostTransport:
         self._specs: Dict[str, Dict[str, Tuple[Tuple[int, ...], str]]] = {}
         self._zero_payloads: Dict[str, Dict[str, np.ndarray]] = {}
         self._scope = ""
+
+    def configure_faults(
+        self,
+        op_timeout_ms: Optional[int] = None,
+        retries: int = 0,
+        backoff_base_s: float = 1.0,
+        backoff_max_s: float = 30.0,
+    ) -> None:
+        """Set the deadline + retry/backoff policy for every KV operation, so a
+        dead peer produces a diagnostic :class:`TransportTimeoutError` after a
+        bounded wait instead of an unexplained multi-hour hang."""
+        self.op_timeout_ms = op_timeout_ms
+        self.op_retries = int(retries)
+        self.op_backoff_base_s = float(backoff_base_s)
+        self.op_backoff_max_s = float(backoff_max_s)
+
+    def _op_timeout(self, default_ms: int, override_ms: Optional[int]) -> int:
+        if override_ms is not None:
+            return int(override_ms)
+        if self.op_timeout_ms is not None:
+            return int(self.op_timeout_ms)
+        return int(default_ms)
+
+    def _kv_retry(self, op, describe: str):
+        """Run a KV op under the retry/backoff policy; exhaustion raises a
+        :class:`TransportTimeoutError` naming the peer that failed to respond."""
+        import time
+
+        attempts = self.op_retries + 1
+        last: Optional[Exception] = None
+        for attempt in range(attempts):
+            try:
+                return op()
+            except Exception as e:  # the coordinator surfaces deadline as XlaRuntimeError
+                last = e
+                if attempt + 1 < attempts:
+                    time.sleep(min(self.op_backoff_base_s * (2**attempt), self.op_backoff_max_s))
+        raise TransportTimeoutError(
+            f"CrossHostTransport {describe} failed after {attempts} attempt(s) "
+            f"(process {jax.process_index()}, scope '{self._scope or 'unscoped'}'): the peer that "
+            "should have served it is likely dead, preempted, or wedged before its publish point. "
+            f"Last error: {type(last).__name__}: {last}"
+        ) from last
+
+    def _kv_set(self, key: str, value: str) -> None:
+        client = _kv_client()
+        self._kv_retry(
+            lambda: client.key_value_set(key, value, allow_overwrite=True),
+            describe=f"KV set of '{key}'",
+        )
+
+    def _kv_get(self, key: str, timeout_ms: int) -> str:
+        client = _kv_client()
+        return self._kv_retry(
+            lambda: client.blocking_key_value_get(key, timeout_ms),
+            describe=f"KV get of '{key}' (deadline {timeout_ms} ms/attempt)",
+        )
 
     def set_scope(self, scope: str) -> None:
         """Namespace the KV exchange to this run.
@@ -164,7 +239,34 @@ class CrossHostTransport:
         scope = hashlib.sha1(self._scope.encode()).hexdigest()[:12] if self._scope else "unscoped"
         return f"sheeprl_tpu/decoupled/{scope}/{tag}"
 
-    def verify_resume_digest(self, ckpt_path: str, timeout_ms: int = 600_000) -> None:
+    @staticmethod
+    def _stale_side(local_mtime: Optional[float], player_mtime: Optional[float]) -> str:
+        """Which SIDE holds the stale checkpoint copy, from file mtimes.
+
+        The digests only prove the copies differ; the mtimes say who is behind.
+        Kept as a pure helper so the attribution logic is unit-testable without
+        a multi-process world."""
+        if local_mtime is None or player_mtime is None:
+            return (
+                "stale side unknown (checkpoint mtime unavailable on one side); "
+                "compare the files' timestamps manually"
+            )
+        if local_mtime < player_mtime:
+            return (
+                f"this TRAINER process holds the STALE copy (local mtime {local_mtime:.0f} "
+                f"< player mtime {player_mtime:.0f}); refresh this host's checkpoint from the player's"
+            )
+        if local_mtime > player_mtime:
+            return (
+                f"the PLAYER (process 0) holds the STALE copy (player mtime {player_mtime:.0f} "
+                f"< local mtime {local_mtime:.0f}); refresh the player host's checkpoint"
+            )
+        return (
+            "both copies carry the same mtime yet different contents (divergent writes); "
+            "re-copy the checkpoint to every host from one source"
+        )
+
+    def verify_resume_digest(self, ckpt_path: str, timeout_ms: Optional[int] = None) -> None:
         """Fail fast when processes resume from DIFFERENT copies of a checkpoint.
 
         Every process calls ``load_state(resume_from)`` against its own
@@ -181,19 +283,32 @@ class CrossHostTransport:
             return
         key = self._scope_key("resume_digest")
         local = _ckpt_digest(ckpt_path)
+        try:
+            local_mtime: Optional[float] = os.path.getmtime(ckpt_path)
+        except OSError:
+            local_mtime = None
+        deadline = self._op_timeout(600_000, timeout_ms)
         if self.is_player_process:
-            client.key_value_set(key, local, allow_overwrite=True)
+            # digest|mtime: the mtime lets a mismatching trainer attribute the
+            # stale side instead of just reporting that the copies differ
+            self._kv_set(key, f"{local}|{'' if local_mtime is None else local_mtime!r}")
         else:
-            published = client.blocking_key_value_get(key, timeout_ms)
-            if published != local:
+            published = self._kv_get(key, deadline)
+            pub_digest, _, pub_mtime_s = published.partition("|")
+            try:
+                player_mtime: Optional[float] = float(pub_mtime_s) if pub_mtime_s else None
+            except ValueError:
+                player_mtime = None
+            if pub_digest != local:
                 raise RuntimeError(
                     f"Resume checkpoint mismatch: this process's copy of '{ckpt_path}' "
-                    f"(digest {local}) differs from process 0's (digest {published}). "
+                    f"(digest {local}) differs from the player's — process 0 — "
+                    f"(digest {pub_digest}). {self._stale_side(local_mtime, player_mtime)}. "
                     "All processes must resume from the same checkpoint file."
                 )
 
     def sync_payload_spec(
-        self, tag: str, flat: Optional[Dict[str, Any]] = None, timeout_ms: int = 86_400_000
+        self, tag: str, flat: Optional[Dict[str, Any]] = None, timeout_ms: Optional[int] = None
     ) -> Dict[str, Tuple[Tuple[int, ...], str]]:
         """One-time shape/dtype exchange for a flat ``{name: array}`` payload.
 
@@ -234,11 +349,9 @@ class CrossHostTransport:
                 name: (tuple(int(d) for d in np.shape(v)), str(np.asarray(v).dtype))
                 for name, v in flat.items()
             }
-            client.key_value_set(
-                key, json.dumps({n: [list(s), d] for n, (s, d) in spec.items()}), allow_overwrite=True
-            )
+            self._kv_set(key, json.dumps({n: [list(s), d] for n, (s, d) in spec.items()}))
         else:
-            raw = json.loads(client.blocking_key_value_get(key, timeout_ms))
+            raw = json.loads(self._kv_get(key, self._op_timeout(86_400_000, timeout_ms)))
             spec = {n: (tuple(s), d) for n, (s, d) in raw.items()}
         self._specs[tag] = spec
         return spec

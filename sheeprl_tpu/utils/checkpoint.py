@@ -80,10 +80,31 @@ class _CrcReader:
         return b
 
 
+def _fsync_dir(dirname: str) -> None:
+    """fsync a directory so a rename within it survives power loss (no-op where
+    directories can't be opened, e.g. some network filesystems / Windows)."""
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
 def save_state(path: str, state: Dict[str, Any]) -> None:
     """Layout: header pickle (magic/version/manifest), state pickle (streamed
-    through a CRC), footer pickle ({"crc32": ...})."""
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    through a CRC), footer pickle ({"crc32": ...}).
+
+    Durability: the temp file is fsync'd (and the directory before AND after
+    the ``os.replace``) so a preemption/power cut at any instant leaves either
+    the old checkpoint or the complete new one — never a torn file under the
+    final name."""
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
     host_state = _to_host(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -96,7 +117,11 @@ def save_state(path: str, state: Dict[str, Any]) -> None:
         writer = _CrcWriter(f)
         pickle.dump(host_state, writer, protocol=pickle.HIGHEST_PROTOCOL)
         pickle.dump({"crc32": writer.crc}, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_dir(parent)
     os.replace(tmp, path)
+    _fsync_dir(parent)
 
 
 def _v1_header_at_head(head: bytes) -> bool:
@@ -179,7 +204,14 @@ def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]
     return None
 
 
-def load_state(path: str) -> Dict[str, Any]:
+class CheckpointCorruptionError(RuntimeError):
+    """The file under this path exists but fails an integrity check (truncated
+    write, bit rot, CRC/footer mismatch, manifest drift). Distinct from
+    RuntimeError so ``load_state`` can fall back to an older sibling on
+    corruption but never on e.g. a format_version from a newer build."""
+
+
+def _load_state_file(path: str) -> Dict[str, Any]:
     try:
         with open(path, "rb") as f:
             obj = pickle.load(f)
@@ -205,12 +237,12 @@ def load_state(path: str) -> Dict[str, Any]:
         # UnpicklingError, EOFError, bad-opcode ModuleNotFoundError/AttributeError,
         # struct.error, MemoryError from a corrupted frame length — so the whole
         # parse is the corruption boundary, not an enumerable exception list.
-        raise RuntimeError(
+        raise CheckpointCorruptionError(
             f"Checkpoint '{path}' is unreadable (truncated, corrupt, or not a checkpoint): "
             f"{type(e).__name__}: {e}"
         ) from e
     if reader.crc != footer.get("crc32"):
-        raise RuntimeError(
+        raise CheckpointCorruptionError(
             f"Checkpoint '{path}' failed its integrity check (CRC mismatch): the file "
             "is corrupt (truncated copy, bit rot, or a partial write)."
         )
@@ -221,12 +253,61 @@ def load_state(path: str) -> Dict[str, Any]:
             diff = sorted(set(stored) ^ set(actual))[:5] or [
                 k for k in sorted(stored) if stored[k] != actual.get(k)
             ][:5]
-            raise RuntimeError(
+            raise CheckpointCorruptionError(
                 f"Checkpoint '{path}' state does not match its manifest "
                 f"(first differing leaves: {diff}); refusing to resume from an "
                 "inconsistent checkpoint."
             )
     return state
+
+
+def _older_sibling_ckpts(path: str) -> List[str]:
+    """Sibling ``*.ckpt`` files older than ``path``, newest first."""
+    ckpt_dir = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        own_mtime: Optional[float] = os.path.getmtime(path)
+    except OSError:
+        own_mtime = None
+    out: List[Tuple[float, str]] = []
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    for name in names:
+        cand = os.path.join(ckpt_dir, name)
+        if not name.endswith(".ckpt") or os.path.abspath(cand) == os.path.abspath(path):
+            continue
+        try:
+            mtime = os.path.getmtime(cand)
+        except OSError:
+            continue
+        if own_mtime is None or mtime < own_mtime:
+            out.append((mtime, cand))
+    return [p for _, p in sorted(out, reverse=True)]
+
+
+def load_state(path: str, fallback_to_older: bool = True) -> Dict[str, Any]:
+    """Load a checkpoint; on corruption (CRC/footer/manifest failure) fall back
+    to the newest OLDER ``*.ckpt`` in the same directory before giving up, so a
+    write torn by preemption costs one checkpoint interval instead of the run."""
+    try:
+        return _load_state_file(path)
+    except CheckpointCorruptionError as primary:
+        if not fallback_to_older:
+            raise
+        for cand in _older_sibling_ckpts(path):
+            try:
+                state = _load_state_file(cand)
+            except (RuntimeError, OSError):
+                continue
+            import warnings
+
+            warnings.warn(
+                f"Checkpoint '{path}' is corrupt ({primary}); resumed from the newest "
+                f"older sibling '{cand}' instead."
+            )
+            return state
+        raise
 
 
 class CheckpointCallback:

@@ -180,13 +180,37 @@ def make_env(
     return thunk
 
 
-def vectorized_env(env_fns: List[Callable[[], gym.Env]], sync: bool = True):
-    """SAME_STEP autoreset vector env (matches the reference's rollout semantics)."""
+def vectorized_env(
+    env_fns: List[Callable[[], gym.Env]], sync: bool = True, step_timeout: Optional[float] = None
+):
+    """SAME_STEP autoreset vector env (matches the reference's rollout semantics).
+
+    ``step_timeout`` (async path only): per-``step`` deadline in seconds. A
+    wedged worker then raises ``multiprocessing.TimeoutError`` from ``step`` —
+    catchable by a supervisor (core/resilience.py) — instead of blocking the
+    whole training loop forever. ``None`` keeps gymnasium's unbounded wait.
+    """
     from gymnasium.vector import AsyncVectorEnv, AutoresetMode, SyncVectorEnv
 
     if sync or len(env_fns) == 1:
         return SyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
-    return AsyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
+    if step_timeout is None:
+        return AsyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
+
+    class _DeadlineAsyncVectorEnv(AsyncVectorEnv):
+        """AsyncVectorEnv whose step/reset waits default to a finite deadline."""
+
+        _default_timeout = float(step_timeout)
+
+        def step_wait(self, timeout=None):
+            return super().step_wait(self._default_timeout if timeout is None else timeout)
+
+        def reset_wait(self, *args, timeout=None, **kwargs):
+            return super().reset_wait(
+                *args, timeout=self._default_timeout if timeout is None else timeout, **kwargs
+            )
+
+    return _DeadlineAsyncVectorEnv(env_fns, autoreset_mode=AutoresetMode.SAME_STEP)
 
 
 def get_dummy_env(id: str, **kwargs):

@@ -1,0 +1,173 @@
+"""End-to-end chaos suite: training survives injected env crashes, detects
+injected NaNs per the configured policy, and a preempted run resumes
+BIT-IDENTICALLY to an uninterrupted one (the ISSUE acceptance trio)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core.resilience import NonFiniteUpdateError, WorkerSupervisionError
+
+CHAOS_WRAPPER = "env.wrapper._target_=sheeprl_tpu.envs.chaos.chaos_dummy_env"
+
+
+def _tiny_ppo(total_steps=16, rollout_steps=4, num_envs=1):
+    return [
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        f"env.num_envs={num_envs}",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.devices=1",
+        "metric.log_level=0",
+        f"algo.rollout_steps={rollout_steps}",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        f"algo.total_steps={total_steps}",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.run_test=False",
+        "buffer.memmap=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+    ]
+
+
+def _find_ckpts(root):
+    found = []
+    for base, _, files in os.walk(root):
+        found += [os.path.join(base, f) for f in files if f.endswith(".ckpt")]
+    return sorted(found)
+
+
+@pytest.mark.timeout(600)
+def test_chaos_crash_worker_restarted_run_completes(tmp_path, monkeypatch):
+    """crash_at=[3] crashes EVERY env incarnation at its 3rd step (the counter
+    restarts with the rebuilt worker), so a 16-step run rides through ~5
+    restarts — within the raised budget the run must simply complete."""
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=_tiny_ppo()
+        + [
+            CHAOS_WRAPPER,
+            "env.wrapper.chaos.crash_at=[3]",
+            "fault_tolerance.env_supervision.max_restarts=8",
+            "fault_tolerance.env_supervision.backoff_base_s=0.0",
+        ]
+    )
+
+
+@pytest.mark.timeout(600)
+def test_chaos_crash_past_max_restarts_raises(tmp_path, monkeypatch):
+    """An env that dies on EVERY incarnation's first step is a bug, not
+    weather: the original fault must resurface once the budget is spent."""
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(WorkerSupervisionError, match="max_restarts"):
+        run(
+            overrides=_tiny_ppo()
+            + [
+                CHAOS_WRAPPER,
+                "env.wrapper.chaos.crash_at=[1]",
+                "fault_tolerance.env_supervision.max_restarts=1",
+                "fault_tolerance.env_supervision.backoff_base_s=0.0",
+            ]
+        )
+
+
+@pytest.mark.timeout(600)
+def test_chaos_nan_halt_raises(tmp_path, monkeypatch):
+    """An injected NaN reward flows through GAE into a non-finite loss; under
+    policy=halt the exported skip counter (>0) must raise host-side — this is
+    also the assertion that the in-graph guard actually FIRED."""
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(NonFiniteUpdateError, match="non-finite"):
+        run(
+            overrides=_tiny_ppo()
+            + [
+                CHAOS_WRAPPER,
+                "env.wrapper.chaos.nan_at=[2]",
+                "fault_tolerance.nonfinite.policy=halt",
+            ]
+        )
+
+
+@pytest.mark.timeout(600)
+def test_chaos_nan_skip_update_rides_through(tmp_path, monkeypatch):
+    """Same injection, policy=skip_update: the poisoned update is dropped
+    in-graph (params keep their previous finite values) and the run completes."""
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=_tiny_ppo()
+        + [
+            CHAOS_WRAPPER,
+            "env.wrapper.chaos.nan_at=[2]",
+            "fault_tolerance.nonfinite.policy=skip_update",
+            "checkpoint.save_last=True",
+        ]
+    )
+    ckpts = _find_ckpts(tmp_path / "logs")
+    assert ckpts, "run did not finish and checkpoint"
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    import jax
+
+    params = load_state(ckpts[-1])["agent"]
+    for leaf in jax.tree_util.tree_leaves(params):
+        assert np.isfinite(np.asarray(leaf)).all(), "NaN leaked into the params"
+
+
+@pytest.mark.timeout(600)
+def test_preemption_resume_bit_identical(tmp_path, monkeypatch):
+    """The headline resilience property: SIGTERM'd-and-resumed == uninterrupted,
+    leaf for leaf, for params AND optimizer state.
+
+    Uses the deterministic stop_after_iters knob (same code path as the signal,
+    minus delivery timing). rollout_steps=5 aligns iteration boundaries with
+    the dummy env's 5-step episodes, so the env-side state is also identical
+    across the resume (env state is deliberately not checkpointed)."""
+    import jax
+
+    from sheeprl_tpu.utils.checkpoint import load_state
+
+    base = _tiny_ppo(total_steps=40, rollout_steps=5, num_envs=2)
+
+    run_a = tmp_path / "runA"
+    run_a.mkdir()
+    monkeypatch.chdir(run_a)
+    run(overrides=base + ["checkpoint.save_last=True"])
+    ckpts_a = _find_ckpts(run_a / "logs")
+    assert len(ckpts_a) == 1
+    final_a = ckpts_a[0]
+
+    run_b = tmp_path / "runB"
+    run_b.mkdir()
+    monkeypatch.chdir(run_b)
+    run(overrides=base + ["fault_tolerance.preemption.stop_after_iters=2"])
+    emergency = _find_ckpts(run_b / "logs")
+    assert len(emergency) == 1, f"expected exactly the emergency checkpoint, got {emergency}"
+    assert "ckpt_20_" in os.path.basename(emergency[0])  # mid-run, not the end
+
+    run(
+        overrides=base
+        + ["checkpoint.save_last=True", f"checkpoint.resume_from={os.path.abspath(emergency[0])}"]
+    )
+    finals_b = [
+        c
+        for c in _find_ckpts(run_b / "logs")
+        if os.path.basename(c) == os.path.basename(final_a)
+    ]
+    assert len(finals_b) == 1, "resumed run did not reach the same final step"
+
+    state_a, state_b = load_state(final_a), load_state(finals_b[0])
+    for key in ("agent", "optimizer"):
+        leaves_a, treedef_a = jax.tree_util.tree_flatten(state_a[key])
+        leaves_b, treedef_b = jax.tree_util.tree_flatten(state_b[key])
+        assert treedef_a == treedef_b
+        for la, lb in zip(leaves_a, leaves_b):
+            assert np.array_equal(np.asarray(la), np.asarray(lb)), (
+                f"{key} diverged after preemption+resume"
+            )

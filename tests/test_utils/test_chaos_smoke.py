@@ -1,0 +1,36 @@
+"""Satellite registration of scripts/chaos_smoke.py as a tier-1 test: a real
+SIGTERM delivered to `bench.py --smoke` mid-iteration must yield a clean exit,
+an emergency checkpoint, and a successful resume (full harness, fresh
+interpreters, real signal delivery — the one test that is not in-process)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.timeout(600)
+def test_chaos_smoke_sigterm_roundtrip(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "chaos_smoke.py"),
+            "--workdir",
+            str(tmp_path),
+            "--timeout",
+            "480",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-1500:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "chaos smoke OK" in out.stdout
+    # the harness's own assertions already ran; re-check the artifact exists
+    assert any(
+        f.endswith(".ckpt") for _, _, fs in os.walk(tmp_path / "logs") for f in fs
+    ), "no emergency checkpoint left on disk"

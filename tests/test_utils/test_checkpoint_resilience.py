@@ -1,0 +1,102 @@
+"""Durability half of the checkpoint layer: corruption fallback to an older
+sibling checkpoint and the CheckpointCallback keep_last garbage collection
+(in-flight ``.tmp`` writes must never count against the retention budget)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.checkpoint import (
+    CheckpointCallback,
+    CheckpointCorruptionError,
+    load_state,
+    save_state,
+)
+
+
+def _write_ckpt(path, iter_num, mtime):
+    save_state(str(path), {"iter_num": iter_num, "agent": np.full((3,), iter_num, np.float32)})
+    os.utime(path, (mtime, mtime))
+
+
+def _corrupt(path):
+    st = path.stat()
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF  # flip a byte inside the CRC-covered state pickle
+    path.write_bytes(bytes(raw))
+    os.utime(path, (st.st_atime, st.st_mtime))  # keep the sibling mtime ordering
+
+
+def test_fallback_to_newest_older_sibling(tmp_path):
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    _write_ckpt(tmp_path / "ckpt_20_0.ckpt", 20, 2000)
+    newest = tmp_path / "ckpt_30_0.ckpt"
+    _write_ckpt(newest, 30, 3000)
+    _corrupt(newest)
+    with pytest.warns(UserWarning, match="older sibling"):
+        state = load_state(str(newest))
+    # the NEWEST older sibling, not just any: one checkpoint interval lost
+    assert state["iter_num"] == 20
+
+
+def test_fallback_skips_corrupt_siblings(tmp_path):
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    mid = tmp_path / "ckpt_20_0.ckpt"
+    _write_ckpt(mid, 20, 2000)
+    newest = tmp_path / "ckpt_30_0.ckpt"
+    _write_ckpt(newest, 30, 3000)
+    _corrupt(newest)
+    _corrupt(mid)  # the first fallback candidate is ALSO torn
+    with pytest.warns(UserWarning, match="older sibling"):
+        state = load_state(str(newest))
+    assert state["iter_num"] == 10
+
+
+def test_fallback_ignores_newer_siblings_and_non_ckpt_files(tmp_path):
+    corrupt = tmp_path / "ckpt_10_0.ckpt"
+    _write_ckpt(corrupt, 10, 1000)
+    _corrupt(corrupt)
+    # a NEWER sibling is a different (later) run state — resuming from it would
+    # silently jump the run forward, so it must not be a fallback candidate
+    _write_ckpt(tmp_path / "ckpt_20_0.ckpt", 20, 2000)
+    (tmp_path / "notes.txt").write_text("not a checkpoint")
+    with pytest.raises(CheckpointCorruptionError):
+        load_state(str(corrupt))
+
+
+def test_fallback_can_be_disabled(tmp_path):
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    newest = tmp_path / "ckpt_20_0.ckpt"
+    _write_ckpt(newest, 20, 2000)
+    _corrupt(newest)
+    with pytest.raises(CheckpointCorruptionError, match="integrity|unreadable|corrupt"):
+        load_state(str(newest), fallback_to_older=False)
+
+
+def test_gc_keep_last_prunes_oldest_and_never_counts_tmp(tmp_path):
+    for i, mtime in [(1, 1000), (2, 2000), (3, 3000), (4, 4000)]:
+        p = tmp_path / f"ckpt_{i}_0.ckpt"
+        p.write_bytes(b"x")
+        os.utime(p, (mtime, mtime))
+    # an in-flight atomic write: must neither count toward keep_last nor be removed
+    tmp = tmp_path / "ckpt_5_0.ckpt.tmp"
+    tmp.write_bytes(b"partial")
+    os.utime(tmp, (500, 500))  # even as the oldest file in the dir
+
+    CheckpointCallback(keep_last=2)._gc(str(tmp_path))
+    assert sorted(os.listdir(tmp_path)) == [
+        "ckpt_3_0.ckpt",
+        "ckpt_4_0.ckpt",
+        "ckpt_5_0.ckpt.tmp",
+    ]
+
+
+def test_gc_disabled_keeps_everything(tmp_path):
+    for i in range(3):
+        (tmp_path / f"ckpt_{i}_0.ckpt").write_bytes(b"x")
+    CheckpointCallback(keep_last=None)._gc(str(tmp_path))
+    CheckpointCallback(keep_last=0)._gc(str(tmp_path))
+    assert len(list(tmp_path.glob("*.ckpt"))) == 3
+    # a vanished directory is a no-op, not a crash
+    CheckpointCallback(keep_last=2)._gc(str(tmp_path / "missing"))

@@ -72,8 +72,9 @@ def test_scope_isolates_runs(transport_pair):
     player.set_scope("logs/run_A")
     trainer.set_scope("logs/run_B")
     player.sync_payload_spec("roll", {"a": np.zeros((2,), np.float32)})
-    # different scope -> the stale run-A spec must NOT satisfy run B
-    with pytest.raises(TimeoutError):
+    # different scope -> the stale run-A spec must NOT satisfy run B; the
+    # exhausted deadline surfaces as the diagnostic transport error
+    with pytest.raises(decoupled_mod.TransportTimeoutError):
         trainer.sync_payload_spec("roll")
     trainer.set_scope("logs/run_A")
     assert trainer.sync_payload_spec("roll")["a"] == ((2,), "float32")
@@ -109,7 +110,7 @@ def test_resume_digest_scoped_per_run(transport_pair, tmp_path):
     player.set_scope("logs/runs/a/version_0")
     player.verify_resume_digest(str(ckpt))
     trainer.set_scope("logs/runs/a/version_1")  # different incarnation
-    with pytest.raises(TimeoutError):
+    with pytest.raises(decoupled_mod.TransportTimeoutError):
         trainer.verify_resume_digest(str(ckpt))
 
 

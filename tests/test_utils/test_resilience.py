@@ -1,0 +1,351 @@
+"""Unit layer of the fault-tolerant runtime (core/resilience.py): config
+resolution, the preemption guard, crash/hang env supervision, the in-graph
+non-finite guard, and the CrossHostTransport deadline/retry policy."""
+
+import os
+import signal
+import time
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.resilience import (
+    PreemptionGuard,
+    SupervisedVectorEnv,
+    WorkerSupervisionError,
+    WorkerSupervisor,
+)
+
+# --------------------------------------------------------------------------- #
+# Fixture envs (module-level so AsyncVectorEnv workers can rebuild them)
+# --------------------------------------------------------------------------- #
+
+
+class _FlakyEnv(gym.Env):
+    """Raises on the next `fail_box[0]` step calls; state lives OUTSIDE the
+    instance so a supervisor rebuild (fresh instance, same box) sees it."""
+
+    observation_space = gym.spaces.Box(-10, 10, (2,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self, fail_box):
+        self._fail_box = fail_box
+
+    def reset(self, *, seed=None, options=None):
+        return np.zeros(2, np.float32), {}
+
+    def step(self, action):
+        if self._fail_box[0] > 0:
+            self._fail_box[0] -= 1
+            raise RuntimeError("injected worker crash")
+        return np.ones(2, np.float32), 1.0, False, False, {}
+
+
+def _hanging_env_fn():
+    from sheeprl_tpu.envs.chaos import ChaosEnv
+
+    return ChaosEnv(_FlakyEnv([0]), hang_at=[2], hang_seconds=30.0)
+
+
+def _healthy_env_fn():
+    return _FlakyEnv([0])
+
+
+# --------------------------------------------------------------------------- #
+# resolve()
+# --------------------------------------------------------------------------- #
+
+
+def test_resolve_fills_defaults_when_group_missing():
+    """Sidecar configs written before the subsystem existed lack the group."""
+    ft = resilience.resolve({})
+    assert ft.preemption.enabled is True
+    assert ft.preemption.stop_after_iters is None
+    assert ft.nonfinite.policy == "skip_update"
+    assert ft.env_supervision.enabled is True
+    assert ft.env_supervision.max_restarts == 3
+    assert ft.transport.retries == 2
+
+
+def test_resolve_partial_override_keeps_other_defaults():
+    ft = resilience.resolve({"fault_tolerance": {"nonfinite": {"policy": "halt"}}})
+    assert ft.nonfinite.policy == "halt"
+    assert ft.env_supervision.enabled is True  # untouched section keeps defaults
+    ft = resilience.resolve(
+        {"fault_tolerance": {"env_supervision": {"max_restarts": 7}}}
+    )
+    assert ft.env_supervision.max_restarts == 7
+    assert ft.env_supervision.backoff_base_s == 0.5
+
+
+# --------------------------------------------------------------------------- #
+# PreemptionGuard
+# --------------------------------------------------------------------------- #
+
+
+def test_preemption_guard_stop_after_iters():
+    with PreemptionGuard(enabled=True, stop_after_iters=2) as guard:
+        assert not guard.should_stop
+        guard.completed_iteration()
+        assert not guard.should_stop
+        # mid-iteration 2: the in-band broadcast decision must already be True
+        assert guard.stop_at_iteration_end()
+        guard.completed_iteration()
+        assert guard.should_stop
+        assert "stop_after_iters=2" in guard.describe()
+
+
+def test_preemption_guard_real_sigterm_and_handler_restore():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=True) as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not guard.should_stop and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.should_stop
+        assert guard.signum == signal.SIGTERM
+        assert "SIGTERM" in guard.describe()
+        assert guard.stop_at_iteration_end()
+    assert signal.getsignal(signal.SIGTERM) is prev
+
+
+def test_preemption_guard_disabled_installs_no_handlers():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard(enabled=False) as guard:
+        assert signal.getsignal(signal.SIGTERM) is prev
+        assert not guard.should_stop
+
+
+def test_preemption_guard_touches_ready_file(tmp_path, monkeypatch):
+    """The chaos harness polls this file so its SIGTERM lands mid-iteration."""
+    ready = tmp_path / "guard_ready"
+    monkeypatch.setenv(resilience.READY_FILE_ENV_VAR, str(ready))
+    assert not ready.exists()
+    with PreemptionGuard(enabled=True):
+        assert ready.exists()
+        assert ready.read_text() == str(os.getpid())
+
+
+# --------------------------------------------------------------------------- #
+# WorkerSupervisor / SupervisedVectorEnv
+# --------------------------------------------------------------------------- #
+
+
+def test_worker_supervisor_restarts_crashed_env():
+    fails = [1]
+    sup = WorkerSupervisor(lambda: _FlakyEnv(fails), max_restarts=3, backoff_base_s=0.0)
+    sup.reset()
+    obs, reward, terminated, truncated, info = sup.step(0)
+    # the interrupted episode is TRUNCATED (bootstrap stays legal), zero reward
+    assert truncated and not terminated
+    assert reward == 0.0
+    assert info["worker_restarted"] is True
+    assert info["restart_on_exception"] is True  # dreamer_v3's buffer-patch key
+    obs, reward, terminated, truncated, info = sup.step(0)
+    assert not truncated and "worker_restarted" not in info
+
+
+def test_worker_supervisor_gives_up_past_max_restarts():
+    fails = [100]  # persistent fault, not weather
+    sup = WorkerSupervisor(lambda: _FlakyEnv(fails), max_restarts=2, backoff_base_s=0.0)
+    sup.reset()
+    with pytest.raises(WorkerSupervisionError, match="max_restarts=2"):
+        for _ in range(10):
+            sup.step(0)
+
+
+def test_supervised_vector_env_counts_restarts_and_drains_deltas():
+    fails = [1]
+    venv = SupervisedVectorEnv(
+        [lambda: _FlakyEnv(fails), lambda: _FlakyEnv([0])],
+        sync=True,
+        max_restarts=3,
+        backoff_base_s=0.0,
+    )
+    try:
+        venv.reset(seed=1)
+        obs, rewards, terminated, truncated, info = venv.step(np.zeros(2, np.int64))
+        # env 0 crashed: its episode is truncated, env 1 is untouched
+        assert truncated[0] and not truncated[1]
+        assert not terminated[0]
+        assert venv.counters["Resilience/env_restarts"] == 1
+        assert venv.counters["Resilience/env_timeouts"] == 0
+        # drain returns DELTAS: first call 1, second call 0
+        assert venv.drain_counters()["Resilience/env_restarts"] == 1
+        assert venv.drain_counters()["Resilience/env_restarts"] == 0
+        # healthy steps after the restart don't count anything
+        venv.step(np.zeros(2, np.int64))
+        assert venv.counters["Resilience/env_restarts"] == 1
+    finally:
+        venv.close()
+
+
+def test_supervised_vector_env_recovers_from_hang():
+    """A wedged async worker trips the per-step deadline; the parent terminates
+    and rebuilds the whole vector env, truncating every in-flight episode."""
+    venv = SupervisedVectorEnv(
+        [_hanging_env_fn, _healthy_env_fn],
+        sync=False,
+        step_timeout_s=1.0,
+        max_restarts=1,
+        backoff_base_s=0.0,
+    )
+    try:
+        venv.reset(seed=3)
+        venv.step(np.zeros(2, np.int64))  # step 1: fine
+        obs, rewards, terminated, truncated, info = venv.step(np.zeros(2, np.int64))
+        assert info.get("vector_env_restarted") is True
+        assert truncated.all() and not terminated.any()
+        assert rewards.sum() == 0.0
+        assert venv.counters["Resilience/env_timeouts"] == 1
+        venv.step(np.zeros(2, np.int64))  # rebuilt group steps normally
+        # the rebuilt incarnation hangs again at ITS step 2 -> budget exhausted
+        with pytest.raises(WorkerSupervisionError, match="wedged"):
+            venv.step(np.zeros(2, np.int64))
+    finally:
+        try:
+            venv.close(terminate=True)
+        except Exception:
+            pass
+
+
+def test_make_supervised_env_dispatch():
+    ft_on = resilience.resolve({})
+    ft_off = resilience.resolve({"fault_tolerance": {"env_supervision": {"enabled": False}}})
+    venv = resilience.make_supervised_env([_healthy_env_fn], sync=True, ft=ft_on)
+    assert isinstance(venv, SupervisedVectorEnv)
+    venv.close()
+    venv = resilience.make_supervised_env([_healthy_env_fn], sync=True, ft=ft_off)
+    assert not isinstance(venv, SupervisedVectorEnv)
+    venv.close()
+
+
+def test_drain_env_counters_feeds_aggregator():
+    from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
+
+    class _Fake:
+        def drain_counters(self):
+            return {"Resilience/env_restarts": 2, "Resilience/env_timeouts": 0}
+
+    agg = MetricAggregator({"Resilience/env_restarts": SumMetric()})
+    resilience.drain_env_counters(_Fake(), agg)
+    resilience.drain_env_counters(_Fake(), agg)
+    assert agg.compute()["Resilience/env_restarts"] == 4.0
+    # no-ops: plain vector env (no drain_counters), disabled aggregator
+    resilience.drain_env_counters(object(), agg)
+    resilience.drain_env_counters(_Fake(), None)
+
+
+# --------------------------------------------------------------------------- #
+# In-graph non-finite guard
+# --------------------------------------------------------------------------- #
+
+
+def test_finite_or_skip_selects_old_state_on_nonfinite():
+    import jax.numpy as jnp
+
+    new = {"w": jnp.ones(3), "count": jnp.int32(5)}
+    old = {"w": jnp.zeros(3), "count": jnp.int32(4)}
+
+    guarded, skipped = resilience.finite_or_skip((jnp.float32(1.0),), new, old)
+    assert float(skipped) == 0.0
+    np.testing.assert_array_equal(np.asarray(guarded["w"]), np.ones(3))
+    assert int(guarded["count"]) == 5
+
+    for bad in (jnp.float32(np.nan), jnp.float32(np.inf), jnp.array([1.0, -np.inf])):
+        guarded, skipped = resilience.finite_or_skip((jnp.float32(0.5), bad), new, old)
+        assert float(skipped) == 1.0
+        np.testing.assert_array_equal(np.asarray(guarded["w"]), np.zeros(3))
+        assert int(guarded["count"]) == 4
+
+
+def test_guard_enabled_per_policy():
+    for policy, enabled in [("skip_update", True), ("halt", True), ("off", False)]:
+        ft = resilience.resolve({"fault_tolerance": {"nonfinite": {"policy": policy}}})
+        assert resilience.guard_enabled(ft) is enabled
+
+
+def test_enforce_nonfinite_policy_halts_only_on_skips():
+    ft_halt = resilience.resolve({"fault_tolerance": {"nonfinite": {"policy": "halt"}}})
+    ft_skip = resilience.resolve({})
+    # skip_update rides through any count
+    resilience.enforce_nonfinite_policy(ft_skip, {"Resilience/nonfinite_skips": 3.0})
+    # halt with zero skips (or no counter at all) is quiet
+    resilience.enforce_nonfinite_policy(ft_halt, {"Resilience/nonfinite_skips": 0.0})
+    resilience.enforce_nonfinite_policy(ft_halt, {})
+    with pytest.raises(resilience.NonFiniteUpdateError, match="non-finite"):
+        resilience.enforce_nonfinite_policy(
+            ft_halt, {"Resilience/nonfinite_skips": np.float32(2.0)}
+        )
+
+
+# --------------------------------------------------------------------------- #
+# CrossHostTransport deadline/retry policy
+# --------------------------------------------------------------------------- #
+
+
+def _bare_transport():
+    from sheeprl_tpu.parallel.decoupled import CrossHostTransport
+
+    # __init__ needs a trainer mesh; the fault policy is independent of it
+    t = CrossHostTransport.__new__(CrossHostTransport)
+    t.op_timeout_ms = None
+    t.op_retries = 0
+    t.op_backoff_base_s = 1.0
+    t.op_backoff_max_s = 30.0
+    t._scope = "unit-test-scope"
+    return t
+
+
+def test_transport_op_timeout_precedence():
+    t = _bare_transport()
+    assert t._op_timeout(5000, None) == 5000  # per-op default
+    t.configure_faults(op_timeout_ms=250, retries=1, backoff_base_s=0.0)
+    assert t._op_timeout(5000, None) == 250  # configured policy wins over default
+    assert t._op_timeout(5000, 99) == 99  # explicit per-call override wins over all
+
+
+def test_kv_retry_recovers_then_exhausts():
+    from sheeprl_tpu.parallel.decoupled import TransportTimeoutError
+
+    t = _bare_transport()
+    t.configure_faults(retries=2, backoff_base_s=0.0, backoff_max_s=0.0)
+
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 2:
+            raise RuntimeError("transient coordinator hiccup")
+        return "value"
+
+    assert t._kv_retry(flaky, describe="KV get of 'k'") == "value"
+    assert attempts["n"] == 2
+
+    calls = []
+
+    def dead_peer():
+        calls.append(1)
+        raise RuntimeError("DEADLINE_EXCEEDED: key never published")
+
+    with pytest.raises(TransportTimeoutError) as exc:
+        t._kv_retry(dead_peer, describe="KV get of 'spec'")
+    assert len(calls) == 3  # 1 + retries
+    msg = str(exc.value)
+    # diagnosable from one log line: op, attempts, scope, underlying error
+    assert "KV get of 'spec'" in msg
+    assert "3 attempt(s)" in msg
+    assert "DEADLINE_EXCEEDED" in msg
+
+
+def test_stale_side_attribution():
+    from sheeprl_tpu.parallel.decoupled import CrossHostTransport
+
+    stale = CrossHostTransport._stale_side
+    assert "TRAINER" in stale(100.0, 200.0)
+    assert "PLAYER" in stale(200.0, 100.0)
+    assert "unknown" in stale(None, 200.0)
+    assert "unknown" in stale(100.0, None)
+    assert "same mtime" in stale(100.0, 100.0)
