@@ -211,42 +211,54 @@ def bench_smoke(total_steps: int = 128) -> dict:
     round since r2 failed on reachability, which also meant nobody would notice
     the harness bit-rotting. Runs on the dummy env, a 16-step rollout, and both
     ``buffer.backend=host`` and ``buffer.backend=device`` so the on-policy HBM
-    rollout path is covered too. Numbers are NOT comparable to the real bench.
+    rollout path is covered too; a third pass over async env workers engages the
+    interaction pipeline (core/pipeline.py) and reports the env-step time hidden
+    behind device/host work. Numbers are NOT comparable to the real bench.
     """
     from sheeprl_tpu.cli import run
+    from sheeprl_tpu.core.pipeline import process_overlap_totals
 
     result = {
         "metric": _target_metric("smoke"),
         "unit": "env-steps/s",
         "smoke": True,
     }
+    common = [
+        "exp=ppo",
+        f"algo.total_steps={total_steps}",
+        "algo.rollout_steps=16",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=1",
+        "env=dummy",
+        "env.num_envs=2",
+        "env.capture_video=False",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "metric.disable_timer=True",
+        "checkpoint.every=999999999",
+        "checkpoint.save_last=False",
+        "buffer.memmap=False",
+        "fabric.devices=1",
+    ]
     for backend in ("host", "device"):
         t0 = time.perf_counter()
-        run(
-            overrides=[
-                "exp=ppo",
-                f"algo.total_steps={total_steps}",
-                "algo.rollout_steps=16",
-                "algo.per_rank_batch_size=8",
-                "algo.update_epochs=1",
-                "env=dummy",
-                "env.num_envs=2",
-                "env.sync_env=True",
-                "env.capture_video=False",
-                "algo.mlp_keys.encoder=[state]",
-                "algo.cnn_keys.encoder=[]",
-                "algo.run_test=False",
-                "metric.log_level=0",
-                "metric.disable_timer=True",
-                "checkpoint.every=999999999",
-                "checkpoint.save_last=False",
-                "buffer.memmap=False",
-                f"buffer.backend={backend}",
-                "fabric.devices=1",
-            ]
-        )
+        run(overrides=[*common, "env.sync_env=True", f"buffer.backend={backend}"])
         result[f"smoke_{backend}_env_steps_per_sec"] = round(
             total_steps / (time.perf_counter() - t0), 2
+        )
+    # async env workers: the pipelined pass (Time/sps_pipeline_overlap's source)
+    overlap_s0, overlap_n0 = process_overlap_totals()
+    t0 = time.perf_counter()
+    run(overrides=[*common, "env.sync_env=False", "buffer.backend=host"])
+    result["smoke_pipeline_env_steps_per_sec"] = round(total_steps / (time.perf_counter() - t0), 2)
+    overlap_s, overlap_n = process_overlap_totals()
+    result["smoke_pipeline_overlap_s"] = round(overlap_s - overlap_s0, 3)
+    result["smoke_pipeline_overlap_steps"] = overlap_n - overlap_n0
+    if overlap_s > overlap_s0:
+        result["smoke_sps_pipeline_overlap"] = round(
+            (overlap_n - overlap_n0) * 2 / (overlap_s - overlap_s0), 2
         )
     result["value"] = result["smoke_host_env_steps_per_sec"]
     return result
@@ -325,37 +337,59 @@ if __name__ == "__main__":
         help="tiny CPU-backend PPO pass over both buffer backends (harness self-test; "
         "no accelerator, no comparable numbers)",
     )
+    parser.add_argument(
+        "--platform",
+        choices=("auto", "cpu", "tpu", "gpu"),
+        default="auto",
+        help="pin JAX_PLATFORMS instead of backend auto-discovery (auto keeps jax's "
+        "own probing; cpu skips the accelerator tunnel entirely)",
+    )
     cli_args = parser.parse_args()
     headline_metric = _target_metric("smoke" if cli_args.smoke else cli_args.target)
 
-    if cli_args.smoke:
+    if cli_args.platform != "auto":
+        os.environ["JAX_PLATFORMS"] = cli_args.platform
+    elif cli_args.smoke:
         # the smoke pass must not depend on (or wait for) the tunneled chip
         os.environ["JAX_PLATFORMS"] = "cpu"
 
-    # Fail FAST if the accelerator is unreachable (a dead tunnel parks every
-    # device RPC forever — seen in round 5 when the relay process died): probe
-    # backend discovery under a watchdog and emit a diagnosable one-line record
-    # instead of hanging the driver's bench step.
+    # An unreachable accelerator must not hang the driver's bench step (a dead
+    # tunnel parks every device RPC forever — seen in round 5 when the relay
+    # process died): probe backend discovery under a watchdog. On timeout the
+    # process re-execs itself pinned to JAX_PLATFORMS=cpu so the run still
+    # produces real (if slow) numbers instead of a null record; a second
+    # timeout on the CPU fallback is unrecoverable and emits the error record.
     import threading
 
     probe_done = threading.Event()
 
     def _watchdog():
         if not probe_done.wait(180):
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                print(
+                    json.dumps(
+                        {
+                            "metric": headline_metric,
+                            "value": None,
+                            "unit": "env-steps/s" if "env_steps" in headline_metric else "g-steps/s",
+                            "vs_baseline": None,
+                            "error": "backend discovery exceeded 180s even on the CPU "
+                            "fallback (broken jax install?)",
+                        }
+                    ),
+                    flush=True,
+                )
+                os._exit(3)
             print(
-                json.dumps(
-                    {
-                        "metric": headline_metric,
-                        "value": None,
-                        "unit": "env-steps/s" if "env_steps" in headline_metric else "g-steps/s",
-                        "vs_baseline": None,
-                        "error": "accelerator unreachable: backend discovery exceeded 180s "
-                        "(tunnel/relay down?)",
-                    }
-                ),
+                "WARNING: accelerator unreachable (backend discovery exceeded 180s, "
+                "tunnel/relay down?) — falling back to JAX_PLATFORMS=cpu",
+                file=sys.stderr,
                 flush=True,
             )
-            os._exit(3)
+            env = dict(os.environ, JAX_PLATFORMS="cpu", _SHEEPRL_BENCH_CPU_FALLBACK="1")
+            # exec replaces the process (hung RPC threads included) with a clean
+            # CPU-pinned copy of this same invocation
+            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env)
 
     threading.Thread(target=_watchdog, daemon=True).start()
     import jax
@@ -389,4 +423,8 @@ if __name__ == "__main__":
                     result.update(bench_dv3(batch=16, key_prefix="dv3_recipe"))
                 except Exception as e:
                     result["dv3_recipe_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
+        # numbers are real but from the CPU backend — flag them as incomparable
+        result["cpu_fallback"] = True
+        result["warning"] = "accelerator unreachable: results measured on the CPU fallback backend"
     print(json.dumps(result))

@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -195,9 +196,58 @@ def main(runtime, cfg: Dict[str, Any]):
         player_rng = jax.device_put(jnp.asarray(state["player_rng"]), runtime.player_device)
 
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {}
     for k in obs_keys:
-        step_data[k] = next_obs[k][np.newaxis]
+        next_obs[k] = reset_obs[k]
+        step_data[k] = reset_obs[k][np.newaxis]
+
+    # ----- software pipeline (core/pipeline.py): same structure as ppo.py — env
+    # workers step while the host closes out the previous step; obs reach the
+    # device as ONE packed put per step with the prior rewards/dones riding along
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    codec = PackedObsCodec(cnn_keys=(), device=runtime.player_device)
+    zero_extra = {
+        "rewards": np.zeros((n_envs, 1), np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+    }
+    pending: Dict[str, Any] = {}
+
+    def _process_pending(cur_packed):
+        """Close out the previous step while the env workers run (see ppo.py)."""
+        if not pending:
+            return
+        if device_rollout:
+            if cur_packed is not None:
+                extra_packed, extra_only = cur_packed, False
+            else:
+                extra_packed, extra_only = (
+                    codec.encode_extra_only(
+                        {"rewards": pending["rewards"], "dones": pending["dones"]}
+                    ),
+                    True,
+                )
+            rb.add_env_packed(codec, pending["packed"], extra_packed, extra_only=extra_only)
+        else:
+            rewards = pending["rewards"]
+            step_data["dones"] = pending["dones"][np.newaxis]
+            step_data["values"] = np.asarray(pending["values"])[np.newaxis]
+            step_data["actions"] = np.asarray(pending["cat_actions"])[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            if cfg.buffer.memmap:
+                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            for k in obs_keys:
+                step_data[k] = next_obs[k][np.newaxis]
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(pending["info"])):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+        pending.clear()
 
     def _ckpt_state():
         # shared by the periodic checkpoint and the preemption emergency save so
@@ -224,51 +274,48 @@ def main(runtime, cfg: Dict[str, Any]):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # raw obs straight into the player jit (see PPOPlayer.act_raw;
-                    # A2C reuses the PPO agent, vector obs only)
-                    cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                    # ONE packed host->device transfer per step (A2C reuses the
+                    # PPO agent, vector obs only; see PPOPlayer.act_packed)
+                    packed = codec.encode(
+                        next_obs,
+                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                        if pending
+                        else zero_extra,
+                    )
+                    cat_actions, env_actions, _, values, player_rng = player.act_packed(
+                        codec, packed, player_rng
+                    )
+                    # the one unavoidable per-step device->host sync: env actions
+                    real_actions = np.asarray(env_actions)
+                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                    # ---- overlap window: env workers are stepping
+                    _process_pending(packed)
                     if device_rollout:
                         # in-graph scatter: actions/values stay in HBM (A2C's loss
                         # recomputes logprobs, so only these two leaves are stored)
                         rb.add_policy({"actions": cat_actions, "values": values})
-                    # the one unavoidable per-step device->host sync: env actions
-                    real_actions = np.asarray(env_actions)
-                    obs, rewards, terminated, truncated, info = envs.step(
-                        real_actions.reshape(envs.action_space.shape)
-                    )
+
+                    obs, rewards, terminated, truncated, info = stepper.step_wait()
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                     rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
 
-                if device_rollout:
-                    rb.add_env(
-                        {
-                            "rewards": rewards,
-                            "dones": dones,
-                            **{k: next_obs[k] for k in obs_keys},
-                        }
+                    pending.update(
+                        packed=packed,
+                        rewards=rewards,
+                        dones=dones,
+                        info=info,
+                        values=values,
+                        cat_actions=cat_actions,
                     )
-                else:
-                    step_data["dones"] = dones[np.newaxis]
-                    step_data["values"] = np.asarray(values)[np.newaxis]
-                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
-                    step_data["rewards"] = rewards[np.newaxis]
-                    if cfg.buffer.memmap:
-                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-                next_obs = {}
-                for k in obs_keys:
-                    step_data[k] = obs[k][np.newaxis]
-                    next_obs[k] = obs[k]
+                    next_obs = {}
+                    for k in obs_keys:
+                        next_obs[k] = obs[k]
 
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            with timer("Time/env_interaction_time", SumMetric()):
+                # flush: the rollout's last row has no next act transfer to ride
+                _process_pending(None)
 
             if not device_rollout:
                 local_data = rb.to_arrays(dtype=np.float32)
@@ -301,6 +348,13 @@ def main(runtime, cfg: Dict[str, Any]):
                 if aggregator:
                     aggregator.update_from_device(train_metrics)
                 if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    overlap_s, overlap_steps = stepper.drain_overlap()
+                    if overlap_s > 0:
+                        sps_overlap = overlap_steps * n_envs * cfg.env.action_repeat / overlap_s
+                        if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                            aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                        else:
+                            logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                     if aggregator and not aggregator.disabled:
                         logger.log_metrics(aggregator.compute(), policy_step)
                         aggregator.reset()

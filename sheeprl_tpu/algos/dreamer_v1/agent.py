@@ -185,6 +185,7 @@ class PlayerDV1:
         self.wm_params: Any = None
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._packed_step_fns: Dict[Any, Any] = {}
 
     def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False):
         recurrent_state, stochastic_state, actions = state
@@ -231,6 +232,23 @@ class PlayerDV1:
             key,
             jnp.float32(self.expl_amount),
             greedy=greedy,
+        )
+        return actions_list
+
+    def get_actions_packed(self, codec, packed: jax.Array, key: jax.Array, greedy: bool = False):
+        """Act from a packed obs buffer: unpack + normalize in-graph (one H2D transfer per step)."""
+        cache_key = (codec.signature, bool(greedy))
+        fn = self._packed_step_fns.get(cache_key)
+        if fn is None:
+
+            def _packed(wm_params, actor_params, state, packed, key, expl_amount):
+                obs = codec.decode_obs(packed)
+                return self._raw_step(wm_params, actor_params, state, obs, key, expl_amount, greedy=greedy)
+
+            fn = jax.jit(_packed)
+            self._packed_step_fns[cache_key] = fn
+        actions_list, self.state = fn(
+            self.wm_params, self.actor_params, self.state, packed, key, jnp.float32(self.expl_amount)
         )
         return actions_list
 

@@ -24,9 +24,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.algos.dreamer_v1.agent import DV1Modules, build_agent
 from sheeprl_tpu.algos.dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
-from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, prepare_obs, test
+from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, test
 from sheeprl_tpu.algos.dreamer_v2.agent import ActorOutputDV2, expl_amount_schedule
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
@@ -403,11 +404,22 @@ def main(runtime, cfg: Dict[str, Any]):
         rb.add(step_data, validate_args=cfg.buffer.validate_args)
     player.init_states()
 
+    # software pipeline (core/pipeline.py): the env workers step while the chip
+    # runs the training phase below — the prefetcher samples one train call
+    # behind, so training never depended on the in-flight row anyway
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    codec = PackedObsCodec(
+        cnn_keys=cfg.algo.cnn_keys.encoder,
+        device=runtime.player_device,
+        leading_dims=(1, cfg.env.num_envs),
+    )
+
     base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
     expl_decay = float(cfg.algo.actor.get("expl_decay", 0.0))
     expl_min = float(cfg.algo.actor.get("expl_min", 0.0))
 
     cumulative_per_rank_gradient_steps = 0
+    trained_once = False
     for iter_num in range(start_iter, total_iters + 1):
         profiler.step(policy_step)
         policy_step += policy_steps_per_iter
@@ -424,10 +436,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         axis=-1,
                     )
             else:
-                jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
+                # ONE packed H2D put per step; unpack + normalization run in-graph
+                packed = codec.encode(obs)
                 rng, act_key = jax.random.split(rng)
                 player.expl_amount = expl_amount_schedule(base_expl_amount, expl_decay, expl_min, policy_step)
-                actions_list = player.get_actions(jax_obs, act_key)
+                actions_list = player.get_actions_packed(codec, packed, act_key)
                 actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
                 if is_continuous:
                     real_actions = actions
@@ -437,63 +450,76 @@ def main(runtime, cfg: Dict[str, Any]):
             step_data["is_first"] = np.logical_or(step_data["terminated"], step_data["truncated"]).astype(
                 np.float32
             )
-            next_obs, rewards, terminated, truncated, infos = envs.step(
-                real_actions.reshape(envs.action_space.shape)
-            )
+            stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+        env_step_done = False
+
+        def _finish_env_step():
+            nonlocal env_step_done, obs
+            if env_step_done:
+                return
+            env_step_done = True
+            with timer("Time/env_interaction_time", SumMetric()):
+                next_obs, rewards, terminated, truncated, infos = stepper.step_wait()
             dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
-        if cfg.metric.log_level > 0:
-            for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
-                if aggregator:
-                    if "Rewards/rew_avg" in aggregator:
-                        aggregator.update("Rewards/rew_avg", ep_rew)
-                    if "Game/ep_len_avg" in aggregator:
-                        aggregator.update("Game/ep_len_avg", ep_len)
-                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(infos)):
+                    if aggregator:
+                        if "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
-        finals = final_observations(infos, obs_keys)
-        if finals:
-            for idx, final_obs in finals.items():
-                for k, v in final_obs.items():
-                    real_next_obs[k][idx] = v
+            real_next_obs = {k: np.asarray(v).copy() for k, v in next_obs.items() if k in obs_keys}
+            finals = final_observations(infos, obs_keys)
+            if finals:
+                for idx, final_obs in finals.items():
+                    for k, v in final_obs.items():
+                        real_next_obs[k][idx] = v
 
-        for k in obs_keys:
-            step_data[k] = real_next_obs[k][np.newaxis]
-        obs = next_obs
-
-        step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
-        step_data["rewards"] = clip_rewards_fn(
-            np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
-        )
-        with prefetcher.guard():  # no torn rows under the worker's sample
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-
-        dones_idxes = dones.nonzero()[0].tolist()
-        reset_envs = len(dones_idxes)
-        if reset_envs > 0:
-            reset_data = {}
             for k in obs_keys:
-                reset_data[k] = (np.asarray(next_obs[k])[dones_idxes])[np.newaxis]
-            reset_data["terminated"] = np.zeros((1, reset_envs, 1))
-            reset_data["truncated"] = np.zeros((1, reset_envs, 1))
-            reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
-            reset_data["rewards"] = np.zeros((1, reset_envs, 1))
-            reset_data["is_first"] = np.ones_like(reset_data["terminated"])
-            with prefetcher.guard():  # no torn rows under the worker's sample
-                rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
-            for d in dones_idxes:
-                step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
-                step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
-            player.init_states(dones_idxes)
+                step_data[k] = real_next_obs[k][np.newaxis]
+            obs = next_obs
 
-        # ---- training phase
+            step_data["terminated"] = np.asarray(terminated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            step_data["truncated"] = np.asarray(truncated, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
+            step_data["rewards"] = clip_rewards_fn(
+                np.asarray(rewards, dtype=np.float32).reshape((1, cfg.env.num_envs, -1))
+            )
+            with prefetcher.guard():  # no torn rows under the worker's sample
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+
+            dones_idxes = dones.nonzero()[0].tolist()
+            reset_envs = len(dones_idxes)
+            if reset_envs > 0:
+                reset_data = {}
+                for k in obs_keys:
+                    reset_data[k] = (np.asarray(next_obs[k])[dones_idxes])[np.newaxis]
+                reset_data["terminated"] = np.zeros((1, reset_envs, 1))
+                reset_data["truncated"] = np.zeros((1, reset_envs, 1))
+                reset_data["actions"] = np.zeros((1, reset_envs, int(np.sum(actions_dim))))
+                reset_data["rewards"] = np.zeros((1, reset_envs, 1))
+                reset_data["is_first"] = np.ones_like(reset_data["terminated"])
+                with prefetcher.guard():  # no torn rows under the worker's sample
+                    rb.add(reset_data, dones_idxes, validate_args=cfg.buffer.validate_args)
+                for d in dones_idxes:
+                    step_data["terminated"][0, d] = np.zeros_like(step_data["terminated"][0, d])
+                    step_data["truncated"][0, d] = np.zeros_like(step_data["truncated"][0, d])
+                player.init_states(dones_idxes)
+
+        # ---- training phase (overlap window: env workers step while the chip trains)
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
             if per_rank_gradient_steps > 0:
+                if not trained_once:
+                    # first sample: complete the env step serially so the buffer
+                    # holds the full prefill before the sequence sampler runs
+                    _finish_env_step()
+                    trained_once = True
                 # consumes the batch prefetched during the previous train step and
                 # immediately speculates the next one
                 batches = prefetcher.get(
@@ -518,8 +544,17 @@ def main(runtime, cfg: Dict[str, Any]):
                     if "Params/exploration_amount" in aggregator:
                         aggregator.update("Params/exploration_amount", player.expl_amount)
 
+        _finish_env_step()
+
         # ---- logging
         if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+            overlap_s, overlap_steps = stepper.drain_overlap()
+            if overlap_s > 0:
+                sps_overlap = overlap_steps * cfg.env.num_envs * cfg.env.action_repeat / overlap_s
+                if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                    aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                elif logger:
+                    logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
             if aggregator and not aggregator.disabled:
                 logger.log_metrics(aggregator.compute(), policy_step)
                 aggregator.reset()

@@ -654,6 +654,7 @@ class PlayerDV2:
         self.wm_params: Any = None
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._packed_step_fns: Dict[Any, Any] = {}
 
     def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False, mask=None):
         recurrent_state, stochastic_state, actions = state
@@ -701,6 +702,29 @@ class PlayerDV2:
             jnp.float32(self.expl_amount),
             greedy=greedy,
             mask=mask,
+        )
+        return actions_list
+
+    def get_actions_packed(self, codec, packed: jax.Array, key: jax.Array, greedy: bool = False):
+        """Act from a packed obs buffer: unpack, normalize, and extract action masks in-graph."""
+        use_mask = bool(getattr(self.actor, "uses_action_mask", False))
+        cache_key = (codec.signature, bool(greedy), use_mask)
+        fn = self._packed_step_fns.get(cache_key)
+        if fn is None:
+
+            def _packed(wm_params, actor_params, state, packed, key, expl_amount):
+                obs = codec.decode_obs(packed)
+                mask = None
+                if use_mask:
+                    mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
+                return self._raw_step(
+                    wm_params, actor_params, state, obs, key, expl_amount, greedy=greedy, mask=mask
+                )
+
+            fn = jax.jit(_packed)
+            self._packed_step_fns[cache_key] = fn
+        actions_list, self.state = fn(
+            self.wm_params, self.actor_params, self.state, packed, key, jnp.float32(self.expl_amount)
         )
         return actions_list
 

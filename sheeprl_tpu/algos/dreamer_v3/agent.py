@@ -788,6 +788,7 @@ class PlayerDV3:
         self.wm_params: Any = None
         self.actor_params: Any = None
         self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._packed_step_fns: Dict[Any, Any] = {}
 
     def _actor_step(self, actor_params, latent, key, greedy: bool = False, mask=None):
         """Sample actions from the latent; subclasses override to change how the
@@ -835,6 +836,27 @@ class PlayerDV3:
         actions_list, self.state = self._step(
             self.wm_params, self.actor_params, self.state, obs, key, greedy=greedy, mask=mask
         )
+        return actions_list
+
+    def get_actions_packed(self, codec, packed: jax.Array, key: jax.Array, greedy: bool = False):
+        """Like get_actions but fed by ONE packed host->device transfer (see
+        core/pipeline.PackedObsCodec): unpack + normalize + the ``mask_*``-key
+        action-mask extraction all run in-graph."""
+        use_mask = bool(getattr(self.actor, "uses_action_mask", False))
+        cache_key = (codec.signature, bool(greedy), use_mask)
+        fn = self._packed_step_fns.get(cache_key)
+        if fn is None:
+
+            def _packed(wm_params, actor_params, state, packed, key):
+                obs = codec.decode_obs(packed)
+                mask = None
+                if use_mask:
+                    mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
+                return self._raw_step(wm_params, actor_params, state, obs, key, greedy=greedy, mask=mask)
+
+            fn = jax.jit(_packed)
+            self._packed_step_fns[cache_key] = fn
+        actions_list, self.state = fn(self.wm_params, self.actor_params, self.state, packed, key)
         return actions_list
 
 

@@ -28,16 +28,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput, DV3Modules, build_agent
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
-    get_action_masks,
     MomentsState,
     compute_lambda_values,
     init_moments,
-    prepare_obs,
     test,
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.envs.wrappers import RestartOnException
 from sheeprl_tpu.ops.distributions import (
@@ -596,6 +595,16 @@ def main(runtime, cfg: Dict[str, Any]):
     step_data["is_first"] = np.ones_like(step_data["terminated"])
     player.init_states()
 
+    # ----- software pipeline (core/pipeline.py): env workers step while the host
+    # writes the pre-step buffer row (the prefetcher lock wait hides behind the
+    # env step); obs reach the device as ONE packed put per step
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    codec = PackedObsCodec(
+        cnn_keys=cfg.algo.cnn_keys.encoder,
+        device=runtime.player_device,
+        leading_dims=(1, cfg.env.num_envs),
+    )
+
     cumulative_per_rank_gradient_steps = 0
     heartbeat_t0, heartbeat_iter = time.perf_counter(), start_iter
 
@@ -655,10 +664,11 @@ def main(runtime, cfg: Dict[str, Any]):
                             axis=-1,
                         )
                 else:
-                    jax_obs = prepare_obs(runtime, obs, cnn_keys=cfg.algo.cnn_keys.encoder, num_envs=cfg.env.num_envs)
-                    mask = get_action_masks(jax_obs)
+                    # ONE packed host->device transfer per step: unpack, normalize
+                    # and action-mask extraction run in-graph (PlayerDV3.get_actions_packed)
+                    packed = codec.encode(obs)
                     rng, act_key = jax.random.split(rng)
-                    actions_list = player.get_actions(jax_obs, act_key, mask=mask)
+                    actions_list = player.get_actions_packed(codec, packed, act_key)
                     actions = np.concatenate([np.asarray(a) for a in actions_list], axis=-1)
                     if is_continuous:
                         real_actions = actions
@@ -667,13 +677,15 @@ def main(runtime, cfg: Dict[str, Any]):
                             [np.asarray(a).argmax(axis=-1) for a in actions_list], axis=-1
                         )
 
+                stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                # ---- overlap window: env workers are stepping; the pre-step row
+                # write (and any wait on the prefetcher's sample lock) hides here
                 step_data["actions"] = actions.reshape((1, cfg.env.num_envs, -1))
                 with prefetcher.guard():  # no torn rows under the worker's concurrent sample
                     rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-                next_obs, rewards, terminated, truncated, infos = envs.step(
-                    real_actions.reshape(envs.action_space.shape)
-                )
+                next_obs, rewards, terminated, truncated, infos = stepper.step_wait()
                 dones = np.logical_or(terminated, truncated).astype(np.uint8)
 
             step_data["is_first"] = np.zeros_like(step_data["terminated"])
@@ -764,6 +776,13 @@ def main(runtime, cfg: Dict[str, Any]):
 
             # ---- logging
             if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):
+                overlap_s, overlap_steps = stepper.drain_overlap()
+                if overlap_s > 0:
+                    sps_overlap = overlap_steps * cfg.env.num_envs * cfg.env.action_repeat / overlap_s
+                    if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                        aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                    elif logger:
+                        logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                 if aggregator and not aggregator.disabled:
                     logger.log_metrics(aggregator.compute(), policy_step)
                     aggregator.reset()

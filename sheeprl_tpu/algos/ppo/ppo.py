@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -281,11 +282,70 @@ def main(runtime, cfg: Dict[str, Any]):
         player_rng = jax.device_put(jnp.asarray(state["player_rng"]), runtime.player_device)
 
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {}
     for k in obs_keys:
+        _obs = reset_obs[k]
         if k in cnn_keys:
-            next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
-        step_data[k] = next_obs[k][np.newaxis]
+            _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+        next_obs[k] = _obs
+        step_data[k] = _obs[np.newaxis]
+
+    # ----- software pipeline (core/pipeline.py): the env workers step while the
+    # host closes out the PREVIOUS step and dispatches this one's device work;
+    # the obs reach the device as ONE packed put per step with the previous
+    # step's rewards/dones riding along for the buffer's row-close write
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    codec = PackedObsCodec(cnn_keys=cnn_keys, device=runtime.player_device)
+    zero_extra = {
+        "rewards": np.zeros((n_envs, 1), np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+    }
+    pending: Dict[str, Any] = {}
+
+    def _process_pending(cur_packed):
+        """Close out the previous step while the env workers run: buffer row
+        write, episode/metric accounting. ``cur_packed`` is the current step's
+        packed transfer carrying the pending rewards/dones (None at the
+        end-of-rollout flush, where a short extra-only put stands in)."""
+        if not pending:
+            return
+        if device_rollout:
+            if cur_packed is not None:
+                extra_packed, extra_only = cur_packed, False
+            else:
+                extra_packed, extra_only = (
+                    codec.encode_extra_only(
+                        {"rewards": pending["rewards"], "dones": pending["dones"]}
+                    ),
+                    True,
+                )
+            # obs decode from the PREVIOUS step's act transfer, rewards/dones
+            # from the current one: closing a row costs zero extra transfers
+            rb.add_env_packed(codec, pending["packed"], extra_packed, extra_only=extra_only)
+        else:
+            rewards = pending["rewards"]
+            step_data["dones"] = pending["dones"][np.newaxis]
+            step_data["values"] = np.asarray(pending["values"])[np.newaxis]
+            step_data["actions"] = np.asarray(pending["cat_actions"])[np.newaxis]
+            step_data["logprobs"] = np.asarray(pending["logprobs"])[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            if cfg.buffer.memmap:
+                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            # the row just written holds the obs the pending step acted on; the
+            # NEXT row starts from the obs that step produced (current next_obs)
+            for k in obs_keys:
+                step_data[k] = next_obs[k][np.newaxis]
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(pending["info"])):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+        pending.clear()
 
     def _ckpt_state():
         # shared by the periodic checkpoint and the preemption emergency save so
@@ -312,21 +372,32 @@ def main(runtime, cfg: Dict[str, Any]):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # raw obs straight into the player jit: normalization runs inside
-                    # the one dispatch instead of as a per-step eager prep (see
-                    # PPOPlayer.act_raw)
-                    cat_actions, env_actions, logprobs, values, player_rng = player.act_raw(next_obs, player_rng)
+                    # ONE packed host->device transfer per step: obs plus the
+                    # previous step's rewards/dones (decoded only by the buffer
+                    # write), normalization runs in-graph (PPOPlayer.act_packed)
+                    packed = codec.encode(
+                        next_obs,
+                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                        if pending
+                        else zero_extra,
+                    )
+                    cat_actions, env_actions, logprobs, values, player_rng = player.act_packed(
+                        codec, packed, player_rng
+                    )
+                    # the ONE unavoidable per-step device->host sync: the env needs
+                    # the actions on host to step
+                    real_actions = np.asarray(env_actions)
+                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                    # ---- overlap window: env workers are stepping; close out the
+                    # previous step and dispatch this one's policy-row scatter
+                    _process_pending(packed)
                     if device_rollout:
                         # in-graph scatter straight from the player step's outputs:
                         # values/logprobs/actions stay in HBM, no host pull
                         rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
-                    # the ONE unavoidable per-step device->host sync: the env needs
-                    # the actions on host to step
-                    real_actions = np.asarray(env_actions)
 
-                    obs, rewards, terminated, truncated, info = envs.step(
-                        real_actions.reshape(envs.action_space.shape)
-                    )
+                    obs, rewards, terminated, truncated, info = stepper.step_wait()
                     truncated_envs = np.nonzero(truncated)[0]
                     if len(truncated_envs) > 0 and "final_obs" in info:
                         # bootstrap on truncation (reference ppo.py:292-309)
@@ -354,42 +425,28 @@ def main(runtime, cfg: Dict[str, Any]):
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                     rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
 
-                if device_rollout:
-                    # env products (pre-step obs + rewards + dones) ride ONE packed
-                    # device_put; the row index goes in-band, unpacked in-graph
-                    rb.add_env(
-                        {
-                            "rewards": rewards,
-                            "dones": dones,
-                            **{k: next_obs[k] for k in obs_keys},
-                        }
+                    # env products become the next step's pending work: the row
+                    # write and episode accounting run in the NEXT overlap window
+                    pending.update(
+                        packed=packed,
+                        rewards=rewards,
+                        dones=dones,
+                        info=info,
+                        values=values,
+                        cat_actions=cat_actions,
+                        logprobs=logprobs,
                     )
-                else:
-                    step_data["dones"] = dones[np.newaxis]
-                    step_data["values"] = np.asarray(values)[np.newaxis]
-                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
-                    step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-                    step_data["rewards"] = rewards[np.newaxis]
-                    if cfg.buffer.memmap:
-                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
 
-                next_obs = {}
-                for k in obs_keys:
-                    _obs = obs[k]
-                    if k in cnn_keys:
-                        _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                    step_data[k] = _obs[np.newaxis]
-                    next_obs[k] = _obs
+                    next_obs = {}
+                    for k in obs_keys:
+                        _obs = obs[k]
+                        if k in cnn_keys:
+                            _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                        next_obs[k] = _obs
 
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            with timer("Time/env_interaction_time", SumMetric()):
+                # flush: the rollout's last row has no next act transfer to ride
+                _process_pending(None)
 
             # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
             if not device_rollout:
@@ -438,6 +495,15 @@ def main(runtime, cfg: Dict[str, Any]):
                     aggregator.update_from_device(train_metrics)
                 logger.log_metrics({"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step)
                 if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    overlap_s, overlap_steps = stepper.drain_overlap()
+                    if overlap_s > 0:
+                        # env-step throughput absorbed into the overlap window
+                        # (env time hidden behind device dispatch + host bookkeeping)
+                        sps_overlap = overlap_steps * n_envs * cfg.env.action_repeat / overlap_s
+                        if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                            aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                        else:
+                            logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                     if aggregator and not aggregator.disabled:
                         logger.log_metrics(aggregator.compute(), policy_step)
                         aggregator.reset()

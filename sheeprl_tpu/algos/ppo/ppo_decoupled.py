@@ -41,6 +41,7 @@ from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env
@@ -254,12 +255,62 @@ def main(runtime, cfg: Dict[str, Any]):
         # restore the exact key chain so a preempted run resumes where it left off
         rng = jnp.asarray(state["rng"])
     step_data = {}
+    stepper = codec = None
+    pending: Dict[str, Any] = {}
     if is_player:
-        next_obs = envs.reset(seed=cfg.seed)[0]
+        reset_obs = envs.reset(seed=cfg.seed)[0]
+        next_obs = {}
         for k in obs_keys:
+            _obs = reset_obs[k]
             if k in cnn_keys:
-                next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
-            step_data[k] = next_obs[k][np.newaxis]
+                _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+            next_obs[k] = _obs
+            step_data[k] = _obs[np.newaxis]
+        # ----- software pipeline (core/pipeline.py): same structure as ppo.py,
+        # player role only — trainer processes never touch envs
+        stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+        codec = PackedObsCodec(cnn_keys=cnn_keys, device=player_rt.player_device)
+    zero_extra = {
+        "rewards": np.zeros((n_envs, 1), np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+    }
+
+    def _process_pending(cur_packed):
+        """Close out the previous step while the env workers run (see ppo.py)."""
+        if not pending:
+            return
+        if device_rollout:
+            if cur_packed is not None:
+                extra_packed, extra_only = cur_packed, False
+            else:
+                extra_packed, extra_only = (
+                    codec.encode_extra_only(
+                        {"rewards": pending["rewards"], "dones": pending["dones"]}
+                    ),
+                    True,
+                )
+            rb.add_env_packed(codec, pending["packed"], extra_packed, extra_only=extra_only)
+        else:
+            rewards = pending["rewards"]
+            step_data["dones"] = pending["dones"][np.newaxis]
+            step_data["values"] = np.asarray(pending["values"])[np.newaxis]
+            step_data["actions"] = np.asarray(pending["cat_actions"])[np.newaxis]
+            step_data["logprobs"] = np.asarray(pending["logprobs"])[np.newaxis]
+            step_data["rewards"] = rewards[np.newaxis]
+            if cfg.buffer.memmap:
+                step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+                step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            for k in obs_keys:
+                step_data[k] = next_obs[k][np.newaxis]
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(pending["info"])):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+        pending.clear()
 
     def _ckpt_state():
         pull = jax.device_get if transport is None else transport.pull_replicated
@@ -286,18 +337,29 @@ def main(runtime, cfg: Dict[str, Any]):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # raw obs straight into the player jit (see PPOPlayer.act_raw)
-                    cat_actions, env_actions, logprobs, values, rng = player.act_raw(next_obs, rng)
+                    # ONE packed host->device transfer per step (see
+                    # PPOPlayer.act_packed and core/pipeline.PackedObsCodec)
+                    packed = codec.encode(
+                        next_obs,
+                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                        if pending
+                        else zero_extra,
+                    )
+                    cat_actions, env_actions, logprobs, values, rng = player.act_packed(
+                        codec, packed, rng
+                    )
+                    # the one unavoidable per-step device->host sync: env actions
+                    real_actions = np.asarray(env_actions)
+                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                    # ---- overlap window: env workers are stepping
+                    _process_pending(packed)
                     if device_rollout:
                         # in-graph scatter on the player chip: no host pull of
                         # values/logprobs/actions
                         rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
-                    # the one unavoidable per-step device->host sync: env actions
-                    real_actions = np.asarray(env_actions)
 
-                    obs, rewards, terminated, truncated, info = envs.step(
-                        real_actions.reshape(envs.action_space.shape)
-                    )
+                    obs, rewards, terminated, truncated, info = stepper.step_wait()
                     truncated_envs = np.nonzero(truncated)[0]
                     if len(truncated_envs) > 0 and "final_obs" in info:
                         final_obs_arr = np.asarray(info["final_obs"], dtype=object)
@@ -321,40 +383,29 @@ def main(runtime, cfg: Dict[str, Any]):
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                     rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
 
-                if device_rollout:
-                    rb.add_env(
-                        {
-                            "rewards": rewards,
-                            "dones": dones,
-                            **{k: next_obs[k] for k in obs_keys},
-                        }
-                    )
-                else:
-                    step_data["dones"] = dones[np.newaxis]
-                    step_data["values"] = np.asarray(values)[np.newaxis]
-                    step_data["actions"] = np.asarray(cat_actions)[np.newaxis]
-                    step_data["logprobs"] = np.asarray(logprobs)[np.newaxis]
-                    step_data["rewards"] = rewards[np.newaxis]
-                    if cfg.buffer.memmap:
-                        step_data["returns"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                        step_data["advantages"] = np.zeros_like(rewards, shape=(1, *rewards.shape))
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
+                # env products become the next step's pending work: the row
+                # write and episode accounting run in the NEXT overlap window
+                pending.update(
+                    packed=packed,
+                    rewards=rewards,
+                    dones=dones,
+                    info=info,
+                    values=values,
+                    cat_actions=cat_actions,
+                    logprobs=logprobs,
+                )
 
                 next_obs = {}
                 for k in obs_keys:
                     _obs = obs[k]
                     if k in cnn_keys:
                         _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                    step_data[k] = _obs[np.newaxis]
                     next_obs[k] = _obs
 
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            if is_player:
+                with timer("Time/env_interaction_time", SumMetric()):
+                    # flush: the rollout's last row has no next act transfer to ride
+                    _process_pending(None)
 
             # ---- ship the rollout to the trainer role, block for new params
             # (the reference's scatter_object_list + params broadcast round)
@@ -403,7 +454,8 @@ def main(runtime, cfg: Dict[str, Any]):
                      np.float32(stop_agreed))
                 )
                 if is_player:
-                    jax.block_until_ready(player_params)
+                    if not timer.disabled:  # sync only when the train phase is being timed
+                        jax.block_until_ready(player_params)
                     player.params = player_params
                 else:
                     stop_agreed = bool(np.asarray(stop_flag))
@@ -424,6 +476,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     {"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step
                 )
                 if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    overlap_s, overlap_steps = stepper.drain_overlap()
+                    if overlap_s > 0:
+                        sps_overlap = overlap_steps * n_envs * cfg.env.action_repeat / overlap_s
+                        if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                            aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                        else:
+                            logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                     if aggregator and not aggregator.disabled:
                         logger.log_metrics(aggregator.compute(), policy_step)
                         aggregator.reset()

@@ -203,6 +203,8 @@ class RecurrentPPOPlayer:
         self._act = jax.jit(_act, static_argnums=(5,))
         self._act_raw = jax.jit(_act_raw, static_argnums=(5,))
         self._values = jax.jit(_values)
+        self._act_impl = _act
+        self._packed_act_fns: Dict[Any, Any] = {}
 
     def initial_states(self, hidden_size: int):
         return (
@@ -217,6 +219,22 @@ class RecurrentPPOPlayer:
         """Raw host obs (no T dim, [0,255] cnn stacks) + prev_actions [n_envs, A]:
         normalization, T=1 expansion, and the forward run as ONE jitted dispatch."""
         return self._act_raw(self.params, obs, prev_actions, prev_states, key, greedy)
+
+    def act_packed(self, codec, packed, prev_actions, prev_states, key, greedy: bool = False):
+        """Like act_raw but fed by ONE packed host->device transfer (see
+        core/pipeline.PackedObsCodec): unpack + normalize + T=1 expansion run
+        in-graph; prev actions/states stay device-resident between steps."""
+        cache_key = (codec.signature, bool(greedy))
+        fn = self._packed_act_fns.get(cache_key)
+        if fn is None:
+
+            def _packed(params, packed, prev_actions, prev_states, key):
+                obs = {k: v[None] for k, v in codec.decode_obs(packed).items()}
+                return self._act_impl(params, obs, prev_actions[None], prev_states, key, greedy)
+
+            fn = jax.jit(_packed)
+            self._packed_act_fns[cache_key] = fn
+        return fn(self.params, packed, prev_actions, prev_states, key)
 
     def get_values(self, obs, prev_actions, prev_states):
         return self._values(self.params, obs, prev_actions, prev_states)

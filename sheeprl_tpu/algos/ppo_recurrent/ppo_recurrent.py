@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import resilience
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -257,13 +258,63 @@ def main(runtime, cfg: Dict[str, Any]):
     h = cfg.algo.rnn.lstm.hidden_size
 
     step_data = {}
-    next_obs = envs.reset(seed=cfg.seed)[0]
+    reset_obs = envs.reset(seed=cfg.seed)[0]
+    next_obs = {}
     for k in obs_keys:
+        _obs = reset_obs[k]
         if k in cnn_keys:
-            next_obs[k] = next_obs[k].reshape(n_envs, -1, *next_obs[k].shape[-2:])
-        step_data[k] = next_obs[k][np.newaxis]
+            _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+        next_obs[k] = _obs
+        step_data[k] = _obs[np.newaxis]
     prev_states = player.initial_states(h)
     prev_actions = np.zeros((n_envs, sum(actions_dim)), dtype=np.float32)
+
+    # ----- software pipeline (core/pipeline.py): same structure as ppo.py; the
+    # recurrent state feedback (prev_actions/prev_states) stays immediate after
+    # step_wait because the NEXT act depends on it, everything else is deferred
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    codec = PackedObsCodec(cnn_keys=cnn_keys, device=runtime.player_device)
+    zero_extra = {
+        "rewards": np.zeros((n_envs, 1), np.float32),
+        "dones": np.zeros((n_envs, 1), np.float32),
+    }
+    pending: Dict[str, Any] = {}
+
+    def _process_pending(cur_packed):
+        """Close out the previous step while the env workers run (see ppo.py)."""
+        if not pending:
+            return
+        if device_rollout:
+            if cur_packed is not None:
+                extra_packed, extra_only = cur_packed, False
+            else:
+                extra_packed, extra_only = (
+                    codec.encode_extra_only(
+                        {"rewards": pending["rewards"], "dones": pending["dones"]}
+                    ),
+                    True,
+                )
+            rb.add_env_packed(codec, pending["packed"], extra_packed, extra_only=extra_only)
+        else:
+            step_data["dones"] = pending["dones"][np.newaxis]
+            step_data["values"] = np.asarray(pending["values"])[np.newaxis].reshape(1, n_envs, 1)
+            step_data["actions"] = np.asarray(pending["cat_actions"]).reshape(1, n_envs, -1)
+            step_data["logprobs"] = np.asarray(pending["logprobs"]).reshape(1, n_envs, 1)
+            step_data["rewards"] = pending["rewards"][np.newaxis]
+            step_data["prev_hx"] = np.asarray(pending["prev_hx"]).reshape(1, n_envs, -1)
+            step_data["prev_cx"] = np.asarray(pending["prev_cx"]).reshape(1, n_envs, -1)
+            step_data["prev_actions"] = np.asarray(pending["prev_actions"]).reshape(1, n_envs, -1)
+            rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            for k in obs_keys:
+                step_data[k] = next_obs[k][np.newaxis]
+        if cfg.metric.log_level > 0:
+            for i, (ep_rew, ep_len) in enumerate(finished_episodes(pending["info"])):
+                if aggregator and "Rewards/rew_avg" in aggregator:
+                    aggregator.update("Rewards/rew_avg", ep_rew)
+                if aggregator and "Game/ep_len_avg" in aggregator:
+                    aggregator.update("Game/ep_len_avg", ep_len)
+                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+        pending.clear()
 
     def _ckpt_state():
         # shared by the periodic checkpoint and the preemption emergency save so
@@ -290,18 +341,43 @@ def main(runtime, cfg: Dict[str, Any]):
                 policy_step += n_envs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # raw obs + prev actions straight into the player jit (see
-                    # RecurrentPPOPlayer.act_raw): one dispatch per env step
-                    cat_actions, env_actions, logprobs, values, states, player_rng = player.act_raw(
+                    # ONE packed host->device transfer per step: obs plus the
+                    # previous step's rewards/dones; prev actions/states already
+                    # live on the device (see RecurrentPPOPlayer.act_packed)
+                    packed = codec.encode(
                         next_obs,
+                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                        if pending
+                        else zero_extra,
+                    )
+                    cat_actions, env_actions, logprobs, values, states, player_rng = player.act_packed(
+                        codec,
+                        packed,
                         prev_actions,
                         prev_states,
                         player_rng,
                     )
                     real_actions = np.asarray(env_actions)
-                    obs, rewards, terminated, truncated, info = envs.step(
-                        real_actions.reshape(envs.action_space.shape)
-                    )
+                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                    # ---- overlap window: env workers are stepping; close out the
+                    # previous step and scatter this one's policy row in-graph
+                    _process_pending(packed)
+                    if device_rollout:
+                        # policy outputs + the recurrent state that PRODUCED this
+                        # step: all scattered in-graph, no per-step host pull
+                        rb.add_policy(
+                            {
+                                "values": jnp.reshape(values, (n_envs, 1)),
+                                "actions": jnp.reshape(cat_actions, (n_envs, -1)),
+                                "logprobs": jnp.reshape(logprobs, (n_envs, 1)),
+                                "prev_hx": jnp.reshape(prev_states[0], (n_envs, -1)),
+                                "prev_cx": jnp.reshape(prev_states[1], (n_envs, -1)),
+                                "prev_actions": jnp.reshape(jnp.asarray(prev_actions), (n_envs, -1)),
+                            }
+                        )
+
+                    obs, rewards, terminated, truncated, info = stepper.step_wait()
                     rewards = np.asarray(rewards, dtype=np.float32)
                     # bootstrap on truncation (reference ppo_recurrent.py:312-336)
                     truncated_envs = np.nonzero(truncated)[0]
@@ -324,41 +400,29 @@ def main(runtime, cfg: Dict[str, Any]):
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.float32)
                     rewards = rewards.reshape(n_envs, -1)
 
+                # env products become the next step's pending work (the row write
+                # and episode accounting run in the NEXT overlap window); the
+                # act-time recurrent state is captured before the feedback below
+                pending.update(
+                    packed=packed,
+                    rewards=rewards,
+                    dones=dones,
+                    info=info,
+                    values=values,
+                    cat_actions=cat_actions,
+                    logprobs=logprobs,
+                    prev_hx=prev_states[0],
+                    prev_cx=prev_states[1],
+                    prev_actions=prev_actions,
+                )
+
                 if device_rollout:
-                    # policy outputs + the recurrent state that PRODUCED this step:
-                    # all scattered in-graph, no per-step host pull
-                    rb.add_policy(
-                        {
-                            "values": jnp.reshape(values, (n_envs, 1)),
-                            "actions": jnp.reshape(cat_actions, (n_envs, -1)),
-                            "logprobs": jnp.reshape(logprobs, (n_envs, 1)),
-                            "prev_hx": jnp.reshape(prev_states[0], (n_envs, -1)),
-                            "prev_cx": jnp.reshape(prev_states[1], (n_envs, -1)),
-                            "prev_actions": jnp.reshape(jnp.asarray(prev_actions), (n_envs, -1)),
-                        }
-                    )
-                    rb.add_env(
-                        {
-                            "rewards": rewards,
-                            "dones": dones,
-                            **{k: next_obs[k] for k in obs_keys},
-                        }
-                    )
-                    # prev action feedback stays device-side (dones ride up with the
-                    # packed env put's sibling transfer; small and async)
+                    # prev action feedback stays device-side (the dones put is
+                    # small and async)
                     prev_actions = jnp.asarray(1.0 - dones, dtype=jnp.float32) * jnp.reshape(
                         cat_actions, (n_envs, -1)
                     )
                 else:
-                    step_data["dones"] = dones[np.newaxis]
-                    step_data["values"] = np.asarray(values)[np.newaxis].reshape(1, n_envs, 1)
-                    step_data["actions"] = np.asarray(cat_actions).reshape(1, n_envs, -1)
-                    step_data["logprobs"] = np.asarray(logprobs).reshape(1, n_envs, 1)
-                    step_data["rewards"] = rewards[np.newaxis]
-                    step_data["prev_hx"] = np.asarray(prev_states[0]).reshape(1, n_envs, -1)
-                    step_data["prev_cx"] = np.asarray(prev_states[1]).reshape(1, n_envs, -1)
-                    step_data["prev_actions"] = np.asarray(prev_actions).reshape(1, n_envs, -1)
-                    rb.add(step_data, validate_args=cfg.buffer.validate_args)
                     prev_actions = (1 - dones) * np.asarray(cat_actions).reshape(n_envs, -1)
 
                 # reset recurrent state on done (reference :356-371)
@@ -373,16 +437,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     _obs = obs[k]
                     if k in cnn_keys:
                         _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                    step_data[k] = _obs[np.newaxis]
                     next_obs[k] = _obs
 
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+            with timer("Time/env_interaction_time", SumMetric()):
+                # flush: the rollout's last row has no next act transfer to ride
+                _process_pending(None)
 
             # device path: ONE bulk de-layout pull feeds the host-side episode
             # chunking (variable-length episode splitting is inherently host work)
@@ -422,14 +481,21 @@ def main(runtime, cfg: Dict[str, Any]):
                     jnp.float32(cfg.algo.ent_coef),
                 )
                 player.params = params_sync.pull(flat_params, runtime.player_device)
-                if not timer.disabled:
-                    jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+                if not timer.disabled:  # sync only when the train phase is being timed
+                    jax.block_until_ready(params)
             train_step += world_size
 
             if cfg.metric.log_level > 0:
                 if aggregator:
                     aggregator.update_from_device(train_metrics)
                 if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    overlap_s, overlap_steps = stepper.drain_overlap()
+                    if overlap_s > 0:
+                        sps_overlap = overlap_steps * n_envs * cfg.env.action_repeat / overlap_s
+                        if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                            aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                        else:
+                            logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                     if aggregator and not aggregator.disabled:
                         logger.log_metrics(aggregator.compute(), policy_step)
                         aggregator.reset()

@@ -32,6 +32,7 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, pipeline_enabled
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher
 from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
@@ -296,6 +297,10 @@ def main(runtime, cfg: Dict[str, Any]):
     train_calls = 0
     obs = envs.reset(seed=cfg.seed)[0]
     obs_vec = np.concatenate([np.asarray(obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1)
+    # software pipeline (core/pipeline.py): the env workers step while the chip
+    # runs the training phase below — the prefetcher already samples one train
+    # call behind, so training never depended on the in-flight row anyway
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
 
     for iter_num in range(start_iter, total_iters + 1):
         profiler.step(policy_step)
@@ -306,49 +311,63 @@ def main(runtime, cfg: Dict[str, Any]):
                 actions = envs.action_space.sample()
             else:
                 player_rng, act_key = jax.random.split(player_rng)
+                # SAC's obs is a single flat vector: one small put per step (the
+                # PPO-style packed codec would be the same single transfer)
                 actions = np.asarray(
                     player.get_actions(jax.device_put(obs_vec, runtime.player_device), act_key)
                 )
-            next_obs, rewards, terminated, truncated, info = envs.step(
-                actions.reshape(envs.action_space.shape)
-            )
-            next_obs_vec = np.concatenate(
-                [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
-            )
-            # the real next obs for terminated envs is in final_obs (SAME_STEP autoreset)
-            real_next_obs = next_obs_vec.copy()
-            if "final_obs" in info:
-                for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
-                    if fo is not None:
-                        real_next_obs[idx] = np.concatenate(
-                            [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
-                        )
+            stepper.step_async(actions.reshape(envs.action_space.shape))
 
-        step_data = {
-            "terminated": np.asarray(terminated).reshape(1, n_envs, -1).astype(np.uint8),
-            "truncated": np.asarray(truncated).reshape(1, n_envs, -1).astype(np.uint8),
-            "actions": np.asarray(actions).reshape(1, n_envs, -1).astype(np.float32),
-            "observations": obs_vec[np.newaxis],
-            "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
-        }
-        if not cfg.buffer.sample_next_obs:
-            step_data["next_observations"] = real_next_obs[np.newaxis]
-        with prefetcher.guard():  # no torn rows under the worker's concurrent sample
-            rb.add(step_data, validate_args=cfg.buffer.validate_args)
-        obs_vec = next_obs_vec
+        env_step_done = False
 
-        if cfg.metric.log_level > 0:
-            for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
-                if aggregator and "Rewards/rew_avg" in aggregator:
-                    aggregator.update("Rewards/rew_avg", ep_rew)
-                if aggregator and "Game/ep_len_avg" in aggregator:
-                    aggregator.update("Game/ep_len_avg", ep_len)
-                runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
+        def _finish_env_step():
+            nonlocal env_step_done, obs_vec
+            if env_step_done:
+                return
+            env_step_done = True
+            with timer("Time/env_interaction_time", SumMetric()):
+                next_obs, rewards, terminated, truncated, info = stepper.step_wait()
+                next_obs_vec = np.concatenate(
+                    [np.asarray(next_obs[k], dtype=np.float32).reshape(n_envs, -1) for k in mlp_keys], -1
+                )
+                # real next obs for terminated envs is in final_obs (SAME_STEP autoreset)
+                real_next_obs = next_obs_vec.copy()
+                if "final_obs" in info:
+                    for idx, fo in enumerate(np.asarray(info["final_obs"], dtype=object)):
+                        if fo is not None:
+                            real_next_obs[idx] = np.concatenate(
+                                [np.asarray(fo[k], dtype=np.float32).reshape(-1) for k in mlp_keys], -1
+                            )
+            step_data = {
+                "terminated": np.asarray(terminated).reshape(1, n_envs, -1).astype(np.uint8),
+                "truncated": np.asarray(truncated).reshape(1, n_envs, -1).astype(np.uint8),
+                "actions": np.asarray(actions).reshape(1, n_envs, -1).astype(np.float32),
+                "observations": obs_vec[np.newaxis],
+                "rewards": np.asarray(rewards, dtype=np.float32).reshape(1, n_envs, -1),
+            }
+            if not cfg.buffer.sample_next_obs:
+                step_data["next_observations"] = real_next_obs[np.newaxis]
+            with prefetcher.guard():  # no torn rows under the worker's concurrent sample
+                rb.add(step_data, validate_args=cfg.buffer.validate_args)
+            obs_vec = next_obs_vec
+            if cfg.metric.log_level > 0:
+                for i, (ep_rew, ep_len) in enumerate(finished_episodes(info)):
+                    if aggregator and "Rewards/rew_avg" in aggregator:
+                        aggregator.update("Rewards/rew_avg", ep_rew)
+                    if aggregator and "Game/ep_len_avg" in aggregator:
+                        aggregator.update("Game/ep_len_avg", ep_len)
+                    runtime.print(f"Rank-0: policy_step={policy_step}, reward_env_{i}={ep_rew}")
 
-        # ---- training phase. ``algo.train_every > 1`` batches several iterations'
-        # gradient steps into one jitted call (Ratio keeps the step accounting exact):
-        # on remote accelerators every dispatched program costs fixed round-trip
-        # overhead, so fusing N iterations' updates divides that overhead by N at the
+        if not rb.full and getattr(rb, "_pos", 0) < 2:
+            # too few stored rows to sample from: complete the env step serially
+            # before the first train calls (startup edge only)
+            _finish_env_step()
+
+        # ---- overlap window: env workers step while the chip trains.
+        # ``algo.train_every > 1`` batches several iterations' gradient steps into
+        # one jitted call (Ratio keeps the step accounting exact): on remote
+        # accelerators every dispatched program costs fixed round-trip overhead,
+        # so fusing N iterations' updates divides that overhead by N at the
         # price of params being up to N-1 env steps staler for replay writes.
         if iter_num >= learning_starts and (
             train_every <= 1 or iter_num % train_every == 0 or iter_num == total_iters
@@ -382,11 +401,20 @@ def main(runtime, cfg: Dict[str, Any]):
                     cumulative_grad_steps += g
                 train_step += world_size * g
 
+        _finish_env_step()
+
         if cfg.metric.log_level > 0 and policy_step > 0:
             if iter_num >= learning_starts and "train_metrics" in dir():
                 if aggregator:
                     aggregator.update_from_device(train_metrics)
             if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                overlap_s, overlap_steps = stepper.drain_overlap()
+                if overlap_s > 0:
+                    sps_overlap = overlap_steps * n_envs * cfg.env.action_repeat / overlap_s
+                    if aggregator and "Time/sps_pipeline_overlap" in aggregator:
+                        aggregator.update("Time/sps_pipeline_overlap", sps_overlap)
+                    else:
+                        logger.log_metrics({"Time/sps_pipeline_overlap": sps_overlap}, policy_step)
                 if cumulative_grad_steps > 0:
                     logger.log_metrics(
                         {"Params/replay_ratio": cumulative_grad_steps * world_size / policy_step}, policy_step

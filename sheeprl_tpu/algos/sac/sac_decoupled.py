@@ -323,7 +323,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         rng, train_key = jax.random.split(rng)
                         player_params, train_metrics = trainer_step((batches, np.asarray(train_key)))
                         if is_player:
-                            jax.block_until_ready(player_params)
+                            if not timer.disabled:  # fence ONLY when the train phase is timed
+                                jax.block_until_ready(player_params)
                             player.params = player_params
                         cumulative_grad_steps += per_rank_gradient_steps
                         train_step += trainer_world * per_rank_gradient_steps

@@ -311,6 +311,7 @@ class SupervisedVectorEnv:
         self._last_reset_seed: Any = None
         self.counters: Dict[str, int] = {"Resilience/env_restarts": 0, "Resilience/env_timeouts": 0}
         self._drained: Dict[str, int] = dict.fromkeys(self.counters, 0)
+        self._async_recovery: Any = None
         self.venv = self._make()
 
     def __getattr__(self, name: str) -> Any:
@@ -338,6 +339,43 @@ class SupervisedVectorEnv:
     def step(self, actions):
         try:
             obs, rewards, terminated, truncated, info = self.venv.step(actions)
+        except self._TIMEOUT_ERRORS as err:
+            return self._recover_from_hang(err)
+        self._count_worker_restarts(info)
+        return obs, rewards, terminated, truncated, info
+
+    @property
+    def supports_step_async(self) -> bool:
+        """True when the wrapped vector env exposes the async step split (the
+        pipelined loops check this instead of hasattr: this class defines
+        step_async unconditionally, but a SyncVectorEnv underneath can't)."""
+        return hasattr(self.venv, "step_async") and hasattr(self.venv, "step_wait")
+
+    def step_async(self, actions) -> None:
+        """Supervised half of the async split: dispatch to the workers.
+
+        Without these explicit methods ``__getattr__`` would hand callers the
+        RAW venv's step_async/step_wait, silently dropping hang recovery and
+        restart accounting under the pipelined loops. A deadline trip during
+        dispatch recovers immediately; the rebuilt-env transition is parked and
+        returned by the matching ``step_wait``.
+        """
+        try:
+            self.venv.step_async(actions)
+        except self._TIMEOUT_ERRORS as err:
+            self._async_recovery = self._recover_from_hang(err)
+            return
+        self._async_recovery = None
+
+    def step_wait(self):
+        """Supervised completion: same timeout/restart semantics as ``step``
+        (the per-step deadline lives in the venv's ``step_wait``, so hangs
+        surface here even though the dispatch already happened)."""
+        if self._async_recovery is not None:
+            out, self._async_recovery = self._async_recovery, None
+            return out
+        try:
+            obs, rewards, terminated, truncated, info = self.venv.step_wait()
         except self._TIMEOUT_ERRORS as err:
             return self._recover_from_hang(err)
         self._count_worker_restarts(info)

@@ -72,9 +72,15 @@ class DeviceRolloutBuffer:
         self._buf: Optional[Dict[str, jax.Array]] = None
         self._meta: Dict[str, _LeafMeta] = {}
         self._t = 0  # host-side write cursor (rows fully written)
+        # device-resident mirror of the cursor: the policy write's row index must
+        # ride as a DEVICE scalar (a host np.int32 arg is an implicit per-step
+        # host->device transfer — it trips jax.transfer_guard and costs a
+        # dispatch on remote transports); env writes return it incremented
+        self._t_dev: Optional[jax.Array] = None
         # jit caches keyed by the write's key signature: one compile per key set
         self._policy_write_fns: Dict[Any, Any] = {}
         self._env_write_fns: Dict[Any, Any] = {}
+        self._packed_env_write_fns: Dict[Any, Any] = {}
 
     # ----- properties -------------------------------------------------------------------
     @property
@@ -143,6 +149,13 @@ class DeviceRolloutBuffer:
                 "before writing the next iteration's steps"
             )
 
+    def _cursor(self) -> jax.Array:
+        """Device-resident row index: ONE explicit put per iteration (when the
+        cursor is first needed after an alloc/reset), then device-only."""
+        if self._t_dev is None:
+            self._t_dev = jax.device_put(np.int32(self._t), self._device)
+        return self._t_dev
+
     # ----- policy write path (device -> device, in-graph) -------------------------------
     def _policy_write_fn(self, keys_sig):
         if keys_sig not in self._policy_write_fns:
@@ -164,13 +177,13 @@ class DeviceRolloutBuffer:
         The inputs are the player jit's outputs — already on the buffer's device —
         and the scatter is a donated jitted ``dynamic_update_slice``: no host
         round-trip, no transfer, in-place in HBM. The row index rides as a traced
-        int32 scalar so every step reuses one compile.
+        DEVICE int32 scalar (one compile for every step, zero per-step transfers).
         """
         self._check_open_row()
         self._ensure(outputs)
         keys_sig = tuple(sorted(outputs))
         sub = {k: self._buf[k] for k in keys_sig}
-        out = self._policy_write_fn(keys_sig)(sub, np.int32(self._t), {k: outputs[k] for k in keys_sig})
+        out = self._policy_write_fn(keys_sig)(sub, self._cursor(), {k: outputs[k] for k in keys_sig})
         self._buf.update(out)
 
     # ----- env write path (host -> device, ONE packed transfer) -------------------------
@@ -205,10 +218,11 @@ class DeviceRolloutBuffer:
                 rows = {
                     key: decode_f32(B * metas[key].flat, (1, B, *metas[key].feat)) for key in keys_sig
                 }
-                return {
+                written = {
                     key: jax.lax.dynamic_update_slice_in_dim(buf[key], rows[key], t, axis=0)
                     for key in buf
                 }
+                return written, t + 1  # incremented cursor stays device-resident
 
             self._env_write_fns[keys_sig] = jax.jit(write, donate_argnums=(0,))
         return self._env_write_fns[keys_sig]
@@ -231,7 +245,67 @@ class DeviceRolloutBuffer:
                 )
         sub = {k: self._buf[k] for k in keys_sig}
         packed = jax.device_put(self._pack({k: data[k] for k in keys_sig}), self._device)
-        out = self._env_write_fn(keys_sig)(sub, packed)
+        out, self._t_dev = self._env_write_fn(keys_sig)(sub, packed)
+        self._buf.update(out)
+        self._t += 1
+
+    # ----- env write path from codec-packed transfers (ZERO extra transfers) ------------
+    def _ensure_from_codec(self, codec) -> None:
+        obs_sig, extra_sig, _ = codec.signature
+        for k, spec in (*obs_sig, *extra_sig):
+            if k in self._meta and k in (self._buf or {}):
+                continue
+            if spec.shape[0] != self._B:
+                raise ValueError(
+                    f"packed rollout leaf '{k}' must be [n_envs={self._B}, *feat]; got {spec.shape}"
+                )
+            if self._buf is None:
+                self._buf = {}
+            if k in self._meta:  # re-allocation after a rollout() handoff
+                full_shape = (self._T, self._B, *self._meta[k].feat)
+                self._buf[k] = jax.jit(
+                    partial(jnp.zeros, full_shape, jnp.float32),
+                    out_shardings=None
+                    if self._device is None
+                    else jax.sharding.SingleDeviceSharding(self._device),
+                )()
+            else:
+                self._alloc_leaf(k, spec.shape[1:])
+
+    def _packed_env_write_fn(self, codec, extra_only: bool):
+        sig = (id(codec), bool(extra_only), codec.signature)
+        if sig not in self._packed_env_write_fns:
+
+            def write(buf, t, obs_packed, extra_packed):
+                rows = dict(codec.decode_obs_raw(obs_packed))
+                rows.update(codec.decode_extra(extra_packed, extra_only=extra_only))
+                return {
+                    key: jax.lax.dynamic_update_slice_in_dim(buf[key], rows[key][None], t, axis=0)
+                    for key in buf
+                }, t + 1
+
+            self._packed_env_write_fns[sig] = jax.jit(write, donate_argnums=(0,))
+        return self._packed_env_write_fns[sig]
+
+    def add_env_packed(self, codec, obs_packed: jax.Array, extra_packed: jax.Array, extra_only: bool = False) -> None:
+        """Close the current row from codec-packed buffers ALREADY on device.
+
+        The pipelined loops transfer each step's obs once, for the act dispatch
+        (``PackedObsCodec.encode`` with the previous step's rewards/dones riding
+        as extra leaves); this write re-reads that same device buffer — obs from
+        the PREVIOUS step's put, rewards/dones from the current one — so closing
+        a row costs zero additional host->device transfers. ``extra_only=True``
+        is the end-of-rollout flush, where the last step's env products arrive
+        in a short ``encode_extra_only`` buffer instead.
+        """
+        self._check_open_row()
+        self._ensure_from_codec(codec)
+        obs_sig, extra_sig, _ = codec.signature
+        keys = tuple(k for k, _ in (*obs_sig, *extra_sig))
+        sub = {k: self._buf[k] for k in keys}
+        out, self._t_dev = self._packed_env_write_fn(codec, extra_only)(
+            sub, self._cursor(), obs_packed, extra_packed
+        )
         self._buf.update(out)
         self._t += 1
 
@@ -250,7 +324,7 @@ class DeviceRolloutBuffer:
             )
         if self._buf is None:  # T rows counted but nothing ever written
             raise RuntimeError("empty rollout buffer")
-        out, self._buf, self._t = self._buf, None, 0
+        out, self._buf, self._t, self._t_dev = self._buf, None, 0, None
         return out
 
     def rollout_host(self) -> Dict[str, np.ndarray]:
@@ -267,6 +341,7 @@ class DeviceRolloutBuffer:
         """Drop any partial rollout (crash-restart / resume path)."""
         self._buf = None
         self._t = 0
+        self._t_dev = None
 
     # ----- checkpointing ----------------------------------------------------------------
     def state_dict(self) -> Dict[str, Any]:
@@ -295,7 +370,7 @@ class DeviceRolloutBuffer:
                 )
             self._buf = {}
             self._meta = {}
-            self._policy_write_fns, self._env_write_fns = {}, {}
+            self._policy_write_fns, self._env_write_fns, self._packed_env_write_fns = {}, {}, {}
             for k, v in host.items():
                 arr = np.asarray(v, dtype=np.float32)
                 self._alloc_leaf(k, arr.shape[2:])

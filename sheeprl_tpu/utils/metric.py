@@ -102,6 +102,24 @@ class LastMetric(Metric):
         self._last = math.nan
 
 
+def _acc_step(state, vec):
+    """One donated device-side accumulation step: (sum, max, last) <- vec."""
+    s, mx, last = state
+    return s + vec, jnp.maximum(mx, vec), vec
+
+
+_ACC_STEP = jax.jit(_acc_step, donate_argnums=(0,))
+
+# materializes a fresh buffer: the initial (sum, max, last) state must be three
+# DISTINCT buffers or the next donated step would donate one buffer three times
+_ACC_COPY = jax.jit(lambda v: v + 0)
+
+# metric classes whose window result is recoverable from (sum, max, last, count)
+# — custom subclasses fall back to the immediate-pull path so their update()
+# still sees every raw value
+_DRAINABLE = (MeanMetric, SumMetric, MaxMetric, LastMetric)
+
+
 class MetricAggregator:
     """Dict of metrics with a class-level kill switch.
 
@@ -114,6 +132,8 @@ class MetricAggregator:
     def __init__(self, metrics: Optional[Mapping[str, Any]] = None, raise_on_missing: bool = False):
         self.metrics: Dict[str, Metric] = {}
         self._raise_on_missing = raise_on_missing
+        # device-side accumulators: keys-signature -> [(sum, max, last) device vecs, count]
+        self._device_acc: Dict[tuple, list] = {}
         for key, value in (metrics or {}).items():
             self.add(key, value)
 
@@ -138,29 +158,71 @@ class MetricAggregator:
         self.metrics[name].update(value)
 
     def update_from_device(self, metrics: Mapping[str, Any]) -> None:
-        """Update from a dict of (possibly device-resident) scalars with ONE pull.
+        """Accumulate a dict of (possibly device-resident) scalars with NO pull.
 
         A per-key ``float(device_scalar)`` pays a full synchronous host<->device
         round-trip EACH (~140ms on a tunneled TPU; a 13-metric train dict cost
-        ~1.8s per iteration, measured via jax.profiler). Stacking on device and
-        fetching once makes metric logging O(1) round-trips.
+        ~1.8s per iteration, measured via jax.profiler). Even a single stacked
+        ``np.asarray`` per call still blocks the host once per iteration, so the
+        values stay ON DEVICE in a donated (sum, max, last) accumulator and are
+        pulled exactly once per log window, when :meth:`compute` drains it — the
+        interaction loop's only blocking sync stays the action fetch.
 
         Unregistered keys are always filtered, never raised on: callers pass the
         train step's full metric dict, whose keys are a superset of whatever
         subset the user registered (``raise_on_missing`` still guards the
-        single-key ``update``).
+        single-key ``update``). Custom Metric subclasses (whose window result
+        may not be recoverable from sum/max/last) keep the immediate stacked
+        pull.
         """
         if self.disabled or not metrics:
             return
         keys = [k for k in metrics if k in self.metrics]
         if not keys:
             return
-        vals = [metrics[k] for k in keys]
-        if any(isinstance(v, jax.Array) for v in vals):
-            host = np.asarray(jnp.stack([jnp.asarray(v, dtype=jnp.float32) for v in vals]))
-            vals = host.tolist()
-        for k, v in zip(keys, vals):
-            self.metrics[k].update(float(v))
+        if not any(isinstance(metrics[k], jax.Array) for k in keys):
+            for k in keys:
+                self.metrics[k].update(_to_float(metrics[k]))
+            return
+        deferred = tuple(k for k in keys if type(self.metrics[k]) in _DRAINABLE)
+        immediate = [k for k in keys if k not in set(deferred)]
+        if immediate:
+            host = np.asarray(
+                jnp.stack([jnp.asarray(metrics[k], dtype=jnp.float32).mean() for k in immediate])
+            )
+            for k, v in zip(immediate, host.tolist()):
+                self.metrics[k].update(float(v))
+        if deferred:
+            # eager stack: pure device work, dispatched async, never syncs host
+            vec = jnp.stack([jnp.asarray(metrics[k], dtype=jnp.float32).mean() for k in deferred])
+            acc = self._device_acc.get(deferred)
+            if acc is None:
+                self._device_acc[deferred] = [(vec, _ACC_COPY(vec), _ACC_COPY(vec)), 1]
+            else:
+                acc[0] = _ACC_STEP(acc[0], vec)
+                acc[1] += 1
+
+    def _drain_device_acc(self) -> None:
+        """ONE device->host pull per keys-signature: fold the window's device
+        accumulator into the host metrics (log-boundary only)."""
+        if not self._device_acc:
+            return
+        for sig, (state, count) in self._device_acc.items():
+            sums, maxes, lasts = (np.asarray(a) for a in jax.device_get(state))
+            for i, k in enumerate(sig):
+                m = self.metrics.get(k)
+                if m is None:  # popped since accumulation
+                    continue
+                kind = type(m)
+                if kind is SumMetric:
+                    m.update(float(sums[i]))
+                elif kind is MaxMetric:
+                    m.update(float(maxes[i]))
+                elif kind is LastMetric:
+                    m.update(float(lasts[i]))
+                else:  # MeanMetric: one update carrying the window mean
+                    m.update(float(sums[i]) / count)
+        self._device_acc.clear()
 
     def __contains__(self, name: str) -> bool:
         return name in self.metrics
@@ -169,12 +231,14 @@ class MetricAggregator:
         self.metrics.pop(name, None)
 
     def reset(self) -> None:
+        self._device_acc.clear()
         for m in self.metrics.values():
             m.reset()
 
     def compute(self) -> Dict[str, float]:
         if self.disabled:
             return {}
+        self._drain_device_acc()
         out: Dict[str, float] = {}
         for name, m in self.metrics.items():
             value = m.compute()

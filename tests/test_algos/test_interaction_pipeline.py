@@ -1,0 +1,324 @@
+"""The pipelined interaction loop must change scheduling, not semantics.
+
+Three contracts from core/pipeline.py:
+
+- ``PackedObsCodec.decode_obs`` is bit-identical to the per-key
+  ``device_put`` + normalize path it replaced (cnn / mlp / mixed obs dicts).
+- A steady-state pipelined PPO iteration performs EXACTLY the budgeted
+  host<->device transfers: one packed obs put and one action fetch. The window
+  between two consecutive ``step_async`` dispatches runs under
+  ``jax.transfer_guard("disallow")`` (any implicit transfer raises) with the
+  explicit entry points counted.
+- Pipeline on vs off produces bit-identical trajectories over async env
+  workers under a fixed seed: identical train-fn inputs and post-update params
+  for PPO, identical replay-buffer rows for dreamer_v3.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import sheeprl_tpu.algos.dreamer_v3.dreamer_v3 as dv3_module
+import sheeprl_tpu.algos.ppo.ppo as ppo_module
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec
+from sheeprl_tpu.data.prefetch import InlineSampler
+
+
+def _args(standard_args, *extra):
+    """standard_args with any key re-specified in ``extra`` dropped (hydra
+    rejects duplicate value overrides)."""
+    keys = {e.split("=", 1)[0].lstrip("+~") for e in extra}
+    return [a for a in standard_args if a.split("=", 1)[0].lstrip("+~") not in keys] + list(extra)
+
+
+# ----- PackedObsCodec: one-put path bit-identical to the per-key path -----------------
+
+
+def _reference_decode(obs, cnn_keys, n_envs):
+    """The pre-pipeline path: per-key device_put, normalize in a jitted fn."""
+
+    def normalize(o):
+        out = {}
+        for k, v in o.items():
+            leaf = v.astype(jnp.float32)
+            if k in cnn_keys:
+                out[k] = leaf.reshape(n_envs, -1, *v.shape[-2:]) / 255.0 - 0.5
+            else:
+                out[k] = leaf.reshape(n_envs, -1)
+        return out
+
+    return jax.jit(normalize)({k: jax.device_put(v) for k, v in obs.items()})
+
+
+@pytest.mark.parametrize("case", ["cnn", "mlp", "mixed"])
+def test_packed_codec_matches_per_key_path(case):
+    n_envs = 3
+    rng = np.random.default_rng(0)
+    obs, cnn_keys = {}, []
+    if case in ("cnn", "mixed"):
+        obs["rgb"] = rng.integers(0, 256, (n_envs, 12, 8, 8), dtype=np.uint8)
+        cnn_keys.append("rgb")
+    if case in ("mlp", "mixed"):
+        obs["state"] = rng.standard_normal((n_envs, 10)).astype(np.float32)
+
+    codec = PackedObsCodec(cnn_keys=cnn_keys)
+    decoded = jax.jit(codec.decode_obs)(codec.encode(obs))
+    ref = _reference_decode(obs, cnn_keys, n_envs)
+
+    assert set(decoded) == set(obs)
+    for k in sorted(obs):
+        np.testing.assert_array_equal(
+            np.asarray(decoded[k]), np.asarray(ref[k]), err_msg=f"packed leaf '{k}' diverged"
+        )
+
+
+def test_packed_codec_extra_leaves_roundtrip():
+    """Extras ride the obs transfer un-normalized, and survive the short
+    extra-only flush buffer with the same layout."""
+    obs = {"state": np.arange(6, dtype=np.float32).reshape(2, 3)}
+    extra = {
+        "rewards": np.asarray([[1.5], [-2.5]], np.float32),
+        "dones": np.asarray([[0.0], [1.0]], np.float32),
+    }
+    codec = PackedObsCodec()
+    packed = codec.encode(obs, extra=extra)
+    dec = jax.jit(codec.decode_extra)(packed)
+    for k in extra:
+        np.testing.assert_array_equal(np.asarray(dec[k]), extra[k])
+
+    flush = codec.encode_extra_only(extra)
+    dec_flush = jax.jit(lambda p: codec.decode_extra(p, extra_only=True))(flush)
+    for k in extra:
+        np.testing.assert_array_equal(np.asarray(dec_flush[k]), extra[k])
+
+
+# ----- transfer budget: one put + one fetch per steady-state pipelined step -----------
+
+_PPO_ARGS = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "fabric.devices=1",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=2",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+    "seed=7",
+]
+
+
+def test_ppo_pipelined_steady_state_transfer_budget(standard_args, tmp_path, monkeypatch):
+    """Between step_async #3 and #4 (one full steady-state iteration: previous
+    row close, step_wait, next encode + act + fetch) the loop may perform
+    exactly ONE explicit jax.device_put (the packed obs) and ONE host pull of a
+    jax array (the env actions); jax.transfer_guard makes anything implicit
+    raise instead of silently widening the budget."""
+    monkeypatch.chdir(tmp_path)
+    counts = {"put": 0, "pull": 0, "dispatch": 0}
+    active = [False]
+    stack = contextlib.ExitStack()
+    real_step_async = AsyncEnvStepper.step_async
+    real_put = jax.device_put
+    real_asarray = np.asarray
+
+    def counting_put(x, *args, **kwargs):
+        if active[0]:
+            counts["put"] += 1
+        return real_put(x, *args, **kwargs)
+
+    def counting_asarray(obj, *args, **kwargs):
+        if active[0] and isinstance(obj, jax.Array):
+            counts["pull"] += 1
+        return real_asarray(obj, *args, **kwargs)
+
+    def windowed_step_async(self, actions):
+        counts["dispatch"] += 1
+        if counts["dispatch"] == 4 and active[0]:
+            active[0] = False
+            stack.close()
+        real_step_async(self, actions)
+        if counts["dispatch"] == 3:
+            stack.enter_context(jax.transfer_guard("disallow"))
+            active[0] = True
+
+    try:
+        with monkeypatch.context() as m:
+            m.setattr(AsyncEnvStepper, "step_async", windowed_step_async)
+            m.setattr(jax, "device_put", counting_put)
+            m.setattr(np, "asarray", counting_asarray)
+            run(
+                overrides=_args(
+                    standard_args, *_PPO_ARGS, "env.sync_env=False", "buffer.backend=device"
+                )
+            )
+    finally:
+        if active[0]:
+            active[0] = False
+            stack.close()
+
+    assert counts["dispatch"] >= 4, "never reached the steady-state window"
+    assert counts["put"] == 1, f"expected 1 packed obs put in the window, saw {counts['put']}"
+    assert counts["pull"] == 1, f"expected 1 action fetch in the window, saw {counts['pull']}"
+
+
+# ----- pipeline on/off parity: PPO train-fn inputs --------------------------------------
+
+
+def _capture_ppo(standard_args, pipelined, monkeypatch):
+    captured = []
+    real_make_train_fn = ppo_module.make_train_fn
+
+    def spy_make_train_fn(*args, **kwargs):
+        train_fn = real_make_train_fn(*args, **kwargs)
+
+        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef):
+            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+            captured.append(
+                {
+                    "data": {k: np.asarray(jax.device_get(v)) for k, v in data.items()},
+                    "next_values": np.asarray(jax.device_get(next_values)),
+                    "params": jax.device_get(out[0]),
+                }
+            )
+            return out
+
+        return wrapped
+
+    with monkeypatch.context() as m:
+        m.setattr(ppo_module, "make_train_fn", spy_make_train_fn)
+        run(
+            overrides=_args(
+                standard_args,
+                *_PPO_ARGS,
+                "env.sync_env=False",
+                f"algo.interaction_pipeline={pipelined}",
+            )
+        )
+    assert len(captured) == 1, f"expected exactly one train call, got {len(captured)}"
+    return captured[0]
+
+
+def test_ppo_pipeline_on_off_parity(standard_args, tmp_path, monkeypatch):
+    """Over async env workers under a fixed seed, flipping
+    algo.interaction_pipeline must not change what reaches the train fn."""
+    monkeypatch.chdir(tmp_path)
+    on = _capture_ppo(standard_args, True, monkeypatch)
+    off = _capture_ppo(standard_args, False, monkeypatch)
+
+    assert set(on["data"]) == set(off["data"])
+    for k in sorted(on["data"]):
+        np.testing.assert_array_equal(
+            on["data"][k], off["data"][k], err_msg=f"train-fn input '{k}' diverged across pipeline"
+        )
+    np.testing.assert_array_equal(on["next_values"], off["next_values"])
+
+    on_leaves = jax.tree_util.tree_leaves_with_path(on["params"])
+    off_leaves = dict(
+        (jax.tree_util.keystr(p), l) for p, l in jax.tree_util.tree_leaves_with_path(off["params"])
+    )
+    assert on_leaves and len(on_leaves) == len(off_leaves)
+    for path, leaf in on_leaves:
+        np.testing.assert_array_equal(
+            np.asarray(leaf),
+            np.asarray(off_leaves[jax.tree_util.keystr(path)]),
+            err_msg=f"post-update param {jax.tree_util.keystr(path)} diverged across pipeline",
+        )
+
+
+# ----- pipeline on/off parity: dreamer_v3 stored trajectories ---------------------------
+
+_DV3_ARGS = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "fabric.devices=1",
+    "algo.per_rank_batch_size=1",
+    "algo.per_rank_sequence_length=1",
+    "buffer.size=8",
+    "algo.learning_starts=0",
+    "algo.replay_ratio=1",
+    "algo.horizon=8",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.world_model.recurrent_model.recurrent_state_size=8",
+    "algo.world_model.representation_model.hidden_size=8",
+    "algo.world_model.transition_model.hidden_size=8",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "buffer.memmap=False",
+    "algo.run_test=False",
+    "seed=11",
+]
+
+
+def _capture_dv3_rows(standard_args, pipelined, monkeypatch):
+    """Run dreamer_v3 recording every rb.add row. The stock DevicePrefetcher
+    speculates batches from a worker thread (racing the loop's adds) and the
+    factory leaves the buffer rng unseeded, so batch content is nondeterministic
+    run-to-run; determinism is restored by swapping in a synchronous
+    InlineSampler and seeding the buffer — identically for both pipeline arms,
+    so the comparison isolates the pipeline switch."""
+    rows = []
+    real_make_sequential_replay = dv3_module.make_sequential_replay
+
+    def spy_make_sequential_replay(cfg, runtime, log_dir, obs_keys):
+        rb, prefetcher = real_make_sequential_replay(cfg, runtime, log_dir, obs_keys)
+        prefetcher.close()
+        rb.seed(0)
+        real_add = rb.add
+
+        def recording_add(data, *args, **kwargs):
+            idxes = args[0] if args else kwargs.get("indices")
+            rows.append(
+                (
+                    {k: np.array(v, copy=True) for k, v in data.items()},
+                    None if idxes is None else tuple(np.asarray(idxes).reshape(-1).tolist()),
+                )
+            )
+            return real_add(data, *args, **kwargs)
+
+        rb.add = recording_add
+        return rb, InlineSampler(rb.sample)
+
+    with monkeypatch.context() as m:
+        m.setattr(dv3_module, "make_sequential_replay", spy_make_sequential_replay)
+        run(
+            overrides=_args(
+                standard_args,
+                *_DV3_ARGS,
+                "env.sync_env=False",
+                f"algo.interaction_pipeline={pipelined}",
+            )
+        )
+    assert rows, "instrumentation never saw an rb.add"
+    return rows
+
+
+def test_dreamer_v3_pipeline_on_off_parity(standard_args, tmp_path, monkeypatch):
+    """Same contract as the PPO test for the off-policy/sequential-replay shape:
+    the rows dreamer_v3 writes to its replay buffer (content AND env indices)
+    must be bit-identical across the pipeline switch."""
+    monkeypatch.chdir(tmp_path)
+    on = _capture_dv3_rows(standard_args, True, monkeypatch)
+    off = _capture_dv3_rows(standard_args, False, monkeypatch)
+
+    assert len(on) == len(off), f"row count diverged: {len(on)} vs {len(off)}"
+    for i, ((row_on, idx_on), (row_off, idx_off)) in enumerate(zip(on, off)):
+        assert idx_on == idx_off, f"add #{i} env indices diverged: {idx_on} vs {idx_off}"
+        assert set(row_on) == set(row_off), f"add #{i} key set diverged"
+        for k in sorted(row_on):
+            np.testing.assert_array_equal(
+                row_on[k], row_off[k], err_msg=f"add #{i} leaf '{k}' diverged across pipeline"
+            )

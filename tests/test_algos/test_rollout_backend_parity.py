@@ -97,9 +97,16 @@ def _poison_policy_outputs(monkeypatch_ctx):
     """
     forbidden = {}  # id -> strong ref (keeps ids stable for the run's lifetime)
     real_act_raw = PPOPlayer.act_raw
+    real_act_packed = PPOPlayer.act_packed
 
     def spy_act_raw(self, obs, key, **kwargs):
         out = real_act_raw(self, obs, key, **kwargs)
+        forbidden[id(out[2])] = out[2]  # logprobs
+        forbidden[id(out[3])] = out[3]  # values
+        return out
+
+    def spy_act_packed(self, codec, packed, key, **kwargs):
+        out = real_act_packed(self, codec, packed, key, **kwargs)
         forbidden[id(out[2])] = out[2]  # logprobs
         forbidden[id(out[3])] = out[3]  # values
         return out
@@ -115,6 +122,7 @@ def _poison_policy_outputs(monkeypatch_ctx):
         return guarded
 
     monkeypatch_ctx.setattr(PPOPlayer, "act_raw", spy_act_raw)
+    monkeypatch_ctx.setattr(PPOPlayer, "act_packed", spy_act_packed)
     monkeypatch_ctx.setattr(np, "asarray", make_guard(np.asarray))
     monkeypatch_ctx.setattr(np, "array", make_guard(np.array))
     monkeypatch_ctx.setattr(jax, "device_get", make_guard(jax.device_get))
