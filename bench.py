@@ -264,6 +264,95 @@ def bench_smoke(total_steps: int = 128) -> dict:
     return result
 
 
+_COMPILE_CHILD = r"""
+import contextlib, json, os, sys, time
+t0 = time.perf_counter()
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import compile as jax_compile
+
+overrides = json.loads(os.environ["_SHEEPRL_BENCH_COMPILE_OVERRIDES"])
+with contextlib.redirect_stdout(sys.stderr):
+    run(overrides=overrides)
+stats = jax_compile.process_stats()
+train = jax_compile.find("ppo.train")
+print("BENCH_COMPILE " + json.dumps({
+    "wall_s": round(time.perf_counter() - t0, 3),
+    "first_train_step_s": round(train.first_call_s, 3) if train and train.first_call_s else None,
+    "cache_hits": stats["cache_hits"],
+    "cache_misses": stats["cache_misses"],
+    "compile_seconds": round(stats["compile_seconds"], 3),
+    "retraces": stats["retraces"],
+}), flush=True)
+"""
+
+
+def bench_compile(total_steps: int = 64) -> dict:
+    """Cold-vs-warm persistent-cache wall clock + time-to-first-train-step.
+
+    Runs the same tiny PPO workload twice in FRESH subprocesses against one
+    temporary on-disk compilation cache: the cold child populates it, the warm
+    child replays it. Subprocesses are the only honest measurement — in-process
+    repeats would hit jit's in-memory trace cache and time nothing. The child
+    reports ``first_train_step_s`` from the retrace guard's own first-call
+    clock (core/compile.py GuardedFn.first_call_s), i.e. process start ->
+    first fused train step returning, the latency the AOT warmup + persistent
+    cache exist to shrink.
+    """
+    import json as _json
+    import os
+    import subprocess
+    import tempfile
+
+    overrides = [
+        "exp=ppo",
+        f"algo.total_steps={total_steps}",
+        "algo.rollout_steps=16",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=1",
+        "env=dummy",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "metric.disable_timer=True",
+        "checkpoint.every=999999999",
+        "checkpoint.save_last=False",
+        "buffer.memmap=False",
+        "fabric.devices=1",
+    ]
+    result = {}
+    with tempfile.TemporaryDirectory(prefix="sheeprl_bench_cache_") as cache_dir:
+        env = dict(
+            os.environ,
+            SHEEPRL_TPU_COMP_CACHE_DIR=cache_dir,
+            SHEEPRL_TPU_COMP_CACHE_MIN_SECS="0",
+            _SHEEPRL_BENCH_COMPILE_OVERRIDES=_json.dumps(overrides),
+        )
+        for phase in ("cold", "warm"):
+            proc = subprocess.run(
+                [sys.executable, "-c", _COMPILE_CHILD], env=env, capture_output=True, text=True, timeout=1200
+            )
+            line = next((ln for ln in proc.stdout.splitlines() if ln.startswith("BENCH_COMPILE ")), None)
+            if proc.returncode != 0 or line is None:
+                result[f"compile_{phase}_error"] = (proc.stderr or proc.stdout)[-500:]
+                return result
+            child = _json.loads(line[len("BENCH_COMPILE "):])
+            result[f"compile_{phase}_wall_s"] = child["wall_s"]
+            result[f"compile_{phase}_first_train_step_s"] = child["first_train_step_s"]
+            result[f"compile_{phase}_cache_hits"] = child["cache_hits"]
+            result[f"compile_{phase}_cache_misses"] = child["cache_misses"]
+            result[f"compile_{phase}_compile_seconds"] = child["compile_seconds"]
+            result[f"compile_{phase}_retraces"] = child["retraces"]
+    if result.get("compile_cold_wall_s") and result.get("compile_warm_wall_s"):
+        result["compile_warm_speedup"] = round(
+            result["compile_cold_wall_s"] / result["compile_warm_wall_s"], 3
+        )
+    return result
+
+
 def _target_metric(target: str) -> str:
     """Headline metric name for a bench target — the watchdog's failure record
     must name the metric the selected target WOULD have produced, not hardcode
@@ -272,6 +361,7 @@ def _target_metric(target: str) -> str:
     return {
         "ppo": "ppo_cartpole_env_steps_per_sec",
         "dv3": "dv3_gsteps_per_sec",
+        "compile": "compile_warm_first_train_step_s",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -327,7 +417,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "all"),
+        choices=("ppo", "dv3", "compile", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -423,6 +513,17 @@ if __name__ == "__main__":
                     result.update(bench_dv3(batch=16, key_prefix="dv3_recipe"))
                 except Exception as e:
                     result["dv3_recipe_error"] = f"{type(e).__name__}: {e}"
+            if cli_args.target in ("compile", "all"):
+                try:
+                    comp = bench_compile()
+                    result.update(comp)
+                    if cli_args.target == "compile":
+                        result.setdefault("metric", headline_metric)
+                        result.setdefault("value", comp.get("compile_warm_first_train_step_s"))
+                        result.setdefault("unit", "s")
+                        result.setdefault("vs_baseline", comp.get("compile_warm_speedup"))
+                except Exception as e:  # a compile-bench failure must not lose the other numbers
+                    result["compile_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
         # numbers are real but from the CPU backend — flag them as incomparable
         result["cpu_fallback"] = True

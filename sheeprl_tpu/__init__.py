@@ -14,18 +14,24 @@ ROOT_DIR = os.path.dirname(os.path.abspath(__file__))
 
 # Persistent XLA compilation cache: first-compile of the jitted train steps costs
 # tens of seconds on TPU; later processes reuse the compiled executables. Opt out
-# with SHEEPRL_TPU_NO_COMP_CACHE=1.
+# with SHEEPRL_TPU_NO_COMP_CACHE=1. Settings the user already made (env vars,
+# jax config, the `compile:` Hydra group applied later by the CLI) win: only
+# fill gaps here, never overwrite.
 if not os.environ.get("SHEEPRL_TPU_NO_COMP_CACHE"):
     try:
         import jax
 
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get(
-                "SHEEPRL_TPU_COMP_CACHE_DIR",
+        if os.environ.get("SHEEPRL_TPU_COMP_CACHE_DIR"):
+            jax.config.update(
+                "jax_compilation_cache_dir", os.environ["SHEEPRL_TPU_COMP_CACHE_DIR"]
+            )
+        elif jax.config.jax_compilation_cache_dir is None:
+            jax.config.update(
+                "jax_compilation_cache_dir",
                 os.path.join(os.path.expanduser("~"), ".cache", "sheeprl_tpu_xla"),
-            ),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            )
+        min_secs = os.environ.get("SHEEPRL_TPU_COMP_CACHE_MIN_SECS")
+        if min_secs is not None:
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", float(min_secs))
     except Exception:  # pragma: no cover - cache is best-effort
         pass

@@ -19,6 +19,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
 from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
@@ -109,7 +110,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
             "Resilience/nonfinite_skips": skipped,
         }
 
-    return jax.jit(train, donate_argnums=(0, 1))
+    return jax_compile.guarded_jit(train, name="a2c.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -211,6 +212,47 @@ def main(runtime, cfg: Dict[str, Any]):
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
     }
+
+    # ----- AOT warmup (core/compile.py): same scheme as ppo.py — compile the
+    # packed-act step, the accumulate-and-apply train step, and the metric-drain
+    # kernels on a background thread while the first rollout collects.
+    warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
+    if warmup.enabled:
+        packed0 = codec.encode(next_obs, extra=zero_extra)
+        act_fn = player.packed_act_fn(codec)
+        act_specs = (
+            jax_compile.specs_of(player.params),
+            jax_compile.spec_like(packed0),
+            jax_compile.spec_like(player_rng),
+        )
+        warmup.add(act_fn, *act_specs)
+        if not device_rollout:
+            cat_s, _env_s, _logp_s, val_s, _key_s = jax.eval_shape(act_fn.fun, *act_specs)
+            T = int(cfg.algo.rollout_steps)
+            data_specs = {
+                k: jax.ShapeDtypeStruct((T, *next_obs[k].shape), jnp.float32) for k in obs_keys
+            }
+            for k, s in (("actions", cat_s), ("values", val_s)):
+                data_specs[k] = jax.ShapeDtypeStruct((T, *s.shape), jnp.float32)
+            for k in ("rewards", "dones"):
+                data_specs[k] = jax.ShapeDtypeStruct((T, n_envs, 1), jnp.float32)
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_state),
+                data_specs,
+                jax.ShapeDtypeStruct(val_s.shape, jnp.float32),
+                jax_compile.spec_like(rng),
+            )
+        if aggregator is not None:
+            warmup.add_task(
+                lambda: aggregator.precompile_drain(
+                    ("Loss/policy_loss", "Loss/value_loss", "Resilience/nonfinite_skips")
+                ),
+                name="metric.drain",
+            )
+        warmup.start()
+
     pending: Dict[str, Any] = {}
 
     def _process_pending(cur_packed):
@@ -323,6 +365,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
                     local_data = {k: v[idx] for k, v in local_data.items()}
             with timer("Time/train_time", SumMetric()):
+                if iter_num == start_iter:
+                    # surface any residual warmup compile time here rather than
+                    # inside the train call (the rollout overlapped the thread)
+                    warmup.wait()
                 jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
                 rng, train_key = jax.random.split(rng)
                 if device_rollout:
@@ -381,6 +427,10 @@ def main(runtime, cfg: Dict[str, Any]):
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
             resilience.drain_env_counters(envs, aggregator)
+            jax_compile.drain_compile_counters(aggregator)
+            if iter_num == start_iter:
+                # everything reachable has compiled once: later traces are drift
+                jax_compile.mark_steady()
 
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last

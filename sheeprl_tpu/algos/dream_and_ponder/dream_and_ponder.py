@@ -49,6 +49,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dream_and_ponder.agent import build_agent
 from sheeprl_tpu.algos.dream_and_ponder.ponder_actor import PonderActor, geometric_prior, ponder_loss
 from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput, DV3Modules
@@ -414,7 +415,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         flat_player = psync.ravel(params) if psync is not None else None
         return params, opt_states, moments_state, counter, flat_player, named
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1, 2))
+    return init_opt, jax_compile.guarded_jit(train, name="dap.train", donate_argnums=(0, 1, 2))
 
 
 @register_algorithm()
@@ -716,6 +717,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dreamer_v2.agent import (
     ActorDV2,
     ActorOutputDV2,
@@ -184,7 +185,7 @@ class PlayerDV1:
         self.expl_amount = 0.0
         self.wm_params: Any = None
         self.actor_params: Any = None
-        self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._step = jax_compile.guarded_jit(self._raw_step, name="dv1.step", static_argnames=("greedy",))
         self._packed_step_fns: Dict[Any, Any] = {}
 
     def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False):
@@ -245,7 +246,7 @@ class PlayerDV1:
                 obs = codec.decode_obs(packed)
                 return self._raw_step(wm_params, actor_params, state, obs, key, expl_amount, greedy=greedy)
 
-            fn = jax.jit(_packed)
+            fn = jax_compile.guarded_jit(_packed, name="dv1.step_packed")
             self._packed_step_fns[cache_key] = fn
         actions_list, self.state = fn(
             self.wm_params, self.actor_params, self.state, packed, key, jnp.float32(self.expl_amount)

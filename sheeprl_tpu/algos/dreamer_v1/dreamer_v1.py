@@ -22,6 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dreamer_v1.agent import DV1Modules, build_agent
 from sheeprl_tpu.algos.dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, test
@@ -259,7 +260,7 @@ def make_train_fn(modules: DV1Modules, cfg, runtime, is_continuous: bool, action
         flat_player = psync.ravel(params) if psync is not None else None
         return params, opt_states, flat_player, named
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+    return init_opt, jax_compile.guarded_jit(train, name="dv1.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -585,6 +586,11 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step
 
         # ---- checkpoint
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
+
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last
         ):

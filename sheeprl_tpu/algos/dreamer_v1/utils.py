@@ -30,6 +30,13 @@ AGGREGATOR_KEYS = {
     "Grads/actor",
     "Grads/critic",
 }
+# Compilation-management counters (core/compile.py), drained once per iteration.
+AGGREGATOR_KEYS |= {
+    "Compile/retraces",
+    "Compile/cache_hits",
+    "Compile/cache_misses",
+    "Time/compile_seconds",
+}
 MODELS_TO_REGISTER = {"world_model", "actor", "critic"}
 
 

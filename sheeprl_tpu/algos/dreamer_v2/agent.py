@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.models.models import MLP, CNN, DeCNN, LayerNormGRUCell
 from sheeprl_tpu.utils.utils import host_float32, resolve_actor_cls
 from sheeprl_tpu.ops.distributions import (
@@ -653,7 +654,7 @@ class PlayerDV2:
         self.expl_amount = 0.0
         self.wm_params: Any = None
         self.actor_params: Any = None
-        self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._step = jax_compile.guarded_jit(self._raw_step, name="dv2.step", static_argnames=("greedy",))
         self._packed_step_fns: Dict[Any, Any] = {}
 
     def _raw_step(self, wm_params, actor_params, state, obs, key, expl_amount, greedy: bool = False, mask=None):
@@ -721,7 +722,7 @@ class PlayerDV2:
                     wm_params, actor_params, state, obs, key, expl_amount, greedy=greedy, mask=mask
                 )
 
-            fn = jax.jit(_packed)
+            fn = jax_compile.guarded_jit(_packed, name="dv2.step_packed")
             self._packed_step_fns[cache_key] = fn
         actions_list, self.state = fn(
             self.wm_params, self.actor_params, self.state, packed, key, jnp.float32(self.expl_amount)

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.models.models import MLP, CNN, DeCNN, LayerNorm, LayerNormGRUCell
 from sheeprl_tpu.ops.distributions import (
     Independent,
@@ -787,7 +788,9 @@ class PlayerDV3:
         # filled by build_agent
         self.wm_params: Any = None
         self.actor_params: Any = None
-        self._step = jax.jit(self._raw_step, static_argnames=("greedy",))
+        self._step = jax_compile.guarded_jit(
+            self._raw_step, name="dv3.step", static_argnames=("greedy",)
+        )
         self._packed_step_fns: Dict[Any, Any] = {}
 
     def _actor_step(self, actor_params, latent, key, greedy: bool = False, mask=None):
@@ -842,6 +845,14 @@ class PlayerDV3:
         """Like get_actions but fed by ONE packed host->device transfer (see
         core/pipeline.PackedObsCodec): unpack + normalize + the ``mask_*``-key
         action-mask extraction all run in-graph."""
+        fn = self.packed_step_fn(codec, greedy=greedy)
+        actions_list, self.state = fn(self.wm_params, self.actor_params, self.state, packed, key)
+        return actions_list
+
+    def packed_step_fn(self, codec, greedy: bool = False):
+        """The guarded jitted packed-step entry point for ``codec`` (exposed so
+        the train loop can register its AOT warmup before the rollout starts).
+        greedy/mask-usage close over the trace — no static args, AOT-friendly."""
         use_mask = bool(getattr(self.actor, "uses_action_mask", False))
         cache_key = (codec.signature, bool(greedy), use_mask)
         fn = self._packed_step_fns.get(cache_key)
@@ -854,10 +865,9 @@ class PlayerDV3:
                     mask = {k: v for k, v in obs.items() if k.startswith("mask")} or None
                 return self._raw_step(wm_params, actor_params, state, obs, key, greedy=greedy, mask=mask)
 
-            fn = jax.jit(_packed)
+            fn = jax_compile.guarded_jit(_packed, name="dv3.step_packed")
             self._packed_step_fns[cache_key] = fn
-        actions_list, self.state = fn(self.wm_params, self.actor_params, self.state, packed, key)
-        return actions_list
+        return fn
 
 
 class DV3Modules(NamedTuple):

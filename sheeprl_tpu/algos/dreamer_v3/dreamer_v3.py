@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     update_moments,
 )
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
@@ -54,7 +55,13 @@ from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, polyak_update, save_configs
+from sheeprl_tpu.utils.utils import (
+    NUMPY_TO_JAX_DTYPE,
+    DreamerPlayerSync,
+    Ratio,
+    polyak_update,
+    save_configs,
+)
 
 # Obs->latent->action world-model subset the rollout player needs (see
 # PlayerDV3._raw_step / RSSM.initial_states); shipped to the player device by
@@ -413,7 +420,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         flat_player = psync.ravel(params) if psync is not None else None
         return params, opt_states, moments_state, counter, flat_player, named
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1, 2))
+    return init_opt, jax_compile.guarded_jit(train, name="dv3.train", donate_argnums=(0, 1, 2))
 
 
 def optax_global_norm(tree) -> jax.Array:
@@ -605,6 +612,114 @@ def main(runtime, cfg: Dict[str, Any]):
         leading_dims=(1, cfg.env.num_envs),
     )
 
+    # ----- AOT warmup (core/compile.py): compile the packed policy step, the
+    # fused world-model/actor/critic train step (for every gradient-step count
+    # the Ratio schedule will request) and the metric-drain kernels on a
+    # background thread while the prefill rollout collects; the first train
+    # call then executes a pre-built executable (trace count 0 at call time).
+    warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
+    if warmup.enabled:
+        packed0 = codec.encode(obs)
+        act_fn = player.packed_step_fn(codec)
+        act_specs = (
+            jax_compile.specs_of(player.wm_params),
+            jax_compile.specs_of(player.actor_params),
+            jax_compile.specs_of(player.state),
+            jax_compile.spec_like(packed0),
+            jax_compile.spec_like(rng),
+        )
+        warmup.add(act_fn, *act_specs)
+        # The recurrent/stochastic state's dtype differs between the reset
+        # state (f32 zeros from init_states) and the step's own output (the
+        # model's compute dtype, e.g. bf16), and episode resets flip it back:
+        # warm the steady-state signature too or step #2 retraces every run.
+        _acts_out, state_out = jax.eval_shape(act_fn.fun, *act_specs)
+        steady_specs = (
+            act_specs[0],
+            act_specs[1],
+            jax.tree_util.tree_map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), state_out),
+            act_specs[3],
+            act_specs[4],
+        )
+        if jax_compile.abstract_signature(steady_specs, {}) != jax_compile.abstract_signature(
+            act_specs, {}
+        ):
+            warmup.add(act_fn, *steady_specs)
+        # The train step's leading batch dim is the per-iteration gradient-step
+        # count: predict the counts the Ratio schedule will yield by replaying
+        # the loop's exact arithmetic on a clone (the schedule is periodic
+        # after the first few train iterations, so 1024 iterations and 4
+        # distinct counts bound the sweep).
+        clone = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        clone.load_state_dict(ratio.state_dict())
+        unique_g = []
+        sim_policy_step = policy_step
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for sim_iter in range(start_iter, min(total_iters, start_iter + 1024) + 1):
+                sim_policy_step += policy_steps_per_iter
+                if sim_iter >= learning_starts:
+                    g = clone((sim_policy_step - prefill_steps * policy_steps_per_iter) / world_size)
+                    if g > 0 and g not in unique_g:
+                        unique_g.append(g)
+                        if len(unique_g) >= 4:
+                            break
+        # batch specs mirror the prefetcher's output: [G, L, B, *feat] on the
+        # data axis, storage dtypes narrowed exactly like get_array's transfer
+        seq_len = int(cfg.algo.per_rank_sequence_length)
+        bsz = int(cfg.algo.per_rank_batch_size) * world_size
+        batch_sharding = NamedSharding(runtime.mesh, P(None, None, "data"))
+        feat = {k: tuple(step_data[k].shape[2:]) for k in obs_keys}
+        store_dtype = {k: step_data[k].dtype for k in obs_keys}
+        for k in ("rewards", "truncated", "terminated", "is_first"):
+            feat[k] = (1,)
+            store_dtype[k] = step_data[k].dtype
+        feat["actions"] = (int(np.sum(actions_dim)),)
+        store_dtype["actions"] = np.dtype(np.float32)
+        for g in unique_g:
+            batches_spec = {
+                k: jax.ShapeDtypeStruct(
+                    (g, seq_len, bsz, *feat[k]),
+                    NUMPY_TO_JAX_DTYPE.get(np.dtype(store_dtype[k]), jnp.float32),
+                    sharding=batch_sharding,
+                )
+                for k in feat
+            }
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_states),
+                jax_compile.specs_of(moments_state),
+                jax_compile.spec_like(counter),
+                batches_spec,
+                jax_compile.spec_like(rng),
+            )
+        if aggregator is not None:
+            warmup.add_task(
+                lambda: aggregator.precompile_drain(
+                    (
+                        "Loss/world_model_loss",
+                        "Loss/value_loss",
+                        "Loss/policy_loss",
+                        "Loss/observation_loss",
+                        "Loss/reward_loss",
+                        "Loss/state_loss",
+                        "Loss/continue_loss",
+                        "State/kl",
+                        "State/post_entropy",
+                        "State/prior_entropy",
+                        "Grads/world_model",
+                        "Grads/actor",
+                        "Grads/critic",
+                        "State/moments_low",
+                        "State/moments_high",
+                        "Resilience/nonfinite_skips",
+                    )
+                ),
+                name="metric.drain",
+            )
+        warmup.start()
+
     cumulative_per_rank_gradient_steps = 0
     heartbeat_t0, heartbeat_iter = time.perf_counter(), start_iter
 
@@ -757,6 +872,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         n_samples=per_rank_gradient_steps,
                     )
                     with timer("Time/train_time", SumMetric()):
+                        # no-op once the warmup thread finished (first train
+                        # call at the latest; usually hidden behind prefill)
+                        warmup.wait()
                         rng, train_key = jax.random.split(rng)
                         params, opt_states, moments_state, counter, flat_player, train_metrics = train_fn(
                             params, opt_states, moments_state, counter, batches, train_key
@@ -773,6 +891,11 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update_from_device(train_metrics)
                     resilience.enforce_nonfinite_policy(ft, train_metrics)
             resilience.drain_env_counters(envs, aggregator)
+            jax_compile.drain_compile_counters(aggregator)
+            if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+                # steady-state watermark: the first real train iteration has
+                # compiled everything; any retrace from here is a perf cliff
+                jax_compile.mark_steady()
 
             # ---- logging
             if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):

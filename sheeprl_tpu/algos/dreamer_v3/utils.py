@@ -36,6 +36,13 @@ AGGREGATOR_KEYS = {
     "Resilience/env_timeouts",
     "Resilience/nonfinite_skips",
 }
+# Compilation-management counters (core/compile.py), drained once per iteration.
+AGGREGATOR_KEYS |= {
+    "Compile/retraces",
+    "Compile/cache_hits",
+    "Compile/cache_misses",
+    "Time/compile_seconds",
+}
 MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic", "moments"}
 
 

@@ -22,6 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values
 from sheeprl_tpu.algos.dreamer_v2.agent import ActorOutputDV2, expl_amount_schedule
@@ -347,7 +348,7 @@ def make_train_fn(modules: P2EDV1Modules, cfg, runtime, psync=None):
         flat_player = psync.ravel(params) if psync is not None else None
         return params, opt_states, flat_player, {name: m[i] for i, name in enumerate(METRIC_ORDER)}
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+    return init_opt, jax_compile.guarded_jit(train, name="p2e_dv1.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -640,6 +641,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

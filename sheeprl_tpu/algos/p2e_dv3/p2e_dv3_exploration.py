@@ -28,6 +28,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dreamer_v3.agent import ActorOutput
 from sheeprl_tpu.algos.dreamer_v3.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
@@ -536,7 +537,7 @@ def make_train_fn(modules: P2EDV3Modules, cfg, runtime, is_continuous: bool, act
         flat_player = psync.ravel(params) if psync is not None else None
         return params, opt_states, moments, counter, flat_player, named
 
-    return init_opt, init_moments_dict, jax.jit(train, donate_argnums=(0, 1, 2))
+    return init_opt, init_moments_dict, jax_compile.guarded_jit(train, name="p2e_dv3.train", donate_argnums=(0, 1, 2))
 
 
 def expand_critic_metric_keys(cfg, critics_spec) -> None:
@@ -877,6 +878,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import DV3OptStates, PLAYER_WM_KEYS, make_train_fn
 from sheeprl_tpu.algos.dreamer_v3.utils import MomentsState, init_moments, prepare_obs, test, get_action_masks
 from sheeprl_tpu.algos.p2e_dv3.agent import build_agent
@@ -352,6 +353,11 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

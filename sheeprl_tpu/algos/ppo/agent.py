@@ -17,6 +17,7 @@ import gymnasium
 import jax
 import jax.numpy as jnp
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.models.models import MLP, MultiEncoder, NatureCNN
 from sheeprl_tpu.ops.distributions import Independent, Normal, OneHotCategorical
 from sheeprl_tpu.utils.utils import host_float32, safeatanh, safetanh
@@ -263,10 +264,10 @@ class PPOPlayer:
         def _act_raw(params, obs, key):
             return _act(params, _normalize(obs), key)
 
-        self._act = jax.jit(_act)
-        self._act_raw = jax.jit(_act_raw)
-        self._greedy = jax.jit(_greedy)
-        self._values = jax.jit(_values)
+        self._act = jax_compile.guarded_jit(_act, name="ppo.act")
+        self._act_raw = jax_compile.guarded_jit(_act_raw, name="ppo.act_raw")
+        self._greedy = jax_compile.guarded_jit(_greedy, name="ppo.greedy")
+        self._values = jax_compile.guarded_jit(_values, name="ppo.values")
         self._act_impl = _act  # unjitted: fused into the packed-act trace
         self._packed_act_fns: Dict[Any, Any] = {}
 
@@ -289,11 +290,19 @@ class PPOPlayer:
         bit-for-bit), so a steady-state step costs exactly one host->device
         transfer. One compile per codec layout (two codecs with equal-length
         buffers must not share a trace, hence the signature-keyed cache)."""
+        return self.packed_act_fn(codec)(self.params, packed, key)
+
+    def packed_act_fn(self, codec):
+        """The guarded jitted packed-act entry point for ``codec`` (exposed so
+        the train loop can register its AOT warmup before the rollout starts)."""
         fn = self._packed_act_fns.get(codec.signature)
         if fn is None:
-            fn = jax.jit(lambda params, packed, key: self._act_impl(params, codec.decode_obs(packed), key))
+            fn = jax_compile.guarded_jit(
+                lambda params, packed, key: self._act_impl(params, codec.decode_obs(packed), key),
+                name="ppo.act_packed",
+            )
             self._packed_act_fns[codec.signature] = fn
-        return fn(self.params, packed, key)
+        return fn
 
     def get_actions(self, obs: Dict[str, jax.Array], key: jax.Array, greedy: bool = False):
         """Returns (env-facing actions, next_key)."""

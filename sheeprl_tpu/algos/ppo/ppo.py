@@ -30,6 +30,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
@@ -132,7 +133,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
             "Resilience/nonfinite_skips": losses[:, 3].sum(),
         }
 
-    return jax.jit(train, donate_argnums=(0, 1))
+    return jax_compile.guarded_jit(train, name="ppo.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -301,6 +302,54 @@ def main(runtime, cfg: Dict[str, Any]):
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
     }
+
+    # ----- AOT warmup (core/compile.py): compile the packed-act step, the fused
+    # train step, and the metric-drain kernels on a background thread while the
+    # first rollout collects; the first train call then executes a pre-built
+    # executable (trace count 0 at call time, Compile/retraces stays 0).
+    warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
+    if warmup.enabled:
+        packed0 = codec.encode(next_obs, extra=zero_extra)
+        act_fn = player.packed_act_fn(codec)
+        act_specs = (
+            jax_compile.specs_of(player.params),
+            jax_compile.spec_like(packed0),
+            jax_compile.spec_like(player_rng),
+        )
+        warmup.add(act_fn, *act_specs)
+        if not device_rollout:
+            # train-step specs from the resolved config + the act step's
+            # abstract outputs (jax.eval_shape: no FLOPs, no transfers); the
+            # device-backend rollout keeps JIT-on-first-call (its storage
+            # layout is the buffer's concern, not derivable here)
+            cat_s, _env_s, logp_s, val_s, _key_s = jax.eval_shape(act_fn.fun, *act_specs)
+            T = int(cfg.algo.rollout_steps)
+            data_specs = {
+                k: jax.ShapeDtypeStruct((T, *next_obs[k].shape), jnp.float32) for k in obs_keys
+            }
+            for k, s in (("actions", cat_s), ("logprobs", logp_s), ("values", val_s)):
+                data_specs[k] = jax.ShapeDtypeStruct((T, *s.shape), jnp.float32)
+            for k in ("rewards", "dones"):
+                data_specs[k] = jax.ShapeDtypeStruct((T, n_envs, 1), jnp.float32)
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_state),
+                data_specs,
+                jax.ShapeDtypeStruct(val_s.shape, jnp.float32),
+                jax_compile.spec_like(rng),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+            )
+        if aggregator is not None:
+            warmup.add_task(
+                lambda: aggregator.precompile_drain(
+                    ("Loss/policy_loss", "Loss/value_loss", "Loss/entropy_loss", "Resilience/nonfinite_skips")
+                ),
+                name="metric.drain",
+            )
+        warmup.start()
+
     pending: Dict[str, Any] = {}
 
     def _process_pending(cur_packed):
@@ -415,13 +464,23 @@ def main(runtime, cfg: Dict[str, Any]):
                                     v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
                                 real_next_obs[k].append(v)
                         if valid_idx:
-                            stacked = {
-                                k: jax.device_put(np.stack(v), runtime.player_device)
+                            # canonical shape: pad to the FULL [n_envs, ...] batch and
+                            # gather the valid rows after, so the values forward keeps
+                            # ONE compiled shape no matter how many envs truncated
+                            # (1..n_envs distinct shapes would otherwise each compile)
+                            padded = {
+                                k: np.zeros((n_envs, *np.asarray(v[0]).shape), np.float32)
                                 for k, v in real_next_obs.items()
                             }
-                            vals = np.asarray(player.get_values(stacked)).reshape(len(valid_idx))
+                            for j, te in enumerate(valid_idx):
+                                for k in obs_keys:
+                                    padded[k][te] = real_next_obs[k][j]
+                            stacked = {
+                                k: jax.device_put(v, runtime.player_device) for k, v in padded.items()
+                            }
+                            vals = np.asarray(player.get_values(stacked)).reshape(n_envs)
                             rewards = np.asarray(rewards, dtype=np.float32)
-                            rewards[valid_idx] += cfg.algo.gamma * vals
+                            rewards[valid_idx] += cfg.algo.gamma * vals[valid_idx]
                     dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
                     rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
 
@@ -457,6 +516,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
                     local_data = {k: v[idx] for k, v in local_data.items()}
             with timer("Time/train_time", SumMetric()):
+                if iter_num == start_iter:
+                    # every registered entry point compiled before the first
+                    # train dispatch (usually already done: the whole first
+                    # rollout overlapped the warmup thread)
+                    warmup.wait()
                 jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                 rng, train_key = jax.random.split(rng)
                 if device_rollout:
@@ -540,6 +604,11 @@ def main(runtime, cfg: Dict[str, Any]):
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
             resilience.drain_env_counters(envs, aggregator)
+            jax_compile.drain_compile_counters(aggregator)
+            if iter_num == start_iter:
+                # steady-state watermark: everything this loop will ever compile
+                # has compiled; any retrace from here is a perf cliff
+                jax_compile.mark_steady()
 
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
@@ -466,6 +467,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     ft, transport.pull_replicated(train_metrics) if transport is not None else train_metrics
                 )
             resilience.drain_env_counters(envs, aggregator)
+            jax_compile.drain_compile_counters(aggregator)
 
             if is_player and cfg.metric.log_level > 0:
                 if aggregator:

@@ -16,6 +16,7 @@ import gymnasium
 import jax
 import jax.numpy as jnp
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.ppo.agent import CNNEncoder, MLPEncoder, evaluate_actions, sample_actions
 from sheeprl_tpu.models.models import MLP, MultiEncoder
 from sheeprl_tpu.utils.utils import host_float32
@@ -200,9 +201,9 @@ class RecurrentPPOPlayer:
                 prepped[k] = v[None]
             return _act(params, prepped, prev_actions[None], prev_states, key, greedy)
 
-        self._act = jax.jit(_act, static_argnums=(5,))
-        self._act_raw = jax.jit(_act_raw, static_argnums=(5,))
-        self._values = jax.jit(_values)
+        self._act = jax_compile.guarded_jit(_act, name="ppo_recurrent.act", static_argnums=(5,))
+        self._act_raw = jax_compile.guarded_jit(_act_raw, name="ppo_recurrent.act_raw", static_argnums=(5,))
+        self._values = jax_compile.guarded_jit(_values, name="ppo_recurrent.values")
         self._act_impl = _act
         self._packed_act_fns: Dict[Any, Any] = {}
 
@@ -232,7 +233,7 @@ class RecurrentPPOPlayer:
                 obs = {k: v[None] for k, v in codec.decode_obs(packed).items()}
                 return self._act_impl(params, obs, prev_actions[None], prev_states, key, greedy)
 
-            fn = jax.jit(_packed)
+            fn = jax_compile.guarded_jit(_packed, name="ppo_recurrent.act_packed")
             self._packed_act_fns[cache_key] = fn
         return fn(self.params, packed, prev_actions, prev_states, key)
 

@@ -20,6 +20,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
@@ -120,7 +121,7 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
             "Resilience/nonfinite_skips": losses[:, 3].sum(),
         }
 
-    return jax.jit(train, donate_argnums=(0, 1))
+    return jax_compile.guarded_jit(train, name="ppo_recurrent.train", donate_argnums=(0, 1))
 
 
 def _chunk_and_pad(local_data: Dict[str, np.ndarray], dones: np.ndarray, sl: int, n_envs: int):
@@ -147,22 +148,7 @@ def _chunk_and_pad(local_data: Dict[str, np.ndarray], dones: np.ndarray, sl: int
                     sequences[k].append(v[ep_slice][s0:s1, env_id])
                 lengths.append(s1 - s0)
             start = stop + 1
-    n_seq = len(lengths)
-    bucket = 1
-    while bucket < n_seq:
-        bucket *= 2
-    out: Dict[str, np.ndarray] = {}
-    for k, chunks in sequences.items():
-        sample_shape = chunks[0].shape[1:]
-        arr = np.zeros((sl, bucket, *sample_shape), dtype=np.float32)
-        for i, c in enumerate(chunks):
-            arr[: c.shape[0], i] = c
-        out[k] = arr
-    mask = np.zeros((sl, bucket, 1), dtype=np.float32)
-    for i, ln in enumerate(lengths):
-        mask[:ln, i] = 1.0
-    out["mask"] = mask
-    return out
+    return jax_compile.bucketed_pad(sequences, lengths, sl)
 
 
 @register_algorithm()
@@ -531,6 +517,12 @@ def main(runtime, cfg: Dict[str, Any]):
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
             resilience.drain_env_counters(envs, aggregator)
+            jax_compile.drain_compile_counters(aggregator)
+            if iter_num == start_iter:
+                # first iteration compiled every reachable signature for the
+                # CURRENT bucket set; later buckets are legitimate first
+                # compiles per signature, drift shows up as Compile/retraces
+                jax_compile.mark_steady()
 
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last

@@ -17,6 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.models.models import MLP
 from sheeprl_tpu.utils.utils import host_float32
 
@@ -154,8 +155,8 @@ class SACPlayer:
             mean, _ = actor.apply(params, obs)
             return host_float32(actor_greedy_action(mean, action_scale, action_bias))
 
-        self._act = jax.jit(_act)
-        self._greedy = jax.jit(_greedy)
+        self._act = jax_compile.guarded_jit(_act, name="sac.act")
+        self._greedy = jax_compile.guarded_jit(_greedy, name="sac.greedy")
 
     def get_actions(self, obs: jax.Array, key: Optional[jax.Array] = None, greedy: bool = False) -> jax.Array:
         if greedy:

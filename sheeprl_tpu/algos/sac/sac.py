@@ -22,6 +22,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.sac.agent import (
     SACParams,
     action_scale_bias,
@@ -144,7 +145,7 @@ def make_train_fn(
             "Loss/alpha_loss": mean_losses[2],
         }
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+    return init_opt, jax_compile.guarded_jit(train, name="sac.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -442,6 +443,11 @@ def main(runtime, cfg: Dict[str, Any]):
                     timer.reset()
                 last_log = policy_step
                 last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if cumulative_grad_steps > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

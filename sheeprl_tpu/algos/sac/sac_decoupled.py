@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.sac.agent import action_scale_bias, build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import test
@@ -332,6 +333,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         aggregator.update_from_device(
                             transport.pull_replicated(train_metrics) if transport is not None else train_metrics
                         )
+                    if is_player:
+                        jax_compile.drain_compile_counters(aggregator)
 
             if is_player and cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters

@@ -15,6 +15,13 @@ AGGREGATOR_KEYS = {
     "Loss/policy_loss",
     "Loss/alpha_loss",
 }
+# Compilation-management counters (core/compile.py), drained once per iteration.
+AGGREGATOR_KEYS |= {
+    "Compile/retraces",
+    "Compile/cache_hits",
+    "Compile/cache_misses",
+    "Time/compile_seconds",
+}
 MODELS_TO_REGISTER = {"agent"}
 
 

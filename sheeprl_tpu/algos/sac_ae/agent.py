@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.sac.agent import action_scale_bias, actor_action_and_log_prob
 from sheeprl_tpu.models.models import CNN, MLP, DeCNN, LayerNorm
 from sheeprl_tpu.utils.utils import host_float32
@@ -259,8 +260,8 @@ class SACAEPlayer:
             mean, _ = actor_head.apply(actor_params, feats)
             return host_float32(jnp.tanh(mean) * action_scale + action_bias)
 
-        self._act = jax.jit(_act)
-        self._greedy = jax.jit(_greedy)
+        self._act = jax_compile.guarded_jit(_act, name="sac_ae.act")
+        self._greedy = jax_compile.guarded_jit(_greedy, name="sac_ae.greedy")
 
     def get_actions(self, obs, key=None, greedy: bool = False):
         if greedy:

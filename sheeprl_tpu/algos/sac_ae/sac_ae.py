@@ -21,6 +21,7 @@ import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from sheeprl_tpu.core import compile as jax_compile
 from sheeprl_tpu.algos.sac.agent import action_scale_bias, actor_action_and_log_prob
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac_ae.agent import SACAEParams, build_agent
@@ -214,7 +215,7 @@ def make_train_fn(modules, cfg, runtime, action_scale, action_bias, target_entro
             "Loss/reconstruction_loss": mean_losses[3],
         }
 
-    return init_opt, jax.jit(train, donate_argnums=(0, 1))
+    return init_opt, jax_compile.guarded_jit(train, name="sac_ae.train", donate_argnums=(0, 1))
 
 
 @register_algorithm()
@@ -435,6 +436,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+
+        jax_compile.drain_compile_counters(aggregator)
+        if train_calls > 0 and not jax_compile.is_steady():
+            # everything reachable has compiled once: later traces are drift
+            jax_compile.mark_steady()
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last

@@ -16,6 +16,13 @@ AGGREGATOR_KEYS = {
     "Loss/alpha_loss",
     "Loss/reconstruction_loss",
 }
+# Compilation-management counters (core/compile.py), drained once per iteration.
+AGGREGATOR_KEYS |= {
+    "Compile/retraces",
+    "Compile/cache_hits",
+    "Compile/cache_misses",
+    "Time/compile_seconds",
+}
 MODELS_TO_REGISTER = {"agent", "encoder", "decoder"}
 
 

@@ -141,7 +141,12 @@ def _find_entrypoint(algo_name: str) -> Optional[Dict[str, Any]]:
 def _apply_global_flags(cfg: dotdict) -> None:
     import jax
 
+    from sheeprl_tpu.core import compile as jax_compile
     from sheeprl_tpu.utils.timer import timer
+
+    # Compile-management policy (retrace guard, AOT switch, persistent-cache
+    # knobs) must be live before the first trace of the run.
+    jax_compile.configure(cfg)
 
     # Reference cli.py:161. Critical on remote accelerators: the train loops fence
     # device work ONLY when timing (block_until_ready costs a full round-trip per

@@ -1,0 +1,611 @@
+"""Compilation management: AOT warmup, retrace guard, persistent-cache stats.
+
+Every run pays XLA compile latency on the critical path unless something manages
+it: the first train step blocks on tracing+compiling the fused ``lax.scan``
+update, and any silent shape/dtype drift mid-run retraces it again — invisible
+except as a throughput cliff. This module turns compilation into a managed,
+observable resource (the Podracer recipe: compile once, ahead of time, never
+retrace in steady state):
+
+- :func:`guarded_jit` wraps ``jax.jit`` with a per-function trace counter, an
+  abstract-signature log (every retrace logs the diff against the previous
+  signature), a ``warn``/``halt`` policy once the loop declares steady state
+  (:func:`mark_steady`), and a registry of AOT-compiled executables that
+  matching calls route to WITHOUT touching the jit tracing machinery.
+- :class:`AOTWarmup` compiles registered entry points from
+  ``jax.ShapeDtypeStruct`` specs on a background thread, overlapped with env
+  reset / first-rollout collection, so the accelerator is warm before step 0.
+  ``jit(f).lower(specs).compile()`` alone does NOT populate the jit call cache
+  (a later ``f(args)`` would re-trace), which is why the guard keeps the
+  compiled executable and routes calls to it by abstract signature.
+- cache listeners count persistent-compilation-cache hits/misses
+  (``jax.monitoring`` events) and :func:`drain_compile_counters` folds all
+  counters into a ``MetricAggregator`` at log boundaries
+  (``Compile/retraces``, ``Compile/cache_hits``, ``Compile/cache_misses``,
+  ``Time/compile_seconds``).
+- :func:`pow2_bucket` / :func:`bucketed_pad` are the shared canonical-shape
+  utilities (generalized from ppo_recurrent's inline episode bucketing) so
+  variable-length sequences / partial final batches land in a bounded set of
+  padded shapes instead of a fresh compile each.
+
+Config: the ``compile:`` Hydra group (``configs/compile/default.yaml``), read
+through :func:`resolve` which fills defaults when the group is absent (configs
+recorded before this subsystem existed keep working).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+
+_logger = logging.getLogger("sheeprl_tpu.compile")
+
+# process-relative clock zero for ``first_call_s`` (time-to-first-step metrics)
+_T0 = time.perf_counter()
+
+# --------------------------------------------------------------------------- #
+# Config group
+# --------------------------------------------------------------------------- #
+
+_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    "cache": {"dir": None, "min_compile_time_secs": None},
+    "aot": {"enabled": True},
+    "guard": {"policy": "warn"},
+}
+
+_POLICIES = ("warn", "halt", "off")
+
+
+class _View:
+    """Attribute access over the merged defaults (same shape as resilience._View)."""
+
+    def __init__(self, merged: Dict[str, Dict[str, Any]]):
+        for section, values in merged.items():
+            setattr(self, section, _Section(values))
+
+
+class _Section:
+    def __init__(self, values: Dict[str, Any]):
+        self.__dict__.update(values)
+
+    def get(self, key, default=None):
+        return self.__dict__.get(key, default)
+
+
+def resolve(cfg: Any) -> _View:
+    """Defaults-filled view of ``cfg.compile``; tolerates a missing group entirely
+    (resumed sidecar configs predating this subsystem have no ``compile:``)."""
+    try:
+        group = cfg.get("compile") if hasattr(cfg, "get") else None
+    except Exception:
+        group = None
+    merged: Dict[str, Dict[str, Any]] = {}
+    for section, defaults in _DEFAULTS.items():
+        got = None
+        if group is not None:
+            got = group.get(section) if hasattr(group, "get") else getattr(group, section, None)
+        merged[section] = dict(defaults)
+        if got is not None:
+            for k in defaults:
+                v = got.get(k, defaults[k]) if hasattr(got, "get") else getattr(got, k, defaults[k])
+                merged[section][k] = v
+    policy = str(merged["guard"]["policy"]).lower()
+    if policy not in _POLICIES:
+        raise ValueError(f"compile.guard.policy must be one of {_POLICIES}; got {policy!r}")
+    merged["guard"]["policy"] = policy
+    return _View(merged)
+
+
+def aot_enabled(cfg: Any) -> bool:
+    """Whether the train loops should register + run AOT warmup for this run."""
+    return bool(resolve(cfg).aot.enabled)
+
+
+# --------------------------------------------------------------------------- #
+# Process-wide state
+# --------------------------------------------------------------------------- #
+
+_LOCK = threading.Lock()
+_REGISTRY: List["GuardedFn"] = []
+_STEADY = False
+_GUARD_POLICY = "warn"
+_CACHE_COUNTS = {"cache_hits": 0, "cache_misses": 0}
+_LISTENER_INSTALLED = False
+# snapshot of process totals at the last drain_compile_counters() call
+_DRAINED: Dict[str, float] = {}
+
+_CACHE_EVENTS = {
+    "/jax/compilation_cache/cache_hits": "cache_hits",
+    "/jax/compilation_cache/cache_misses": "cache_misses",
+}
+
+# Aggregator keys this module feeds (register them in configs/metric/default.yaml
+# and each algo's AGGREGATOR_KEYS or the CLI prunes them).
+METRIC_KEYS = (
+    "Compile/retraces",
+    "Compile/cache_hits",
+    "Compile/cache_misses",
+    "Time/compile_seconds",
+)
+
+
+def install_cache_listeners() -> None:
+    """Count persistent-cache hit/miss events (idempotent; listener is global)."""
+    global _LISTENER_INSTALLED
+    with _LOCK:
+        if _LISTENER_INSTALLED:
+            return
+        _LISTENER_INSTALLED = True
+    try:
+        def _listener(event: str, **kwargs) -> None:
+            key = _CACHE_EVENTS.get(event)
+            if key is not None:
+                with _LOCK:
+                    _CACHE_COUNTS[key] += 1
+
+        jax.monitoring.register_event_listener(_listener)
+    except Exception:  # pragma: no cover - monitoring API drift
+        pass
+
+
+def configure(cfg: Any) -> _View:
+    """Apply the ``compile:`` group for a new run.
+
+    Sets the retrace policy, clears the steady-state watermark (a fresh run's
+    first traces are not retraces of the previous run), applies the
+    persistent-cache knobs to jax.config ONLY when explicitly set (never
+    clobbering the user's/env defaults — that is the whole point of the group),
+    and installs the cache-stats listeners.
+    """
+    cc = resolve(cfg)
+    global _GUARD_POLICY, _STEADY
+    _GUARD_POLICY = cc.guard.policy
+    _STEADY = False
+    if cc.cache.dir:
+        jax.config.update("jax_compilation_cache_dir", str(cc.cache.dir))
+    if cc.cache.min_compile_time_secs is not None:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(cc.cache.min_compile_time_secs)
+        )
+    install_cache_listeners()
+    return cc
+
+
+def mark_steady() -> None:
+    """Steady-state watermark: the loops call this once their first full
+    iteration (rollout + train) has compiled everything it is going to; any
+    retrace after this point is a perf cliff and escalates per the policy."""
+    global _STEADY
+    _STEADY = True
+
+
+def is_steady() -> bool:
+    return _STEADY
+
+
+class RetraceError(RuntimeError):
+    """Raised under ``compile.guard.policy=halt`` when a guarded function
+    retraces after the steady-state watermark."""
+
+
+# --------------------------------------------------------------------------- #
+# Abstract signatures
+# --------------------------------------------------------------------------- #
+
+
+def _leaf_sig(x: Any) -> Tuple:
+    """(shape, dtype, weak_type) of one argument leaf; ``jax.ShapeDtypeStruct``
+    warmup specs and real arrays produce identical entries by construction."""
+    if isinstance(x, (bool, int, float, complex)):
+        return ((), np.result_type(type(x)).name, True)
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = getattr(x, "dtype", None)
+    return (shape, np.dtype(dtype).name if dtype is not None else type(x).__name__,
+            bool(getattr(x, "weak_type", False)))
+
+
+def abstract_signature(args: Tuple, kwargs: Dict[str, Any]) -> Tuple:
+    """Hashable abstract call signature: pytree structure + per-leaf
+    (shape, dtype, weak_type). Shardings are deliberately excluded — the AOT
+    executables accept any input placement (XLA reshards), so routing on them
+    would only cause spurious fallbacks."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    return (tuple(_leaf_sig(leaf) for leaf in leaves), treedef)
+
+
+def _routing_key(sig: Tuple) -> Tuple:
+    """AOT-lookup key: the signature with weak_type erased. Compiled executables
+    accept weak- and strong-typed inputs interchangeably (verified both
+    directions), and spec-derived warmup signatures are always strong-typed
+    while e.g. ``jnp.full(..., 2.0)`` products are weak — routing on the full
+    signature would spuriously miss."""
+    leaves, treedef = sig
+    return (tuple((s, d, False) for s, d, _w in leaves), treedef)
+
+
+def signature_diff(old: Optional[Tuple], new: Tuple) -> str:
+    """Human-readable per-leaf diff between two abstract signatures."""
+    if old is None:
+        return "first trace (no previous signature)"
+    old_leaves, old_def = old
+    new_leaves, new_def = new
+    if old_def != new_def:
+        return f"pytree structure changed: {old_def} -> {new_def}"
+    changes = []
+    for i, (a, b) in enumerate(zip(old_leaves, new_leaves)):
+        if a != b:
+            changes.append(f"leaf[{i}]: {a} -> {b}")
+    return "; ".join(changes) if changes else "signatures identical (jit cache dropped?)"
+
+
+def spec_like(x: Any) -> Any:
+    """``jax.ShapeDtypeStruct`` mirroring one concrete array (shape, dtype and —
+    for multi-device arrays — sharding, so AOT compiles for the real placement).
+
+    Single-device shardings are deliberately dropped: mixing a device-committed
+    single-device spec with multi-device param specs makes ``.lower()`` reject
+    the computation as using incompatible devices, and baking "committed to
+    device 0" into the executable makes call-time placement stricter than the
+    jit path. Shape/dtype alone reproduces the jit behaviour there.
+    """
+    sharding = None
+    if isinstance(x, jax.Array):
+        try:
+            if len(x.sharding.device_set) > 1:
+                sharding = x.sharding
+        except Exception:
+            sharding = None
+    return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype, sharding=sharding)
+
+
+def specs_of(tree: Any) -> Any:
+    """Pytree of :func:`spec_like` specs for a pytree of arrays."""
+    return jax.tree_util.tree_map(spec_like, tree)
+
+
+# --------------------------------------------------------------------------- #
+# The retrace guard
+# --------------------------------------------------------------------------- #
+
+
+class GuardedFn:
+    """A ``jax.jit``-compatible callable with trace accounting and AOT routing.
+
+    Calls whose abstract signature matches a warmed AOT executable go straight
+    to it (zero tracing); everything else goes through the jitted path, where a
+    side-effecting hook inside the wrapped function counts actual traces. Any
+    trace after the first compile of this function is a *retrace*: the
+    signature diff is logged, and after :func:`mark_steady` the configured
+    policy applies (``warn`` logs, ``halt`` raises :class:`RetraceError`).
+    """
+
+    def __init__(self, fun: Callable, name: Optional[str] = None, **jit_kwargs: Any):
+        self.fun = fun
+        self.name = name or getattr(fun, "__name__", "<fn>")
+        self._jit_kwargs = dict(jit_kwargs)
+        self._aot: Dict[Tuple, Any] = {}
+        # warmup jobs queued for this fn but not yet compiled (threading.Events,
+        # set by the AOTWarmup thread): callers racing the warmup wait for them
+        # instead of redundantly tracing the same signature on the hot path
+        self._aot_pending: List[threading.Event] = []
+        self._trace_count = 0
+        self.calls = 0
+        self.retraces = 0
+        self.aot_compiles = 0
+        self.aot_fallbacks = 0
+        self.compile_seconds = 0.0
+        self.first_call_s: Optional[float] = None  # seconds since module import
+        self.last_signature: Optional[Tuple] = None
+        self.last_diff: Optional[str] = None
+        self._had_any_compile = False
+
+        def _traced(*args, **kwargs):
+            # runs ONLY while jax traces the function (retraces included);
+            # executed computations never re-enter the Python body
+            self._trace_count += 1
+            return fun(*args, **kwargs)
+
+        try:
+            _traced.__name__ = f"guarded[{self.name}]"
+            _traced.__wrapped__ = fun  # jit resolves static_argnames via inspect.signature
+        except Exception:
+            pass
+        self._jitted = jax.jit(_traced, **jit_kwargs)
+        with _LOCK:
+            _REGISTRY.append(self)
+
+    # ----- properties -----------------------------------------------------------
+    @property
+    def traces(self) -> int:
+        """Traces through the jitted call path (AOT warmup compiles excluded)."""
+        return self._trace_count
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "calls": self.calls,
+            "traces": self.traces,
+            "retraces": self.retraces,
+            "aot_compiles": self.aot_compiles,
+            "aot_fallbacks": self.aot_fallbacks,
+            "compile_seconds": self.compile_seconds,
+            "first_call_s": self.first_call_s,
+        }
+
+    # ----- AOT ------------------------------------------------------------------
+    def aot_compile(self, *specs: Any, **kwspecs: Any) -> Any:
+        """``jit(fun).lower(*specs).compile()`` and register the executable under
+        the specs' abstract signature; matching calls then never trace."""
+        sig = abstract_signature(specs, kwspecs)
+        t0 = time.perf_counter()
+        exe = jax.jit(self.fun, **self._jit_kwargs).lower(*specs, **kwspecs).compile()
+        dt = time.perf_counter() - t0
+        with _LOCK:
+            self._aot[_routing_key(sig)] = exe
+            self.aot_compiles += 1
+            self.compile_seconds += dt
+            self._had_any_compile = True
+            self.last_signature = sig
+        _logger.debug("[compile] AOT %s compiled in %.3fs", self.name, dt)
+        return exe
+
+    # ----- call path ------------------------------------------------------------
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        self.calls += 1
+        sig: Optional[Tuple] = None
+        if self._aot or self._aot_pending:
+            sig = abstract_signature(args, kwargs)
+            exe = self._aot.get(_routing_key(sig))
+            if exe is None and self._aot_pending:
+                # a background warmup for this fn is (probably) compiling the
+                # executable this call needs: waiting is never slower than
+                # tracing+compiling the same signature here, and keeps the
+                # jit-path compile from registering as a spurious retrace
+                for ev in list(self._aot_pending):
+                    ev.wait(timeout=600.0)
+                self._aot_pending = []
+                exe = self._aot.get(_routing_key(sig))
+            if exe is not None:
+                try:
+                    out = exe(*args, **kwargs)
+                    if self.first_call_s is None:
+                        self.first_call_s = time.perf_counter() - _T0
+                    return out
+                except (TypeError, ValueError) as e:
+                    # input mismatch against the compiled executable: the
+                    # signature models shape/dtype only, so committed-ness or
+                    # sharding/layout differences land here. The jitted path
+                    # below is always correct; evict the executable so later
+                    # calls with this signature skip the failing dispatch
+                    if isinstance(e, ValueError) and "does not match" not in str(e):
+                        raise
+                    self.aot_fallbacks += 1
+                    with _LOCK:
+                        self._aot.pop(_routing_key(sig), None)
+                    _logger.warning(
+                        "[compile] AOT executable for '%s' rejected its inputs (%s); "
+                        "falling back to JIT for this signature",
+                        self.name,
+                        str(e).splitlines()[0][:200],
+                    )
+        before = self._trace_count
+        t0 = time.perf_counter()
+        out = self._jitted(*args, **kwargs)
+        if self._trace_count != before:
+            if sig is None:
+                sig = abstract_signature(args, kwargs)
+            self._on_compile(sig, time.perf_counter() - t0)
+        if self.first_call_s is None:
+            self.first_call_s = time.perf_counter() - _T0
+        return out
+
+    def _on_compile(self, sig: Tuple, dt: float) -> None:
+        with _LOCK:
+            self.compile_seconds += dt
+            is_retrace = self._had_any_compile
+            self._had_any_compile = True
+            prev = self.last_signature
+            self.last_signature = sig
+            if is_retrace:
+                self.retraces += 1
+                self.last_diff = signature_diff(prev, sig)
+            policy = _GUARD_POLICY
+            steady = _STEADY
+        if not is_retrace or policy == "off":
+            return
+        msg = (
+            f"[compile] retrace #{self.retraces} of '{self.name}' "
+            f"({dt:.3f}s{' after steady-state watermark' if steady else ''}): {self.last_diff}"
+        )
+        _logger.warning(msg)
+        if steady and policy == "halt":
+            raise RetraceError(msg)
+
+
+def guarded_jit(fun: Callable, name: Optional[str] = None, **jit_kwargs: Any) -> GuardedFn:
+    """Drop-in ``jax.jit`` replacement returning a :class:`GuardedFn`."""
+    return GuardedFn(fun, name=name, **jit_kwargs)
+
+
+def find(name: str) -> Optional[GuardedFn]:
+    """The most recently created guarded function with ``name`` (fresh train
+    loops create fresh instances; tests and bench want the latest run's)."""
+    with _LOCK:
+        for gfn in reversed(_REGISTRY):
+            if gfn.name == name:
+                return gfn
+    return None
+
+
+def process_stats() -> Dict[str, Any]:
+    """Totals across every guarded function plus persistent-cache counters."""
+    with _LOCK:
+        fns = list(_REGISTRY)
+        cache = dict(_CACHE_COUNTS)
+    totals = {
+        "calls": 0,
+        "traces": 0,
+        "retraces": 0,
+        "aot_compiles": 0,
+        "aot_fallbacks": 0,
+        "compile_seconds": 0.0,
+    }
+    per_fn = {}
+    for gfn in fns:
+        s = gfn.stats()
+        per_fn[s["name"]] = s
+        for k in totals:
+            totals[k] += s[k]
+    totals.update(cache)
+    totals["functions"] = per_fn
+    return totals
+
+
+def drain_compile_counters(aggregator: Optional[Any]) -> Dict[str, float]:
+    """Fold the delta since the last drain into the aggregator (log-boundary
+    hook, same shape as ``resilience.drain_env_counters``). Always updates the
+    registered ``Compile/*`` keys — an explicit 0 in the logs is the signal
+    that steady state held."""
+    totals = process_stats()
+    current = {
+        "Compile/retraces": float(totals["retraces"]),
+        "Compile/cache_hits": float(totals["cache_hits"]),
+        "Compile/cache_misses": float(totals["cache_misses"]),
+        "Time/compile_seconds": float(totals["compile_seconds"]),
+    }
+    with _LOCK:
+        delta = {k: v - _DRAINED.get(k, 0.0) for k, v in current.items()}
+        _DRAINED.update(current)
+    if aggregator is not None and not getattr(aggregator, "disabled", False):
+        for k, v in delta.items():
+            if k in aggregator:
+                aggregator.update(k, v)
+    return delta
+
+
+# --------------------------------------------------------------------------- #
+# AOT warmup
+# --------------------------------------------------------------------------- #
+
+
+class AOTWarmup:
+    """Background-thread AOT compiler for a run's jitted entry points.
+
+    Register (guarded_fn, specs) jobs — or arbitrary callables — then
+    ``start()``: compilation overlaps env reset / first-rollout collection /
+    buffer allocation on the main thread. ``wait()`` before the first guarded
+    call that must not trace. Warmup is best-effort: a failed job logs a
+    warning and the entry point falls back to JIT-on-first-call.
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._jobs: List[Tuple[Any, Tuple, Dict, Optional[threading.Event]]] = []
+        self._thread: Optional[threading.Thread] = None
+        self._done = threading.Event()
+        self.errors: List[Tuple[str, BaseException]] = []
+        if not self.enabled:
+            self._done.set()
+
+    def add(self, gfn: GuardedFn, *specs: Any, **kwspecs: Any) -> None:
+        """Queue ``gfn.aot_compile(*specs, **kwspecs)``. The fn is marked
+        pending so a racing call waits for this compile instead of tracing."""
+        if self.enabled:
+            if not isinstance(gfn, GuardedFn):
+                # some act paths hand back a plain jitted callable (e.g. the
+                # device-rollout composition); warmup is best-effort, skip it
+                _logger.debug("[compile] skipping AOT warmup of non-guarded %r", gfn)
+                return
+            ev = threading.Event()
+            gfn._aot_pending.append(ev)
+            self._jobs.append((gfn, specs, kwspecs, ev))
+
+    def add_task(self, task: Callable[[], Any], name: str = "task") -> None:
+        """Queue an arbitrary warmup callable (e.g. metric-drain precompiles)."""
+        if self.enabled:
+            self._jobs.append((None, (task, name), {}, None))
+
+    def start(self) -> "AOTWarmup":
+        if not self.enabled or not self._jobs:
+            self._done.set()
+            return self
+        self._thread = threading.Thread(target=self._run, name="sheeprl-aot-warmup", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        for gfn, specs, kwspecs, ev in self._jobs:
+            try:
+                if gfn is None:
+                    task, _name = specs
+                    task()
+                else:
+                    gfn.aot_compile(*specs, **kwspecs)
+            except Exception as e:  # warmup must never kill the run
+                name = specs[1] if gfn is None else gfn.name
+                self.errors.append((name, e))
+                _logger.warning("[compile] AOT warmup of '%s' failed (%s: %s); falling back "
+                                "to JIT on first call", name, type(e).__name__, e)
+            finally:
+                if ev is not None:
+                    ev.set()
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every queued warmup compile finished (cheap once done)."""
+        return self._done.wait(timeout)
+
+
+# --------------------------------------------------------------------------- #
+# Canonical shapes: pow-2 bucketing + padded stacking
+# --------------------------------------------------------------------------- #
+
+
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power of two >= max(n, minimum): a drifting count maps onto
+    O(log) distinct compiled shapes instead of one compile per value."""
+    n = max(int(n), int(minimum), 1)
+    bucket = 1
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def bucketed_pad(
+    sequences: Dict[str, List[np.ndarray]],
+    lengths: Sequence[int],
+    length: int,
+    dtype=np.float32,
+) -> Dict[str, np.ndarray]:
+    """Stack ragged per-key chunk lists ``[t_i, ...]`` into ``[length, W, ...]``
+    arrays plus a ``mask`` ``[length, W, 1]``, with W = :func:`pow2_bucket` of
+    the chunk count. Zero-padded rows/columns carry mask 0, so losses ignore
+    them and the jitted consumer sees a bounded set of shapes."""
+    n_seq = len(lengths)
+    if n_seq == 0:
+        raise ValueError("bucketed_pad needs at least one sequence")
+    bucket = pow2_bucket(n_seq)
+    out: Dict[str, np.ndarray] = {}
+    for k, chunks in sequences.items():
+        if len(chunks) != n_seq:
+            raise ValueError(f"key '{k}' has {len(chunks)} chunks for {n_seq} lengths")
+        sample_shape = chunks[0].shape[1:]
+        arr = np.zeros((length, bucket, *sample_shape), dtype=dtype)
+        for i, c in enumerate(chunks):
+            arr[: c.shape[0], i] = c
+        out[k] = arr
+    mask = np.zeros((length, bucket, 1), dtype=dtype)
+    for i, ln in enumerate(lengths):
+        mask[:ln, i] = 1.0
+    out["mask"] = mask
+    return out

@@ -16,6 +16,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from sheeprl_tpu.core import compile as jax_compile
+
 
 def _to_float(value) -> float:
     if isinstance(value, (int, float)):
@@ -108,11 +110,11 @@ def _acc_step(state, vec):
     return s + vec, jnp.maximum(mx, vec), vec
 
 
-_ACC_STEP = jax.jit(_acc_step, donate_argnums=(0,))
+_ACC_STEP = jax_compile.guarded_jit(_acc_step, name="metric.acc_step", donate_argnums=(0,))
 
 # materializes a fresh buffer: the initial (sum, max, last) state must be three
 # DISTINCT buffers or the next donated step would donate one buffer three times
-_ACC_COPY = jax.jit(lambda v: v + 0)
+_ACC_COPY = jax_compile.guarded_jit(lambda v: v + 0, name="metric.acc_copy")
 
 # metric classes whose window result is recoverable from (sum, max, last, count)
 # — custom subclasses fall back to the immediate-pull path so their update()
@@ -201,6 +203,21 @@ class MetricAggregator:
             else:
                 acc[0] = _ACC_STEP(acc[0], vec)
                 acc[1] += 1
+
+    def precompile_drain(self, keys: Sequence[str]) -> None:
+        """AOT-compile the device accumulation path for a train metric dict with
+        ``keys`` (warmup hook: the loops queue this on the AOT thread so the
+        first ``update_from_device`` executes pre-built kernels). Only the
+        deferred-drainable subset shapes the kernels, mirroring
+        :meth:`update_from_device`'s key filtering."""
+        if self.disabled:
+            return
+        deferred = tuple(k for k in keys if k in self.metrics and type(self.metrics[k]) in _DRAINABLE)
+        if not deferred:
+            return
+        vec = jax.ShapeDtypeStruct((len(deferred),), jnp.float32)
+        _ACC_COPY.aot_compile(vec)
+        _ACC_STEP.aot_compile((vec, vec, vec), vec)
 
     def _drain_device_acc(self) -> None:
         """ONE device->host pull per keys-signature: fold the window's device

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.core import compile as jax_compile
+
 
 class dotdict(dict):
     """Nested dict with attribute access (recursively converts nested mappings).
@@ -249,7 +251,7 @@ class PlayerParamsSync:
 
         self._ravel_pytree = ravel_pytree
         _, self._unravel = ravel_pytree(player_params)
-        self._unravel_jit = jax.jit(self._unravel)
+        self._unravel_jit = jax_compile.guarded_jit(self._unravel, name="sync.unravel")
 
     def ravel(self, params) -> jax.Array:
         """Flatten on the training mesh — call from inside the jitted train step."""
@@ -286,7 +288,7 @@ class DreamerPlayerSync:
         self.enabled = bool(runtime.player_on_host)
         if self.enabled:
             self._sync = PlayerParamsSync(self.subset(params))
-            self._ravel_jit = jax.jit(self._sync.ravel)
+            self._ravel_jit = jax_compile.guarded_jit(self._sync.ravel, name="sync.ravel")
 
     def subset(self, params):
         wm = params["world_model"]

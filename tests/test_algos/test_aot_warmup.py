@@ -1,0 +1,96 @@
+"""AOT spec fidelity end-to-end: the eval_shape-derived warmup specs must match
+the real first batch, so the hot-path entry points execute pre-built
+executables (zero traces at call time) and record zero retraces over a short
+multi-iteration run. This is the acceptance contract of the compile subsystem:
+if a loop's spec derivation drifts from what it actually feeds the jitted
+functions, these assertions are the first thing to break.
+"""
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.core import compile as jax_compile
+
+
+def _assert_warmed(name: str):
+    gfn = jax_compile.find(name)
+    assert gfn is not None, f"{name} was never created"
+    assert gfn.calls >= 1, f"{name} was never called"
+    assert gfn.aot_compiles >= 1, f"{name} was not AOT-warmed"
+    assert gfn.traces == 0, f"{name} traced {gfn.traces}x despite warmup (spec mismatch)"
+    assert gfn.retraces == 0, f"{name} retraced: {gfn.last_diff}"
+    return gfn
+
+
+@pytest.mark.timeout(300)
+def test_ppo_aot_specs_match_first_batch(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=[
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "algo.total_steps=48",  # 3 iterations of 2 envs x 8 steps
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.run_test=False",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+        ]
+    )
+    train = _assert_warmed("ppo.train")
+    assert train.calls == 3
+    act = _assert_warmed("ppo.act_packed")
+    assert act.calls >= 24  # one per env step
+
+
+@pytest.mark.timeout(300)
+def test_dreamer_v3_aot_specs_match_first_batch(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "algo.total_steps=8",  # 8 iterations (1 policy step each)
+            "algo.learning_starts=2",
+            "algo.replay_ratio=1",
+            "algo.per_rank_batch_size=1",
+            "algo.per_rank_sequence_length=1",
+            "buffer.size=16",
+            "algo.horizon=4",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "algo.world_model.recurrent_model.recurrent_state_size=8",
+            "algo.world_model.representation_model.hidden_size=8",
+            "algo.world_model.transition_model.hidden_size=8",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.run_test=False",
+            "metric.log_level=0",
+            "checkpoint.save_last=False",
+            "buffer.memmap=False",
+        ]
+    )
+    train = _assert_warmed("dv3.train")
+    assert train.calls >= 1
+    # both the f32 post-reset state and the bf16 steady state must be covered
+    step = _assert_warmed("dv3.step_packed")
+    assert step.calls >= 2  # prefill iterations act randomly; the rest use the policy

@@ -1,0 +1,164 @@
+"""Retrace guard + AOT routing unit tests (core/compile.py).
+
+Covers the perf contract the train loops rely on: a warmed signature never
+traces, a drifting signature is counted and diffed, and ``guard.policy=halt``
+turns post-steady drift into a hard error instead of a silent recompile storm.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import compile as jax_compile
+
+
+@pytest.fixture(autouse=True)
+def _reset_guard_state():
+    # policy/steady watermark are process-wide: restore the defaults so test
+    # order never leaks a `halt` policy into unrelated tests
+    jax_compile.configure({})
+    yield
+    jax_compile.configure({})
+
+
+def test_first_compile_is_not_a_retrace():
+    gfn = jax_compile.guarded_jit(lambda x: x * 2, name="t.first")
+    out = gfn(jnp.ones((4,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert gfn.traces == 1
+    assert gfn.retraces == 0
+
+
+def test_shape_drift_counts_retraces_and_logs_diff(caplog):
+    gfn = jax_compile.guarded_jit(lambda x: x + 1, name="t.drift")
+    gfn(jnp.ones((4,)))
+    with caplog.at_level(logging.WARNING, logger="sheeprl_tpu.compile"):
+        gfn(jnp.ones((8,)))
+    assert gfn.retraces == 1
+    assert gfn.last_diff is not None
+    assert "(4,)" in gfn.last_diff and "(8,)" in gfn.last_diff
+    assert any("retrace" in rec.message for rec in caplog.records)
+    # same shapes again: served from jit's cache, no new trace
+    calls_before = gfn.traces
+    gfn(jnp.ones((8,)))
+    assert gfn.traces == calls_before
+
+
+def test_dtype_drift_is_diffed():
+    gfn = jax_compile.guarded_jit(lambda x: x + 1, name="t.dtype")
+    gfn(jnp.ones((4,), jnp.float32))
+    gfn(jnp.ones((4,), jnp.int32))
+    assert gfn.retraces == 1
+    assert "float32" in gfn.last_diff and "int32" in gfn.last_diff
+
+
+def test_halt_policy_raises_after_steady():
+    jax_compile.configure({"compile": {"guard": {"policy": "halt"}}})
+    gfn = jax_compile.guarded_jit(lambda x: x * 3, name="t.halt")
+    gfn(jnp.ones((4,)))
+    jax_compile.mark_steady()
+    with pytest.raises(jax_compile.RetraceError):
+        gfn(jnp.ones((16,)))
+
+
+def test_warn_policy_never_raises_after_steady():
+    gfn = jax_compile.guarded_jit(lambda x: x * 3, name="t.warn")
+    gfn(jnp.ones((4,)))
+    jax_compile.mark_steady()
+    gfn(jnp.ones((16,)))  # logs, but must not raise
+    assert gfn.retraces == 1
+
+
+def test_aot_route_never_traces():
+    gfn = jax_compile.guarded_jit(lambda x: x @ x, name="t.aot")
+    gfn.aot_compile(jax.ShapeDtypeStruct((3, 3), jnp.float32))
+    assert gfn.aot_compiles == 1
+    out = gfn(jnp.eye(3))
+    np.testing.assert_allclose(np.asarray(out), np.eye(3))
+    assert gfn.traces == 0
+    assert gfn.calls == 1
+
+
+def test_aot_route_accepts_weak_typed_inputs():
+    # jnp.full with a python float builds a weak-typed array; the router must
+    # still hit the strong-typed executable (weak_type is erased from the key)
+    gfn = jax_compile.guarded_jit(lambda x: x + x, name="t.weak")
+    gfn.aot_compile(jax.ShapeDtypeStruct((3, 3), jnp.float32))
+    gfn(jnp.full((3, 3), 2.0))
+    assert gfn.traces == 0
+
+
+def test_unwarmed_shape_falls_back_to_jit_and_counts_retrace():
+    gfn = jax_compile.guarded_jit(lambda x: x + 1, name="t.fallback")
+    gfn.aot_compile(jax.ShapeDtypeStruct((4,), jnp.float32))
+    # a shape the warmup did not cover: correctness first (jit path), but the
+    # guard flags it — this is exactly the drift the AOT specs must prevent
+    out = gfn(jnp.ones((5,)))
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    assert gfn.traces == 1
+    assert gfn.retraces == 1
+
+
+def test_guarded_jit_static_argnames():
+    def f(x, flag):
+        return x * 2 if flag else x
+
+    gfn = jax_compile.guarded_jit(f, name="t.static", static_argnames=("flag",))
+    np.testing.assert_allclose(np.asarray(gfn(jnp.ones(()), True)), 2.0)
+    np.testing.assert_allclose(np.asarray(gfn(jnp.ones(()), False)), 1.0)
+    assert gfn.traces == 2  # one per static value: expected, both are first compiles per branch
+
+
+def test_drain_compile_counters_reports_delta():
+    gfn = jax_compile.guarded_jit(lambda x: x + 1, name="t.drain")
+    gfn(jnp.ones((2,)))
+    gfn(jnp.ones((3,)))
+    jax_compile.drain_compile_counters(None)  # snapshot
+    delta = jax_compile.drain_compile_counters(None)
+    assert delta["Compile/retraces"] == 0.0
+    gfn(jnp.ones((7,)))
+    delta = jax_compile.drain_compile_counters(None)
+    assert delta["Compile/retraces"] == 1.0
+
+
+def test_signature_excludes_committed_device_but_keeps_structure():
+    gfn = jax_compile.guarded_jit(lambda tree: tree["a"] + tree["b"], name="t.tree")
+    gfn.aot_compile({"a": jax.ShapeDtypeStruct((2,), jnp.float32), "b": jax.ShapeDtypeStruct((2,), jnp.float32)})
+    gfn({"a": jnp.ones((2,)), "b": jnp.ones((2,))})
+    assert gfn.traces == 0
+    # different pytree structure: distinct signature, routed to the jit path
+    gfn({"a": jnp.ones((2,)), "b": jnp.ones((2,)), "c": jnp.ones((2,))})
+
+
+def test_pow2_bucket():
+    assert jax_compile.pow2_bucket(0) == 1
+    assert jax_compile.pow2_bucket(1) == 1
+    assert jax_compile.pow2_bucket(3) == 4
+    assert jax_compile.pow2_bucket(4) == 4
+    assert jax_compile.pow2_bucket(9) == 16
+    assert jax_compile.pow2_bucket(2, minimum=8) == 8
+
+
+def test_bucketed_pad_shapes_and_mask():
+    chunks = {
+        "obs": [np.ones((3, 5), np.float32), np.ones((2, 5), np.float32), np.ones((4, 5), np.float32)],
+        "rew": [np.ones((3, 1), np.float32), np.ones((2, 1), np.float32), np.ones((4, 1), np.float32)],
+    }
+    out = jax_compile.bucketed_pad(chunks, lengths=[3, 2, 4], length=4)
+    assert out["obs"].shape == (4, 4, 5)  # [sl, pow2_bucket(3)=4, feat]
+    assert out["rew"].shape == (4, 4, 1)
+    assert out["mask"].shape == (4, 4, 1)
+    np.testing.assert_array_equal(out["mask"][:, 0, 0], [1, 1, 1, 0])
+    np.testing.assert_array_equal(out["mask"][:, 1, 0], [1, 1, 0, 0])
+    np.testing.assert_array_equal(out["mask"][:, 3, 0], [0, 0, 0, 0])  # pure padding column
+    assert out["obs"][3, 1].sum() == 0.0  # padded rows stay zero
+
+
+def test_bucketed_pad_rejects_empty_and_ragged():
+    with pytest.raises(ValueError):
+        jax_compile.bucketed_pad({"x": []}, lengths=[], length=4)
+    with pytest.raises(ValueError):
+        jax_compile.bucketed_pad({"x": [np.ones((2, 1))]}, lengths=[2, 3], length=4)
