@@ -353,6 +353,38 @@ def bench_compile(total_steps: int = 64) -> dict:
     return result
 
 
+def bench_health() -> dict:
+    """Self-healing runtime drill: detection latency + rollback wall clock.
+
+    Reuses the scripts/health_smoke.py scenario (chaos reward-spike PPO run:
+    the sentinel must detect the divergence, climb warn -> backoff -> rollback,
+    restore a certified checkpoint, and complete). The numbers measure the
+    health machinery itself — the smoke child runs on the CPU backend, so they
+    are comparable across rounds but say nothing about accelerator throughput.
+    """
+    import importlib.util
+    import os
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "health_smoke",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "health_smoke.py"),
+    )
+    health_smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(health_smoke)
+
+    t0 = time.perf_counter()
+    smoke = health_smoke.main(tempfile.mkdtemp(prefix="bench_health_"))
+    return {
+        "health_detection_latency_s": smoke["detection_latency_s"],
+        "health_detection_latency_steps": smoke["detection_latency_steps"],
+        "health_rollback_wall_s": smoke["rollback_wall_s"],
+        "health_rollbacks": smoke["rollbacks"],
+        "health_certified_sidecars": smoke["certified_sidecars"],
+        "health_drill_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _target_metric(target: str) -> str:
     """Headline metric name for a bench target — the watchdog's failure record
     must name the metric the selected target WOULD have produced, not hardcode
@@ -362,6 +394,7 @@ def _target_metric(target: str) -> str:
         "ppo": "ppo_cartpole_env_steps_per_sec",
         "dv3": "dv3_gsteps_per_sec",
         "compile": "compile_warm_first_train_step_s",
+        "health": "health_detection_latency_s",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -417,7 +450,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "all"),
+        choices=("ppo", "dv3", "compile", "health", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -524,6 +557,14 @@ if __name__ == "__main__":
                         result.setdefault("vs_baseline", comp.get("compile_warm_speedup"))
                 except Exception as e:  # a compile-bench failure must not lose the other numbers
                     result["compile_error"] = f"{type(e).__name__}: {e}"
+            if cli_args.target == "health":
+                # opt-in only (not part of "all"): a CPU-backend resilience
+                # drill, not an accelerator throughput number
+                health = bench_health()
+                result.update(health)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", health.get("health_detection_latency_s"))
+                result.setdefault("unit", "s")
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
         # numbers are real but from the CPU backend — flag them as incomparable
         result["cpu_fallback"] = True

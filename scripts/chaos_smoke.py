@@ -15,7 +15,10 @@ fault-tolerant runtime end-to-end:
 4. resume from it in a fresh process and assert exit 0.
 
 Run directly (``python scripts/chaos_smoke.py``) or through the registered
-tier-1 test (tests/test_utils/test_chaos_smoke.py).
+tier-1 test (tests/test_utils/test_chaos_smoke.py). The companion rollback
+drill — a chaos DIVERGENCE fault (reward spike) that the health sentinel must
+detect and answer by restoring a certified checkpoint — lives in
+``scripts/health_smoke.py`` with the same harness shape.
 """
 
 from __future__ import annotations

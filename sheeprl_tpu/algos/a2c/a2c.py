@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
@@ -64,7 +65,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def train(params, opt_state, data, next_values, key):
+    def train(params, opt_state, data, next_values, key, lr_scale):
         returns, advantages = gae(
             data["rewards"],
             data["values"],
@@ -94,12 +95,15 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
         (grads, pg_sum, v_sum), _ = jax.lax.scan(
             accumulate, (zero_grads, jnp.float32(0), jnp.float32(0)), perm
         )
+        gnorm = optax.global_norm(grads)
         updates, new_opt_state = tx.update(grads, opt_state, params)
+        # health-sentinel LR backoff: traced scalar operand; 1.0 is IEEE-exact
+        updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
         new_params = optax.apply_updates(params, updates)
         if nonfinite_guard:
             # one accumulated update per iteration: guard that single apply
             (params, opt_state), skipped = resilience.finite_or_skip(
-                (pg_sum, v_sum, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+                (pg_sum, v_sum, gnorm), (new_params, new_opt_state), (params, opt_state)
             )
         else:
             params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
@@ -108,6 +112,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
             "Loss/policy_loss": pg_sum / n_minibatches,
             "Loss/value_loss": v_sum / n_minibatches,
             "Resilience/nonfinite_skips": skipped,
+            "Grads/global_norm": gnorm,
         }
 
     return jax_compile.guarded_jit(train, name="a2c.train", donate_argnums=(0, 1))
@@ -133,6 +138,9 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
 
     ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
     n_envs = cfg.env.num_envs * world_size
     envs = resilience.make_supervised_env(
         [
@@ -243,11 +251,17 @@ def main(runtime, cfg: Dict[str, Any]):
                 data_specs,
                 jax.ShapeDtypeStruct(val_s.shape, jnp.float32),
                 jax_compile.spec_like(rng),
+                jax.ShapeDtypeStruct((), jnp.float32),
             )
         if aggregator is not None:
             warmup.add_task(
                 lambda: aggregator.precompile_drain(
-                    ("Loss/policy_loss", "Loss/value_loss", "Resilience/nonfinite_skips")
+                    (
+                        "Loss/policy_loss",
+                        "Loss/value_loss",
+                        "Resilience/nonfinite_skips",
+                        "Grads/global_norm",
+                    )
                 ),
                 name="metric.drain",
             )
@@ -383,7 +397,8 @@ def main(runtime, cfg: Dict[str, Any]):
                         k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
                     }
                 params, opt_state, flat_params, train_metrics = train_fn(
-                    params, opt_state, device_data, next_values, train_key
+                    params, opt_state, device_data, next_values, train_key,
+                    jnp.float32(sentinel.lr_scale),
                 )
                 player.params = params_sync.pull(flat_params, runtime.player_device)
                 if not timer.disabled:
@@ -426,25 +441,67 @@ def main(runtime, cfg: Dict[str, Any]):
                     last_train = train_step
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
-            resilience.drain_env_counters(envs, aggregator)
+            env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
             if iter_num == start_iter:
                 # everything reachable has compiled once: later traces are drift
                 jax_compile.mark_steady()
+
+            # ----- health sentinel: warn -> backoff (lr_scale) -> rollback
+            action = sentinel.observe(policy_step, train_metrics=train_metrics, env_counters=env_deltas)
+            if action.rollback:
+                rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+                if rb_state is not None:
+                    params = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["agent"])
+                    )
+                    opt_state = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["optimizer"])
+                    )
+                    if "rng" in rb_state:
+                        rng = jnp.asarray(rb_state["rng"])
+                        player_rng = jax.device_put(
+                            jnp.asarray(rb_state["player_rng"]), runtime.player_device
+                        )
+                    player.params = params_sync.pull(params_sync.ravel(params), runtime.player_device)
+                    if sentinel.reseed_envs:
+                        pending.clear()
+                        reset_obs = envs.reset(seed=cfg.seed + iter_num)[0]
+                        next_obs = {}
+                        for k in obs_keys:
+                            next_obs[k] = reset_obs[k]
+                            step_data[k] = reset_obs[k][np.newaxis]
+                    runtime.print(
+                        f"Health rollback at policy_step={policy_step}: restored certified "
+                        "checkpoint, training continues."
+                    )
+            sentinel.drain(aggregator)
 
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last
             ):
                 last_checkpoint = policy_step
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=_ckpt_state(),
+                    healthy=sentinel.certifiable,
+                    policy_step=policy_step,
+                )
 
             guard.completed_iteration()
             if guard.should_stop:
                 if last_checkpoint != policy_step:  # periodic save above already covered this step
                     last_checkpoint = policy_step
                     ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                    runtime.call(
+                        "on_checkpoint_coupled",
+                        ckpt_path=ckpt_path,
+                        state=_ckpt_state(),
+                        healthy=sentinel.certifiable,
+                        policy_step=policy_step,
+                    )
                 runtime.print(
                     f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
                     "checkpoint saved, exiting cleanly for resume."

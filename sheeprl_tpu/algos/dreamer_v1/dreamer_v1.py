@@ -23,6 +23,8 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import health as health_mod
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.algos.dreamer_v1.agent import DV1Modules, build_agent
 from sheeprl_tpu.algos.dreamer_v1.loss import actor_loss, critic_loss, reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v1.utils import compute_lambda_values, test
@@ -31,7 +33,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal
-from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.optim import with_clipping
@@ -285,7 +287,11 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
-    envs = vectorized_env(
+    ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
+    envs = resilience.make_supervised_env(
         [
             make_env(
                 cfg,
@@ -298,6 +304,7 @@ def main(runtime, cfg: Dict[str, Any]):
             for i in range(cfg.env.num_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
@@ -515,6 +522,9 @@ def main(runtime, cfg: Dict[str, Any]):
         if iter_num >= learning_starts:
             ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
             per_rank_gradient_steps = ratio(ratio_steps / world_size)
+            if per_rank_gradient_steps > 0 and sentinel.ratio_scale < 1.0:
+                # health-sentinel backoff: shrink this round's gradient grant
+                per_rank_gradient_steps = max(1, int(per_rank_gradient_steps * sentinel.ratio_scale))
             if per_rank_gradient_steps > 0:
                 if not trained_once:
                     # first sample: complete the env step serially so the buffer
@@ -586,10 +596,41 @@ def main(runtime, cfg: Dict[str, Any]):
             last_train = train_step
 
         # ---- checkpoint
+        env_deltas = resilience.drain_env_counters(envs, aggregator)
         jax_compile.drain_compile_counters(aggregator)
         if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
             # everything reachable has compiled once: later traces are drift
             jax_compile.mark_steady()
+
+        # ----- health sentinel: warn -> backoff (ratio grant above) -> rollback
+        action = sentinel.observe(
+            policy_step,
+            train_metrics=train_metrics if "train_metrics" in dir() else None,
+            env_counters=env_deltas,
+        )
+        if action.rollback:
+            rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+            if rb_state is not None:
+                params = runtime.place_params(
+                    {
+                        **params,
+                        "world_model": jax.tree_util.tree_map(jnp.asarray, rb_state["world_model"]),
+                        "actor": jax.tree_util.tree_map(jnp.asarray, rb_state["actor"]),
+                        "critic": jax.tree_util.tree_map(jnp.asarray, rb_state["critic"]),
+                    }
+                )
+                opt_states = runtime.place_params(
+                    jax.tree_util.tree_map(jnp.asarray, rb_state["opt_states"])
+                )
+                ratio.load_state_dict(rb_state["ratio"])
+                # replay rows stay valid off-policy data; only the learner
+                # (and the player's copy of it) rewinds to the snapshot
+                psync.push(player, params, force=True)
+                runtime.print(
+                    f"Health rollback at policy_step={policy_step}: restored certified "
+                    "checkpoint, training continues."
+                )
+        sentinel.drain(aggregator)
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last
@@ -613,6 +654,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
                 io_lock=prefetcher.guard(),
+                healthy=sentinel.certifiable,
+                policy_step=policy_step,
             )
 
     profiler.close()

@@ -36,6 +36,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
@@ -459,6 +460,9 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
 
     ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
     env_fns = [
         make_env(
             cfg,
@@ -749,6 +753,8 @@ def main(runtime, cfg: Dict[str, Any]):
             state=ckpt_state,
             replay_buffer=rb if cfg.buffer.checkpoint else None,
             io_lock=prefetcher.guard(),
+            healthy=sentinel.certifiable,
+            policy_step=policy_step,
         )
 
     guard = resilience.PreemptionGuard(
@@ -863,6 +869,9 @@ def main(runtime, cfg: Dict[str, Any]):
             if iter_num >= learning_starts:
                 ratio_steps = policy_step - prefill_steps * policy_steps_per_iter
                 per_rank_gradient_steps = ratio(ratio_steps / world_size)
+                if per_rank_gradient_steps > 0 and sentinel.ratio_scale < 1.0:
+                    # health-sentinel backoff: shrink this round's gradient grant
+                    per_rank_gradient_steps = max(1, int(per_rank_gradient_steps * sentinel.ratio_scale))
                 if per_rank_gradient_steps > 0:
                     # steady-state: this consumes the batch prefetched during the previous
                     # train step and immediately starts speculating the next one
@@ -890,12 +899,47 @@ def main(runtime, cfg: Dict[str, Any]):
                     if aggregator:
                         aggregator.update_from_device(train_metrics)
                     resilience.enforce_nonfinite_policy(ft, train_metrics)
-            resilience.drain_env_counters(envs, aggregator)
+            env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
             if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
                 # steady-state watermark: the first real train iteration has
                 # compiled everything; any retrace from here is a perf cliff
                 jax_compile.mark_steady()
+
+            # ----- health sentinel: warn -> backoff (ratio grant above) -> rollback
+            action = sentinel.observe(
+                policy_step,
+                train_metrics=train_metrics if "train_metrics" in dir() else None,
+                env_counters=env_deltas,
+            )
+            if action.rollback:
+                rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+                if rb_state is not None:
+                    params = runtime.place_params(
+                        {
+                            **params,
+                            "world_model": jax.tree_util.tree_map(jnp.asarray, rb_state["world_model"]),
+                            "actor": jax.tree_util.tree_map(jnp.asarray, rb_state["actor"]),
+                            "critic": jax.tree_util.tree_map(jnp.asarray, rb_state["critic"]),
+                            "target_critic": jax.tree_util.tree_map(jnp.asarray, rb_state["target_critic"]),
+                        }
+                    )
+                    opt_states = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["opt_states"])
+                    )
+                    moments_state = MomentsState(*[jnp.asarray(v) for v in rb_state["moments"]])
+                    counter = jnp.int32(rb_state["counter"])
+                    ratio.load_state_dict(rb_state["ratio"])
+                    if "rng" in rb_state:
+                        rng = jnp.asarray(rb_state["rng"])
+                    # replay rows stay valid off-policy data; only the learner
+                    # (and the player's copy of it) rewinds to the snapshot
+                    psync.push(player, params, force=True)
+                    runtime.print(
+                        f"Health rollback at policy_step={policy_step}: restored certified "
+                        "checkpoint, training continues."
+                    )
+            sentinel.drain(aggregator)
 
             # ---- logging
             if cfg.metric.log_level > 0 and (policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters):

@@ -30,7 +30,8 @@ from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.core import resilience
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
@@ -197,12 +198,14 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
 
     n_envs = cfg.env.num_envs * world_size
-    envs = vectorized_env(
+    ft = resilience.resolve(cfg)
+    envs = resilience.make_supervised_env(
         [
             make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
             for i in range(n_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
@@ -418,6 +421,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
+        resilience.drain_env_counters(envs, aggregator)
         jax_compile.drain_compile_counters(aggregator)
         if train_calls > 0 and not jax_compile.is_steady():
             # everything reachable has compiled once: later traces are drift

@@ -28,7 +28,8 @@ from sheeprl_tpu.algos.dreamer_v3.utils import get_action_masks
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.factory import make_episode_replay, make_sequential_replay
 from sheeprl_tpu.utils.checkpoint import load_state
-from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
+from sheeprl_tpu.core import resilience
+from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
@@ -76,7 +77,8 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
-    envs = vectorized_env(
+    ft = resilience.resolve(cfg)
+    envs = resilience.make_supervised_env(
         [
             make_env(
                 cfg,
@@ -89,6 +91,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             for i in range(cfg.env.num_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
@@ -375,6 +378,7 @@ def main(runtime, cfg: Dict[str, Any], exploration_cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
+        resilience.drain_env_counters(envs, aggregator)
         jax_compile.drain_compile_counters(aggregator)
         if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
             # everything reachable has compiled once: later traces are drift

@@ -52,6 +52,7 @@ from sheeprl_tpu.ops.distributions import (
     SymlogDistribution,
     TwoHotEncodingDistribution,
 )
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env, vectorized_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -588,23 +589,28 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
-    envs = vectorized_env(
-        [
-            partial(
-                RestartOnException,
-                make_env(
-                    cfg,
-                    cfg.seed + rank * cfg.env.num_envs + i,
-                    rank * cfg.env.num_envs,
-                    log_dir if runtime.is_global_zero else None,
-                    "train",
-                    vector_env_idx=i,
-                ),
-            )
-            for i in range(cfg.env.num_envs)
-        ],
-        sync=cfg.env.sync_env,
-    )
+    ft = resilience.resolve(cfg)
+    env_fns = [
+        make_env(
+            cfg,
+            cfg.seed + rank * cfg.env.num_envs + i,
+            rank * cfg.env.num_envs,
+            log_dir if runtime.is_global_zero else None,
+            "train",
+            vector_env_idx=i,
+        )
+        for i in range(cfg.env.num_envs)
+    ]
+    if ft.env_supervision.enabled:
+        # WorkerSupervisor supersedes RestartOnException (same restart-on-crash
+        # semantics plus bounded backoff, hang detection, and restart counters)
+        envs = resilience.make_supervised_env(env_fns, sync=cfg.env.sync_env, ft=ft)
+    else:
+        envs = vectorized_env(
+            [partial(RestartOnException, fn) for fn in env_fns],
+            sync=cfg.env.sync_env,
+            step_timeout=ft.env_supervision.step_timeout_s,
+        )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
 
@@ -879,6 +885,7 @@ def main(runtime, cfg: Dict[str, Any]):
             last_log = policy_step
             last_train = train_step
 
+        resilience.drain_env_counters(envs, aggregator)
         jax_compile.drain_compile_counters(aggregator)
         if cumulative_per_rank_gradient_steps > 0 and not jax_compile.is_steady():
             # everything reachable has compiled once: later traces are drift

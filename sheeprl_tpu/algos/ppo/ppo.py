@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
@@ -85,7 +86,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def train(params, opt_state, data, next_values, key, clip_coef, ent_coef):
+    def train(params, opt_state, data, next_values, key, clip_coef, ent_coef, lr_scale):
         # ----- GAE on device (reverse lax.scan over T; reference utils.py:64-100)
         returns, advantages = gae(
             data["rewards"],
@@ -113,15 +114,20 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
                 lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
             )
             (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
+            gnorm = optax.global_norm(grads)
             updates, new_opt_state = tx.update(grads, opt_state, params)
+            # health-sentinel LR backoff: a traced scalar operand (no retrace on
+            # change); the healthy value is exactly 1.0, and x * 1.0 is IEEE-
+            # exact, so a disabled/quiet sentinel leaves updates bit-identical
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
             new_params = optax.apply_updates(params, updates)
             if nonfinite_guard:
                 (params, opt_state), skipped = resilience.finite_or_skip(
-                    (loss, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+                    (loss, gnorm), (new_params, new_opt_state), (params, opt_state)
                 )
             else:
                 params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
-            return (params, opt_state), jnp.stack([pg, vl, ent, skipped])
+            return (params, opt_state), jnp.stack([pg, vl, ent, skipped, gnorm])
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
@@ -131,6 +137,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
             "Resilience/nonfinite_skips": losses[:, 3].sum(),
+            "Grads/global_norm": metrics[4],
         }
 
     return jax_compile.guarded_jit(train, name="ppo.train", donate_argnums=(0, 1))
@@ -165,6 +172,9 @@ def main(runtime, cfg: Dict[str, Any]):
     # Environment setup: one process drives world_size * num_envs envs (per-rank
     # semantics of the reference are per-device here).
     ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
     n_envs = cfg.env.num_envs * world_size
     envs = resilience.make_supervised_env(
         [
@@ -340,11 +350,18 @@ def main(runtime, cfg: Dict[str, Any]):
                 jax_compile.spec_like(rng),
                 jax.ShapeDtypeStruct((), jnp.float32),
                 jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
             )
         if aggregator is not None:
             warmup.add_task(
                 lambda: aggregator.precompile_drain(
-                    ("Loss/policy_loss", "Loss/value_loss", "Loss/entropy_loss", "Resilience/nonfinite_skips")
+                    (
+                        "Loss/policy_loss",
+                        "Loss/value_loss",
+                        "Loss/entropy_loss",
+                        "Resilience/nonfinite_skips",
+                        "Grads/global_norm",
+                    )
                 ),
                 name="metric.drain",
             )
@@ -546,6 +563,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     train_key,
                     jnp.float32(cfg.algo.clip_coef),
                     jnp.float32(cfg.algo.ent_coef),
+                    jnp.float32(sentinel.lr_scale),
                 )
                 # refresh the player's copy with ONE cross-backend transfer; the next
                 # rollout implicitly waits for (only) the params it needs
@@ -603,19 +621,62 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
-            resilience.drain_env_counters(envs, aggregator)
+            env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
             if iter_num == start_iter:
                 # steady-state watermark: everything this loop will ever compile
                 # has compiled; any retrace from here is a perf cliff
                 jax_compile.mark_steady()
 
+            # ----- health sentinel (core/health.py): one check per iteration over
+            # the metrics this loop already produced; detections climb the
+            # warn -> backoff (lr_scale operand above) -> rollback ladder
+            action = sentinel.observe(policy_step, train_metrics=train_metrics, env_counters=env_deltas)
+            if action.rollback:
+                rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+                if rb_state is not None:
+                    params = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["agent"])
+                    )
+                    opt_state = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["optimizer"])
+                    )
+                    if "rng" in rb_state:
+                        rng = jnp.asarray(rb_state["rng"])
+                        player_rng = jax.device_put(
+                            jnp.asarray(rb_state["player_rng"]), runtime.player_device
+                        )
+                    player.params = params_sync.pull(params_sync.ravel(params), runtime.player_device)
+                    if sentinel.reseed_envs:
+                        # drop the in-flight transition (it was produced by the
+                        # poisoned policy) and restart the streams on a fresh seed
+                        pending.clear()
+                        reset_obs = envs.reset(seed=cfg.seed + iter_num)[0]
+                        next_obs = {}
+                        for k in obs_keys:
+                            _obs = reset_obs[k]
+                            if k in cnn_keys:
+                                _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                            next_obs[k] = _obs
+                            step_data[k] = _obs[np.newaxis]
+                    runtime.print(
+                        f"Health rollback at policy_step={policy_step}: restored certified "
+                        "checkpoint, training continues."
+                    )
+            sentinel.drain(aggregator)
+
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last
             ):
                 last_checkpoint = policy_step
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=_ckpt_state(),
+                    healthy=sentinel.certifiable,
+                    policy_step=policy_step,
+                )
 
             guard.completed_iteration()
             if guard.should_stop:
@@ -624,7 +685,13 @@ def main(runtime, cfg: Dict[str, Any]):
                     ckpt_path = os.path.join(
                         log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt"
                     )
-                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                    runtime.call(
+                        "on_checkpoint_coupled",
+                        ckpt_path=ckpt_path,
+                        state=_ckpt_state(),
+                        healthy=sentinel.certifiable,
+                        policy_step=policy_step,
+                    )
                 runtime.print(
                     f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
                     "checkpoint saved, exiting cleanly for resume."

@@ -41,6 +41,7 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
@@ -91,6 +92,16 @@ def main(runtime, cfg: Dict[str, Any]):
         logger.log_hyperparams(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     ft = resilience.resolve(cfg)
+    # Warn-only sentinel: the decoupled PPO split keeps the optimizer state on
+    # the trainer role, so an in-loop rollback would need a cross-role restore
+    # protocol; detection + certification still run so operators get the signal
+    # and certified checkpoints for a manual (or resume-time) rollback.
+    sentinel = health_mod.HealthSentinel(
+        cfg,
+        log_dir=log_dir if runtime.is_global_zero else None,
+        world_size=runtime.world_size,
+        supports=("warn",),
+    )
     if transport is not None:
         transport.set_scope(log_dir)  # run-scope the KV spec exchange (coordinator store outlives runs)
         transport.configure_faults(
@@ -237,7 +248,9 @@ def main(runtime, cfg: Dict[str, Any]):
         train_key = jnp.asarray(train_key).astype(jnp.uint32)
         new_params, new_opt, _flat, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
-            clip_coef, ent_coef,
+            # the decoupled sentinel is warn-only (no backoff rung), so the
+            # traced LR-scale operand is the constant healthy value
+            clip_coef, ent_coef, jnp.float32(1.0),
         )
         trainer_state["params"] = new_params
         trainer_state["opt_state"] = new_opt
@@ -466,8 +479,21 @@ def main(runtime, cfg: Dict[str, Any]):
                 resilience.enforce_nonfinite_policy(
                     ft, transport.pull_replicated(train_metrics) if transport is not None else train_metrics
                 )
-            resilience.drain_env_counters(envs, aggregator)
+            env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
+
+            if is_player:
+                # ----- health sentinel (warn-only in the decoupled split)
+                sentinel.observe(
+                    policy_step,
+                    train_metrics=(
+                        (transport.pull_replicated(train_metrics) if transport is not None else train_metrics)
+                        if "train_metrics" in dir()
+                        else None
+                    ),
+                    env_counters=env_deltas,
+                )
+                sentinel.drain(aggregator)
 
             if is_player and cfg.metric.log_level > 0:
                 if aggregator:
@@ -524,7 +550,10 @@ def main(runtime, cfg: Dict[str, Any]):
             ):
                 last_checkpoint = policy_step
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.call(
+                    "on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state(),
+                    healthy=sentinel.certifiable, policy_step=policy_step,
+                )
 
             guard.completed_iteration()
             if stop_agreed if transport is not None else guard.should_stop:
@@ -533,7 +562,10 @@ def main(runtime, cfg: Dict[str, Any]):
                     ckpt_path = os.path.join(
                         log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt"
                     )
-                    runtime.call("on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state())
+                    runtime.call(
+                    "on_checkpoint_player", ckpt_path=ckpt_path, state=_ckpt_state(),
+                    healthy=sentinel.certifiable, policy_step=policy_step,
+                )
                 runtime.print(
                     f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
                     "checkpoint saved, exiting cleanly for resume."

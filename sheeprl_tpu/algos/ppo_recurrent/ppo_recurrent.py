@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs
 from sheeprl_tpu.algos.ppo_recurrent.agent import build_agent, evaluate_actions
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
@@ -82,7 +83,7 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
 
-    def train(params, opt_state, data, key, clip_coef, ent_coef):
+    def train(params, opt_state, data, key, clip_coef, ent_coef, lr_scale):
         n_seq = next(iter(data.values())).shape[1]
         batch_size = max(n_seq // n_batches, 1)
         n_mb = n_seq // batch_size
@@ -101,15 +102,18 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
             batch["prev_hx"] = batch["prev_hx"][0]
             batch["prev_cx"] = batch["prev_cx"][0]
             (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
+            gnorm = optax.global_norm(grads)
             updates, new_opt_state = tx.update(grads, opt_state, params)
+            # health-sentinel LR backoff: traced scalar operand; 1.0 is IEEE-exact
+            updates = jax.tree_util.tree_map(lambda u: u * lr_scale, updates)
             new_params = optax.apply_updates(params, updates)
             if nonfinite_guard:
                 (params, opt_state), skipped = resilience.finite_or_skip(
-                    (loss, optax.global_norm(grads)), (new_params, new_opt_state), (params, opt_state)
+                    (loss, gnorm), (new_params, new_opt_state), (params, opt_state)
                 )
             else:
                 params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
-            return (params, opt_state), jnp.stack([pg, vl, ent, skipped])
+            return (params, opt_state), jnp.stack([pg, vl, ent, skipped, gnorm])
 
         (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
         metrics = losses.mean(axis=0)
@@ -119,6 +123,7 @@ def make_train_fn(agent, tx, cfg, runtime, obs_keys, cnn_keys, params_sync=None)
             "Loss/value_loss": metrics[1],
             "Loss/entropy_loss": metrics[2],
             "Resilience/nonfinite_skips": losses[:, 3].sum(),
+            "Grads/global_norm": metrics[4],
         }
 
     return jax_compile.guarded_jit(train, name="ppo_recurrent.train", donate_argnums=(0, 1))
@@ -173,6 +178,9 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.print(f"Log dir: {log_dir}")
 
     ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
     n_envs = cfg.env.num_envs * world_size
     envs = resilience.make_supervised_env(
         [
@@ -465,6 +473,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     train_key,
                     jnp.float32(cfg.algo.clip_coef),
                     jnp.float32(cfg.algo.ent_coef),
+                    jnp.float32(sentinel.lr_scale),
                 )
                 player.params = params_sync.pull(flat_params, runtime.player_device)
                 if not timer.disabled:  # sync only when the train phase is being timed
@@ -516,7 +525,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
 
             resilience.enforce_nonfinite_policy(ft, train_metrics)
-            resilience.drain_env_counters(envs, aggregator)
+            env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
             if iter_num == start_iter:
                 # first iteration compiled every reachable signature for the
@@ -524,19 +533,68 @@ def main(runtime, cfg: Dict[str, Any]):
                 # compiles per signature, drift shows up as Compile/retraces
                 jax_compile.mark_steady()
 
+            # ----- health sentinel: warn -> backoff (lr_scale) -> rollback
+            action = sentinel.observe(policy_step, train_metrics=train_metrics, env_counters=env_deltas)
+            if action.rollback:
+                rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+                if rb_state is not None:
+                    params = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["agent"])
+                    )
+                    opt_state = runtime.place_params(
+                        jax.tree_util.tree_map(jnp.asarray, rb_state["optimizer"])
+                    )
+                    if "rng" in rb_state:
+                        rng = jnp.asarray(rb_state["rng"])
+                        player_rng = jax.device_put(
+                            jnp.asarray(rb_state["player_rng"]), runtime.player_device
+                        )
+                    player.params = params_sync.pull(params_sync.ravel(params), runtime.player_device)
+                    if sentinel.reseed_envs:
+                        # fresh episode streams AND a clean recurrent state: the
+                        # in-flight hidden state was produced by the poisoned policy
+                        pending.clear()
+                        reset_obs = envs.reset(seed=cfg.seed + iter_num)[0]
+                        next_obs = {}
+                        for k in obs_keys:
+                            _obs = reset_obs[k]
+                            if k in cnn_keys:
+                                _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                            next_obs[k] = _obs
+                            step_data[k] = _obs[np.newaxis]
+                        prev_states = player.initial_states(h)
+                        prev_actions = np.zeros((n_envs, sum(actions_dim)), dtype=np.float32)
+                    runtime.print(
+                        f"Health rollback at policy_step={policy_step}: restored certified "
+                        "checkpoint, training continues."
+                    )
+            sentinel.drain(aggregator)
+
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
                 iter_num == total_iters and cfg.checkpoint.save_last
             ):
                 last_checkpoint = policy_step
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                runtime.call(
+                    "on_checkpoint_coupled",
+                    ckpt_path=ckpt_path,
+                    state=_ckpt_state(),
+                    healthy=sentinel.certifiable,
+                    policy_step=policy_step,
+                )
 
             guard.completed_iteration()
             if guard.should_stop:
                 if last_checkpoint != policy_step:  # periodic save above already covered this step
                     last_checkpoint = policy_step
                     ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                    runtime.call("on_checkpoint_coupled", ckpt_path=ckpt_path, state=_ckpt_state())
+                    runtime.call(
+                        "on_checkpoint_coupled",
+                        ckpt_path=ckpt_path,
+                        state=_ckpt_state(),
+                        healthy=sentinel.certifiable,
+                        policy_step=policy_step,
+                    )
                 runtime.print(
                     f"Preemption ({guard.describe()}) at iteration {iter_num}: emergency "
                     "checkpoint saved, exiting cleanly for resume."

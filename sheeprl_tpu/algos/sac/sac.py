@@ -33,10 +33,12 @@ from sheeprl_tpu.algos.sac.agent import (
 from sheeprl_tpu.algos.sac.loss import critic_loss, entropy_loss, policy_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import health as health_mod
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, pipeline_enabled
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.prefetch import DevicePrefetcher
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
@@ -171,13 +173,18 @@ def main(runtime, cfg: Dict[str, Any]):
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
 
+    ft = resilience.resolve(cfg)
+    sentinel = health_mod.HealthSentinel(
+        cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
+    )
     n_envs = cfg.env.num_envs * world_size
-    envs = vectorized_env(
+    envs = resilience.make_supervised_env(
         [
             make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
             for i in range(n_envs)
         ],
         sync=cfg.env.sync_env,
+        ft=ft,
     )
     action_space = envs.single_action_space
     observation_space = envs.single_observation_space
@@ -374,6 +381,11 @@ def main(runtime, cfg: Dict[str, Any]):
             train_every <= 1 or iter_num % train_every == 0 or iter_num == total_iters
         ):
             per_rank_gradient_steps = ratio((policy_step - prefill_steps * n_envs) / world_size)
+            if per_rank_gradient_steps > 0 and sentinel.ratio_scale < 1.0:
+                # health-sentinel backoff for replay-ratio loops: shrink this
+                # iteration's gradient-step grant (the dropped steps are spent,
+                # not deferred — a deliberate cooling-off, not bookkeeping)
+                per_rank_gradient_steps = max(1, int(per_rank_gradient_steps * sentinel.ratio_scale))
             if per_rank_gradient_steps > 0:
                 g = per_rank_gradient_steps
                 # prefetched during the previous train step (sample + async device_put
@@ -444,10 +456,40 @@ def main(runtime, cfg: Dict[str, Any]):
                 last_log = policy_step
                 last_train = train_step
 
+        env_deltas = resilience.drain_env_counters(envs, aggregator)
         jax_compile.drain_compile_counters(aggregator)
         if cumulative_grad_steps > 0 and not jax_compile.is_steady():
             # everything reachable has compiled once: later traces are drift
             jax_compile.mark_steady()
+
+        # ----- health sentinel: warn -> backoff (ratio grant above) -> rollback
+        action = sentinel.observe(
+            policy_step,
+            train_metrics=train_metrics if iter_num >= learning_starts and "train_metrics" in dir() else None,
+            env_counters=env_deltas,
+        )
+        if action.rollback:
+            rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+            if rb_state is not None:
+                params = runtime.place_params(
+                    jax.tree_util.tree_map(jnp.asarray, rb_state["agent"])
+                )
+                opt_states = runtime.place_params(
+                    jax.tree_util.tree_map(jnp.asarray, rb_state["opt_states"])
+                )
+                update_counter = jnp.int32(rb_state["update_counter"])
+                ratio.load_state_dict(rb_state["ratio"])
+                # the replay buffer keeps its rows (off-policy data stays valid);
+                # only the learner state rewinds to the certified snapshot
+                player.params = params_sync.pull(
+                    params_sync.ravel(params.actor), runtime.player_device
+                )
+                last_flat_actor = None
+                runtime.print(
+                    f"Health rollback at policy_step={policy_step}: restored certified "
+                    "checkpoint, training continues."
+                )
+        sentinel.drain(aggregator)
 
         if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (
             iter_num == total_iters and cfg.checkpoint.save_last
@@ -470,6 +512,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
                 io_lock=prefetcher.guard(),
+                healthy=sentinel.certifiable,
+                policy_step=policy_step,
             )
 
     prefetcher.close()

@@ -35,13 +35,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import health as health_mod
+from sheeprl_tpu.core import resilience
 from sheeprl_tpu.algos.sac.agent import action_scale_bias, build_agent
 from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
-from sheeprl_tpu.utils.env import finished_episodes, make_env, vectorized_env
+from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
 from sheeprl_tpu.utils.profiler import TraceProfiler
@@ -95,13 +97,24 @@ def main(runtime, cfg: Dict[str, Any]):
     )
 
     n_envs = cfg.env.num_envs
+    ft = resilience.resolve(cfg)
+    # Health sentinel: the full ladder needs the trainer state in-process, so
+    # cross-host worlds run warn-only (backoff would desync the lockstep
+    # gradient-step arithmetic; rollback would need a coordinated restore).
+    sentinel = health_mod.HealthSentinel(
+        cfg,
+        log_dir=log_dir if runtime.is_global_zero else None,
+        world_size=runtime.world_size,
+        supports=("warn", "backoff", "rollback") if transport is None else ("warn",),
+    )
     if is_player:
-        envs = vectorized_env(
+        envs = resilience.make_supervised_env(
             [
                 make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
                 for i in range(n_envs)
             ],
             sync=cfg.env.sync_env,
+            ft=ft,
         )
         action_space = envs.single_action_space
         observation_space = envs.single_observation_space
@@ -294,6 +307,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 # stays in lockstep (the reference broadcasts it instead,
                 # sac_decoupled.py:237).
                 per_rank_gradient_steps = ratio(ratio_steps / trainer_world)
+                if transport is None and per_rank_gradient_steps > 0 and sentinel.ratio_scale < 1.0:
+                    # health-sentinel backoff: shrink this round's gradient
+                    # grant (single-controller only — every process must
+                    # compute the same count in a cross-host world)
+                    per_rank_gradient_steps = max(1, int(per_rank_gradient_steps * sentinel.ratio_scale))
                 if per_rank_gradient_steps > 0:
                     if is_player:
                         # The player samples and ships the batch (reference :243-257)
@@ -329,12 +347,42 @@ def main(runtime, cfg: Dict[str, Any]):
                             player.params = player_params
                         cumulative_grad_steps += per_rank_gradient_steps
                         train_step += trainer_world * per_rank_gradient_steps
-                    if is_player and aggregator:
-                        aggregator.update_from_device(
+                    if is_player:
+                        host_metrics = (
                             transport.pull_replicated(train_metrics) if transport is not None else train_metrics
                         )
-                    if is_player:
+                        if aggregator:
+                            aggregator.update_from_device(host_metrics)
                         jax_compile.drain_compile_counters(aggregator)
+
+            if is_player:
+                # ----- health sentinel: warn -> backoff (grant above) -> rollback
+                env_deltas = resilience.drain_env_counters(envs, aggregator)
+                action = sentinel.observe(
+                    policy_step,
+                    train_metrics=host_metrics if "host_metrics" in dir() else None,
+                    env_counters=env_deltas,
+                )
+                if action.rollback:
+                    rb_state = sentinel.take_rollback_state(os.path.join(log_dir, "checkpoint"))
+                    if rb_state is not None:
+                        restored = jax.tree_util.tree_map(jnp.asarray, rb_state["agent"])
+                        trainer_state["params"] = trainer_rt.replicate(restored)
+                        trainer_state["opt_states"] = trainer_rt.replicate(
+                            jax.tree_util.tree_map(jnp.asarray, rb_state["opt_states"])
+                        )
+                        trainer_state["update_counter"] = trainer_rt.replicate(
+                            np.int32(rb_state["update_counter"])
+                        )
+                        ratio.load_state_dict(rb_state["ratio"])
+                        # replay rows stay valid off-policy data; only the
+                        # learner rewinds to the certified snapshot
+                        player.params = player_rt.replicate(restored.actor)
+                        runtime.print(
+                            f"Health rollback at policy_step={policy_step}: restored certified "
+                            "checkpoint, training continues."
+                        )
+                sentinel.drain(aggregator)
 
             if is_player and cfg.metric.log_level > 0 and (
                 policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters
@@ -390,6 +438,8 @@ def main(runtime, cfg: Dict[str, Any]):
                     ckpt_path=ckpt_path,
                     state=ckpt_state,
                     replay_buffer=rb if cfg.buffer.checkpoint else None,
+                    healthy=sentinel.certifiable,
+                    policy_step=policy_step,
                 )
 
     profiler.close()

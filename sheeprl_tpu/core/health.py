@@ -1,0 +1,718 @@
+"""Self-healing training runtime: health sentinel, response ladder, rollback.
+
+PR 3 (``core/resilience.py``) made runs survive *hard* faults — preemption,
+crashed env workers, non-finite updates. Long accelerator runs more often die
+of *silent* degradation: loss divergence, entropy collapse, throughput stalls,
+skip-update or retrace storms that burn hours of chip time before a human
+notices. This module closes that loop:
+
+- :class:`HealthSentinel` ingests the metrics every training loop already
+  produces (``Loss/*`` and ``Grads/*`` scalars from the jitted train step,
+  ``Resilience/nonfinite_skips``, ``Compile/retraces``, the loop's own
+  policy-step counter for SPS) and runs three cheap detectors per iteration:
+
+  * **divergence** — per-key EWMA mean/variance with z-score thresholding and
+    hysteresis (:class:`sheeprl_tpu.utils.metric.EWMAStat`); a non-finite
+    sample is an immediate anomaly; optional entropy-collapse floor;
+  * **stall** — EWMA baseline of steps/sec with a floor ratio, plus an
+    optional per-iteration wall-clock deadline;
+  * **thrash** — streaks of skipped (non-finite) updates or post-steady-state
+    retraces.
+
+- Detections climb a graded, config-driven **response ladder**
+  (``health.response.ladder``, default ``warn -> backoff -> rollback``):
+
+  * ``warn`` logs an event (and a flight-recorder flush);
+  * ``backoff`` shrinks a host-side scale the loops apply IN-GRAPH — the
+    on-policy train steps take it as a traced ``lr_scale`` operand multiplying
+    the optimizer update (no retrace), the replay-ratio loops multiply their
+    per-iteration gradient-step grant by it;
+  * ``rollback`` restores the newest **certified** checkpoint (see below) with
+    a bounded per-run budget.
+
+- **Certification**: the periodic checkpointer passes
+  ``healthy=sentinel.certifiable`` and only checkpoints written while the
+  sentinel reports healthy get a ``*.certified.json`` sidecar (CRC + size,
+  ``utils/checkpoint.py:certify``). ``load_state``'s corruption fallback and
+  the sentinel's rollback only trust certified files.
+
+- **Flight recorder**: a small ring buffer of recent per-check health rows,
+  flushed to ``<log_dir>/health/flight_*.jsonl`` on any detection or rollback
+  for post-mortem; every ladder action also appends one line to
+  ``<log_dir>/health/events.jsonl`` (the rollback smoke and ``bench.py
+  --target health`` parse it).
+
+Cost: one stacked device->host pull of the watched scalars per
+``health.check_every`` iterations — the same transfer shape the ``halt``
+non-finite policy already pays. With ``health.enabled=false`` (the default)
+``observe`` returns immediately, no sidecars are written, and every loop is
+bit-identical to the pre-health build (the on-policy ``lr_scale`` operand is a
+constant 1.0, and ``x * 1.0`` is exact in IEEE arithmetic).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.utils.metric import EWMAStat
+
+_DEFAULTS: Dict[str, Any] = {
+    "enabled": False,
+    # Detector cadence in training iterations (1 = every iteration). Raising it
+    # divides the per-iteration pull cost and multiplies detection latency.
+    "check_every": 1,
+    "divergence": {
+        # Keys of the train-step metric dict to watch; null auto-selects every
+        # "Loss/*" and "Grads/*" key present in the first observed dict.
+        "keys": None,
+        "window": 64,
+        "warmup": 8,
+        "z_threshold": 8.0,
+        # Hysteresis: an anomaly episode opened at |z| > z_threshold only closes
+        # once |z| falls below z_clear (prevents flapping around the threshold).
+        "z_clear": 4.0,
+        "streak": 3,
+        "entropy_key": "Loss/entropy_loss",
+        # Entropy collapse floor on the EWMA of entropy_key (null = off). The
+        # PPO-family entropy_loss is NEGATIVE entropy, so collapse means the
+        # EWMA RISING above -floor; both signs are handled.
+        "entropy_floor": None,
+    },
+    "stall": {
+        "enabled": True,
+        "window": 64,
+        "warmup": 8,
+        # SPS below floor_ratio * EWMA baseline counts as a stalled check.
+        "floor_ratio": 0.2,
+        "streak": 3,
+        # Optional hard per-iteration wall-clock deadline in seconds (null =
+        # off). Trips the detector on the NEXT observe; a step that never
+        # returns is covered by the env-supervision timeouts, not here.
+        "deadline_s": None,
+    },
+    "thrash": {
+        "skip_key": "Resilience/nonfinite_skips",
+        "skip_streak": 4,
+        "retrace_streak": 8,
+    },
+    "response": {
+        "ladder": ["warn", "backoff", "rollback"],
+        "backoff_scale": 0.5,
+        "min_scale": 0.05,
+        # Consecutive healthy checks before the ladder resets and the backoff
+        # scale recovers to 1.0.
+        "recover_iters": 20,
+        # Max rollbacks per run; past the budget the ladder caps at backoff.
+        "rollback_budget": 2,
+        # Checks skipped right after a rollback while the restored state and
+        # the detector windows re-warm.
+        "grace_iters": 5,
+        # Reseed + reset the vector env on rollback where the loop supports it
+        # (on-policy loops); turning it off keeps the env streams untouched.
+        "reseed_envs": True,
+    },
+    "recorder": {"capacity": 256},
+}
+
+
+class _View:
+    """Attribute view over a plain dict (mirrors ``resilience._View``)."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self._d = d
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            v = self._d[name]
+        except KeyError:
+            raise AttributeError(name) from None
+        return _View(v) if isinstance(v, dict) else v
+
+
+def _merge(defaults: Any, got: Any) -> Any:
+    if not isinstance(defaults, dict):
+        return defaults if got is None else got
+    out = {}
+    for k, dv in defaults.items():
+        gv = None
+        if got is not None:
+            gv = got.get(k) if hasattr(got, "get") else getattr(got, k, None)
+        out[k] = _merge(dv, gv)
+    return out
+
+
+def resolve(cfg: Any) -> _View:
+    """Defaults-filled view of ``cfg.health``.
+
+    Tolerates a missing group entirely (sidecar configs recorded before this
+    subsystem existed resume with health disabled).
+    """
+    try:
+        group = cfg.get("health") if hasattr(cfg, "get") else None
+    except Exception:
+        group = None
+    return _View(_merge(_DEFAULTS, group))
+
+
+class HealthAction:
+    """What the sentinel asks the loop to do after a check."""
+
+    __slots__ = ("kind", "reason")
+
+    def __init__(self, kind: str = "none", reason: str = ""):
+        self.kind = kind
+        self.reason = reason
+
+    @property
+    def rollback(self) -> bool:
+        return self.kind == "rollback"
+
+    @property
+    def backoff(self) -> bool:
+        return self.kind == "backoff"
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"HealthAction({self.kind!r}, {self.reason!r})"
+
+
+NO_ACTION = HealthAction()
+
+
+# --------------------------------------------------------------------------- #
+# Detectors (host-side math over once-per-check pulled scalars)
+# --------------------------------------------------------------------------- #
+
+
+class DivergenceDetector:
+    """Per-key EWMA/z-score anomaly detection with streaks and hysteresis.
+
+    Anomalous samples are EXCLUDED from the running moments (a diverging loss
+    must not drag the baseline up to meet it), except during warmup where every
+    sample feeds the moments and nothing fires.
+    """
+
+    def __init__(
+        self,
+        window: int = 64,
+        warmup: int = 8,
+        z_threshold: float = 8.0,
+        z_clear: float = 4.0,
+        streak: int = 3,
+        entropy_key: Optional[str] = None,
+        entropy_floor: Optional[float] = None,
+    ):
+        self.window = int(window)
+        self.warmup = max(int(warmup), 2)
+        self.z_threshold = float(z_threshold)
+        self.z_clear = min(float(z_clear), float(z_threshold))
+        self.streak = max(int(streak), 1)
+        self.entropy_key = entropy_key
+        self.entropy_floor = entropy_floor
+        self._stats: Dict[str, EWMAStat] = {}
+        self._in_anomaly: Dict[str, bool] = {}
+        self._streaks: Dict[str, int] = {}
+        self.last_z: Dict[str, float] = {}
+
+    def reset(self) -> None:
+        self._stats.clear()
+        self._in_anomaly.clear()
+        self._streaks.clear()
+        self.last_z.clear()
+
+    def _update_key(self, key: str, value: float) -> Tuple[bool, float]:
+        stat = self._stats.get(key)
+        if stat is None:
+            stat = self._stats[key] = EWMAStat(window=self.window)
+            self._in_anomaly[key] = False
+            self._streaks[key] = 0
+        if not math.isfinite(value):
+            # a NaN/inf loss is divergence by definition, no statistics needed
+            self._streaks[key] += 1
+            self._in_anomaly[key] = True
+            return True, math.inf
+        z = stat.zscore(value)
+        warm = stat.count < self.warmup
+        if warm:
+            stat.update(value)
+            self._streaks[key] = 0
+            self._in_anomaly[key] = False
+            return False, z
+        threshold = self.z_clear if self._in_anomaly[key] else self.z_threshold
+        anomalous = abs(z) > threshold
+        self._in_anomaly[key] = anomalous
+        if anomalous:
+            self._streaks[key] += 1
+        else:
+            self._streaks[key] = 0
+            stat.update(value)
+        return anomalous, z
+
+    def check(self, values: Mapping[str, float]) -> Tuple[bool, str]:
+        """Feed one check's scalars; returns (fired, reason)."""
+        fired_keys: List[str] = []
+        for key, value in values.items():
+            anomalous, z = self._update_key(key, float(value))
+            self.last_z[key] = z
+            if anomalous and self._streaks[key] >= self.streak:
+                fired_keys.append(f"{key} z={z:.1f} x{self._streaks[key]}")
+        if self.entropy_key and self.entropy_floor is not None and self.entropy_key in values:
+            stat = self._stats.get(self.entropy_key)
+            ent = stat.mean if stat is not None and stat.count >= self.warmup else None
+            # entropy_loss is -H for the PPO family: collapse is |EWMA| < floor
+            if ent is not None and abs(ent) < float(self.entropy_floor):
+                fired_keys.append(f"entropy collapse |{self.entropy_key}|={abs(ent):.4f}")
+        if fired_keys:
+            return True, "divergence: " + "; ".join(fired_keys)
+        return False, ""
+
+
+class StallDetector:
+    """SPS-collapse and per-iteration-deadline detection.
+
+    The sentinel feeds (policy_step, wall-time) pairs; SPS baselines are EWMA
+    so a run that legitimately slows (bigger model phase) re-baselines instead
+    of alarming forever.
+    """
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        window: int = 64,
+        warmup: int = 8,
+        floor_ratio: float = 0.2,
+        streak: int = 3,
+        deadline_s: Optional[float] = None,
+    ):
+        self.enabled = bool(enabled)
+        self.warmup = max(int(warmup), 2)
+        self.floor_ratio = float(floor_ratio)
+        self.streak = max(int(streak), 1)
+        self.deadline_s = float(deadline_s) if deadline_s else None
+        self._stat = EWMAStat(window=window)
+        self._streak = 0
+        self.last_sps = math.nan
+
+    def reset(self) -> None:
+        self._stat = EWMAStat(window=self._stat.window)
+        self._streak = 0
+
+    def check(self, steps: float, elapsed_s: float) -> Tuple[bool, str]:
+        if not self.enabled or elapsed_s <= 0:
+            return False, ""
+        if self.deadline_s is not None and elapsed_s > self.deadline_s:
+            return True, f"stall: iteration took {elapsed_s:.1f}s > deadline {self.deadline_s:.1f}s"
+        sps = steps / elapsed_s
+        self.last_sps = sps
+        if self._stat.count < self.warmup:
+            self._stat.update(sps)
+            self._streak = 0
+            return False, ""
+        if sps < self.floor_ratio * self._stat.mean:
+            self._streak += 1
+            if self._streak >= self.streak:
+                return True, (
+                    f"stall: sps {sps:.1f} < {self.floor_ratio:.2f} x baseline {self._stat.mean:.1f} "
+                    f"for {self._streak} checks"
+                )
+            return False, ""
+        self._streak = 0
+        self._stat.update(sps)
+        return False, ""
+
+
+class ThrashDetector:
+    """Streaks of skipped (non-finite) updates or post-steady retraces."""
+
+    def __init__(self, skip_streak: int = 4, retrace_streak: int = 8):
+        self.skip_streak = max(int(skip_streak), 1)
+        self.retrace_streak = max(int(retrace_streak), 1)
+        self._skips = 0
+        self._retraces = 0
+
+    def reset(self) -> None:
+        self._skips = 0
+        self._retraces = 0
+
+    def check(self, skipped: float, retraces: float) -> Tuple[bool, str]:
+        self._skips = self._skips + 1 if skipped > 0 else 0
+        self._retraces = self._retraces + 1 if retraces > 0 else 0
+        if self._skips >= self.skip_streak:
+            return True, f"thrash: non-finite update skipped {self._skips} checks in a row"
+        if self._retraces >= self.retrace_streak:
+            return True, f"thrash: retraces observed {self._retraces} checks in a row"
+        return False, ""
+
+
+# --------------------------------------------------------------------------- #
+# Flight recorder
+# --------------------------------------------------------------------------- #
+
+
+class FlightRecorder:
+    """Ring buffer of recent per-check health rows, flushed on detections.
+
+    Rows are plain dicts of JSON-serializable scalars. ``flush`` writes the
+    whole ring (oldest first) to ``<dir>/flight_<step>_<tag>.jsonl`` and keeps
+    recording, so back-to-back detections each get a snapshot.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(int(capacity), 1)
+        self._ring: deque = deque(maxlen=self.capacity)
+
+    def record(self, row: Dict[str, Any]) -> None:
+        self._ring.append(row)
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def flush(self, out_dir: Optional[str], step: int, tag: str) -> Optional[str]:
+        if out_dir is None or not self._ring:
+            return None
+        os.makedirs(out_dir, exist_ok=True)
+        tag = "".join(c if (c.isalnum() or c in "-_") else "_" for c in tag)[:48]
+        path = os.path.join(out_dir, f"flight_{int(step)}_{tag}.jsonl")
+        try:
+            with open(path, "w") as f:
+                for row in self._ring:
+                    f.write(json.dumps(row) + "\n")
+        except OSError:
+            return None
+        return path
+
+
+# --------------------------------------------------------------------------- #
+# Sentinel
+# --------------------------------------------------------------------------- #
+
+
+class HealthSentinel:
+    """Per-loop health monitor owning the detectors and the response ladder.
+
+    Construction never fails the run: with ``health.enabled=false`` every
+    method is a cheap no-op and no files are touched. ``supports`` names the
+    ladder rungs the hosting loop can honor (a decoupled player cannot reach
+    into its trainer process to back off or roll back); unsupported rungs fall
+    back to the highest supported one below them.
+    """
+
+    def __init__(
+        self,
+        cfg: Any,
+        log_dir: Optional[str] = None,
+        world_size: int = 1,
+        supports: Sequence[str] = ("warn", "backoff", "rollback"),
+    ):
+        hc = resolve(cfg)
+        self.cfg = hc
+        self.enabled = bool(hc.enabled)
+        self.check_every = max(int(hc.check_every), 1)
+        self.world_size = max(int(world_size), 1)
+        self._supports = tuple(supports)
+        self._ladder = [str(r) for r in hc.response.ladder]
+        self._log_dir = os.path.join(log_dir, "health") if log_dir else None
+        self._keys: Optional[Tuple[str, ...]] = (
+            tuple(hc.divergence.keys) if hc.divergence.keys else None
+        )
+        self.divergence = DivergenceDetector(
+            window=hc.divergence.window,
+            warmup=hc.divergence.warmup,
+            z_threshold=hc.divergence.z_threshold,
+            z_clear=hc.divergence.z_clear,
+            streak=hc.divergence.streak,
+            entropy_key=hc.divergence.entropy_key,
+            entropy_floor=hc.divergence.entropy_floor,
+        )
+        self.stall = StallDetector(
+            enabled=hc.stall.enabled,
+            window=hc.stall.window,
+            warmup=hc.stall.warmup,
+            floor_ratio=hc.stall.floor_ratio,
+            streak=hc.stall.streak,
+            deadline_s=hc.stall.deadline_s,
+        )
+        self.thrash = ThrashDetector(
+            skip_streak=hc.thrash.skip_streak, retrace_streak=hc.thrash.retrace_streak
+        )
+        self.recorder = FlightRecorder(capacity=hc.recorder.capacity)
+        self.lr_scale = 1.0
+        self._level = 0
+        self._healthy_streak = 0
+        self._grace = 0
+        self._checks = 0
+        self._observes = 0
+        self._last_step: Optional[int] = None
+        self._last_time: Optional[float] = None
+        self._anomaly_opened: Optional[Tuple[int, float]] = None  # (step, wall time)
+        self._rollbacks_used = 0
+        self._last_retraces = 0
+        self.counters: Dict[str, float] = {
+            "Health/detections": 0,
+            "Health/warns": 0,
+            "Health/backoffs": 0,
+            "Health/rollbacks": 0,
+        }
+        self._drained: Dict[str, float] = dict.fromkeys(self.counters, 0)
+        self.last_detection_latency_s: Optional[float] = None
+        self.last_detection_latency_steps: Optional[int] = None
+
+    # -- certification -------------------------------------------------------
+
+    @property
+    def certifiable(self) -> bool:
+        """True when a checkpoint written now may be marked ``last_good``:
+        health monitoring is on, no ladder level is active, no anomaly episode
+        is open, and we are not inside the post-rollback grace window."""
+        return (
+            self.enabled
+            and self._level == 0
+            and self._grace == 0
+            and self._anomaly_opened is None
+        )
+
+    # -- events --------------------------------------------------------------
+
+    def _event(self, kind: str, step: int, **fields: Any) -> None:
+        if self._log_dir is None:
+            return
+        try:
+            os.makedirs(self._log_dir, exist_ok=True)
+            with open(os.path.join(self._log_dir, "events.jsonl"), "a") as f:
+                f.write(
+                    json.dumps({"event": kind, "step": int(step), "time": time.time(), **fields})
+                    + "\n"
+                )
+        except OSError:
+            pass
+
+    # -- observation ---------------------------------------------------------
+
+    def _pull(self, train_metrics: Optional[Mapping[str, Any]]) -> Dict[str, float]:
+        """ONE stacked device->host pull of the watched scalars."""
+        if not train_metrics:
+            return {}
+        if self._keys is None:
+            self._keys = tuple(
+                k for k in train_metrics if k.startswith(("Loss/", "Grads/"))
+            )
+        skip_key = self.cfg.thrash.skip_key
+        keys = [k for k in self._keys if k in train_metrics]
+        if skip_key in train_metrics and skip_key not in keys:
+            keys.append(skip_key)
+        if not keys:
+            return {}
+        vals = [train_metrics[k] for k in keys]
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            if any(isinstance(v, jax.Array) for v in vals):
+                host = np.asarray(
+                    jnp.stack([jnp.asarray(v, dtype=jnp.float32).mean() for v in vals])
+                )
+            else:
+                host = np.asarray([float(np.asarray(v).mean()) for v in vals])
+        except Exception:
+            host = np.asarray([float(np.asarray(v).mean()) for v in vals])
+        return {k: float(v) for k, v in zip(keys, host.tolist())}
+
+    def observe(
+        self,
+        policy_step: int,
+        train_metrics: Optional[Mapping[str, Any]] = None,
+        env_counters: Optional[Mapping[str, float]] = None,
+    ) -> HealthAction:
+        """Feed one training iteration's signals; returns the ladder action.
+
+        Call once per iteration AFTER the train phase. ``train_metrics`` may
+        hold device arrays (pulled once, stacked) or host floats;
+        ``env_counters`` is the delta dict ``resilience.drain_env_counters``
+        returns (worker restarts ride into the flight recorder).
+        """
+        if not self.enabled:
+            return NO_ACTION
+        now = time.monotonic()
+        self._observes += 1
+        steps = float(policy_step - self._last_step) if self._last_step is not None else 0.0
+        elapsed = (now - self._last_time) if self._last_time is not None else 0.0
+        self._last_step = int(policy_step)
+        self._last_time = now
+        if self._observes % self.check_every != 0:
+            return NO_ACTION
+        self._checks += 1
+
+        values = self._pull(train_metrics)
+        skipped = values.get(self.cfg.thrash.skip_key, 0.0)
+        try:
+            from sheeprl_tpu.core import compile as jax_compile
+
+            total_retraces = int(jax_compile.process_stats().get("retraces", 0))
+        except Exception:
+            total_retraces = self._last_retraces
+        retraces = max(total_retraces - self._last_retraces, 0)
+        self._last_retraces = total_retraces
+
+        row: Dict[str, Any] = {
+            "step": int(policy_step),
+            "time": time.time(),
+            "sps": round(steps / elapsed, 2) if elapsed > 0 else None,
+            "lr_scale": self.lr_scale,
+            "level": self._level,
+            "skipped": skipped,
+            "retraces": retraces,
+            **{k: v for k, v in values.items()},
+        }
+        if env_counters:
+            row.update({k: float(v) for k, v in env_counters.items() if v})
+        self.recorder.record(row)
+
+        if self._grace > 0:
+            self._grace -= 1
+            return NO_ACTION
+
+        div_keys = {k: v for k, v in values.items() if k != self.cfg.thrash.skip_key}
+        fired, reasons = False, []
+        f, r = self.divergence.check(div_keys)
+        if f:
+            fired, reasons = True, reasons + [r]
+        f, r = self.stall.check(steps, elapsed)
+        if f:
+            fired, reasons = True, reasons + [r]
+        f, r = self.thrash.check(skipped, retraces)
+        if f:
+            fired, reasons = True, reasons + [r]
+
+        if not fired:
+            if self._anomaly_opened is not None and not any(
+                self.divergence._in_anomaly.values()
+            ):
+                self._anomaly_opened = None
+            self._healthy_streak += 1
+            if self._level > 0 and self._healthy_streak >= int(self.cfg.response.recover_iters):
+                self._level = 0
+                self.lr_scale = 1.0
+                self._event("recovered", policy_step)
+            return NO_ACTION
+
+        # ---- detection: escalate the ladder ---------------------------------
+        self._healthy_streak = 0
+        if self._anomaly_opened is None:
+            self._anomaly_opened = (int(policy_step), now)
+        self.counters["Health/detections"] += 1
+        self.last_detection_latency_s = now - self._anomaly_opened[1]
+        self.last_detection_latency_steps = int(policy_step) - self._anomaly_opened[0]
+        self._level = min(self._level + 1, len(self._ladder))
+        reason = "; ".join(reasons)
+
+        rung = self._ladder[self._level - 1]
+        if rung == "rollback" and (
+            "rollback" not in self._supports
+            or self._rollbacks_used >= int(self.cfg.response.rollback_budget)
+        ):
+            rung = "backoff"
+        if rung == "backoff" and "backoff" not in self._supports:
+            rung = "warn"
+
+        flush_path = self.recorder.flush(self._log_dir, policy_step, rung)
+        if rung == "warn":
+            self.counters["Health/warns"] += 1
+            self._event("warn", policy_step, reason=reason, flight=flush_path)
+            return HealthAction("warn", reason)
+        if rung == "backoff":
+            self.counters["Health/backoffs"] += 1
+            self.lr_scale = max(
+                self.lr_scale * float(self.cfg.response.backoff_scale),
+                float(self.cfg.response.min_scale),
+            )
+            self._event(
+                "backoff", policy_step, reason=reason, lr_scale=self.lr_scale, flight=flush_path
+            )
+            return HealthAction("backoff", reason)
+        self._event("rollback_requested", policy_step, reason=reason, flight=flush_path)
+        return HealthAction("rollback", reason)
+
+    @property
+    def ratio_scale(self) -> float:
+        """The backoff scale as seen by replay-ratio loops: off-policy/dreamer
+        loops multiply their per-iteration gradient-step grant by this instead
+        of scaling the LR in-graph (same knob, host-side application)."""
+        return self.lr_scale
+
+    # -- rollback ------------------------------------------------------------
+
+    @property
+    def reseed_envs(self) -> bool:
+        return bool(self.cfg.response.reseed_envs)
+
+    def take_rollback_state(self, ckpt_dir: str) -> Optional[Dict[str, Any]]:
+        """Load the newest certified checkpoint for an in-place state restore.
+
+        Returns the checkpoint state dict, or None when the rollback budget is
+        exhausted or no certified checkpoint exists (the caller then stays at
+        the backoff rung). On success the detectors reset, the backoff scale
+        tightens once, and a grace window suppresses detections while the
+        restored state re-warms the windows.
+        """
+        from sheeprl_tpu.utils import checkpoint as ckpt
+
+        step = self._last_step or 0
+        if self._rollbacks_used >= int(self.cfg.response.rollback_budget):
+            self._event("rollback_budget_exhausted", step, used=self._rollbacks_used)
+            return None
+        t0 = time.monotonic()
+        path = ckpt.latest_certified(ckpt_dir)
+        if path is None:
+            self._event("rollback_no_certified", step, ckpt_dir=ckpt_dir)
+            return None
+        try:
+            state = ckpt.load_state(path, fallback_to_older=False)
+        except Exception as e:
+            self._event("rollback_load_failed", step, path=path, error=f"{type(e).__name__}: {e}")
+            return None
+        self._rollbacks_used += 1
+        self.counters["Health/rollbacks"] += 1
+        self.divergence.reset()
+        self.stall.reset()
+        self.thrash.reset()
+        self._anomaly_opened = None
+        self._level = 0
+        self._healthy_streak = 0
+        self._grace = int(self.cfg.response.grace_iters)
+        self.lr_scale = max(
+            self.lr_scale * float(self.cfg.response.backoff_scale),
+            float(self.cfg.response.min_scale),
+        )
+        self._event(
+            "rollback",
+            step,
+            path=os.path.abspath(path),
+            rollbacks_used=self._rollbacks_used,
+            lr_scale=self.lr_scale,
+            detection_latency_s=self.last_detection_latency_s,
+            detection_latency_steps=self.last_detection_latency_steps,
+            wall_s=round(time.monotonic() - t0, 3),
+        )
+        return state
+
+    # -- metrics -------------------------------------------------------------
+
+    def drain(self, aggregator: Any) -> None:
+        """Feed Health/* counter deltas (and gauges) to the aggregator."""
+        if not self.enabled or aggregator is None:
+            return
+        for k, v in self.counters.items():
+            delta = v - self._drained[k]
+            self._drained[k] = v
+            if delta and k in aggregator:
+                aggregator.update(k, delta)
+        if "Health/lr_scale" in aggregator:
+            aggregator.update("Health/lr_scale", self.lr_scale)
+        if self.last_detection_latency_s is not None and "Health/detection_latency_s" in aggregator:
+            aggregator.update("Health/detection_latency_s", self.last_detection_latency_s)

@@ -445,15 +445,20 @@ def make_supervised_env(
     )
 
 
-def drain_env_counters(envs: Any, aggregator: Any) -> None:
+def drain_env_counters(envs: Any, aggregator: Any) -> Dict[str, float]:
     """Feed a SupervisedVectorEnv's restart/timeout counters to the aggregator
-    (no-op for plain vector envs or a disabled aggregator)."""
+    (no-op for plain vector envs; with ``aggregator=None`` the counters are
+    still drained). Returns the drained delta dict so callers can forward it —
+    the health sentinel records worker restarts in its flight recorder."""
     drain = getattr(envs, "drain_counters", None)
-    if drain is None or aggregator is None:
-        return
-    for k, v in drain().items():
-        if v and k in aggregator:
-            aggregator.update(k, v)
+    if drain is None:
+        return {}
+    deltas = drain()
+    if aggregator is not None:
+        for k, v in deltas.items():
+            if v and k in aggregator:
+                aggregator.update(k, v)
+    return deltas
 
 
 # --------------------------------------------------------------------------- #

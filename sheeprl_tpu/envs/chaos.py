@@ -49,6 +49,15 @@ class ChaosEnv(gym.Wrapper):
         hang_seconds: float = 30.0,
         nan_at: Optional[Iterable[int]] = None,
         crash_on_reset: bool = False,
+        reward_scale_from: Optional[int] = None,
+        reward_scale_until: Optional[int] = None,
+        reward_scale: float = 1e6,
+        corrupt_obs_from: Optional[int] = None,
+        corrupt_obs_until: Optional[int] = None,
+        corrupt_scale: float = 1e6,
+        freeze_from: Optional[int] = None,
+        freeze_until: Optional[int] = None,
+        freeze_seconds: float = 0.25,
     ):
         super().__init__(env)
         self._crash_at = _as_step_set(crash_at)
@@ -56,8 +65,27 @@ class ChaosEnv(gym.Wrapper):
         self._nan_at = _as_step_set(nan_at)
         self._hang_seconds = float(hang_seconds)
         self._crash_on_reset = bool(crash_on_reset)
+        # Sustained window faults for the health sentinel (divergence/stall):
+        # active on steps in [from, until) — until=null means "until the end".
+        # These model SILENT degradation (reward blow-up, sensor corruption,
+        # throughput collapse) rather than the hard faults above, and they
+        # repeat every step of the window so detectors see a sustained anomaly
+        # rather than a one-sample blip their streak logic ignores.
+        self._reward_window = (reward_scale_from, reward_scale_until)
+        self._reward_scale = float(reward_scale)
+        self._corrupt_window = (corrupt_obs_from, corrupt_obs_until)
+        self._corrupt_scale = float(corrupt_scale)
+        self._freeze_window = (freeze_from, freeze_until)
+        self._freeze_seconds = float(freeze_seconds)
         self._step_count = 0
         self._fired: Set[int] = set()
+
+    @staticmethod
+    def _in_window(window, step: int) -> bool:
+        start, stop = window
+        if start is None:
+            return False
+        return int(start) <= step and (stop is None or step < int(stop))
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
         if self._crash_on_reset and self._step_count > 0:
@@ -74,6 +102,19 @@ class ChaosEnv(gym.Wrapper):
             return np.full_like(arr, np.nan)
         return obs
 
+    @staticmethod
+    def _corrupt(obs: Any, scale: float, step: int) -> Any:
+        """Deterministic large-magnitude corruption of every float slot (models
+        a stuck/garbage sensor: finite — so the non-finite guard stays silent —
+        but statistically violent enough to wreck the value targets)."""
+        if isinstance(obs, dict):
+            return {k: ChaosEnv._corrupt(v, scale, step) for k, v in obs.items()}
+        arr = np.asarray(obs)
+        if np.issubdtype(arr.dtype, np.floating):
+            sign = 1.0 if (step % 2 == 0) else -1.0
+            return np.full_like(arr, sign * scale)
+        return obs
+
     def step(self, action):
         self._step_count += 1
         step = self._step_count
@@ -83,10 +124,17 @@ class ChaosEnv(gym.Wrapper):
         if step in self._hang_at and step not in self._fired:
             self._fired.add(step)
             time.sleep(self._hang_seconds)
+        if self._in_window(self._freeze_window, step):
+            # frozen env: every step in the window crawls, collapsing SPS
+            time.sleep(self._freeze_seconds)
         obs, reward, terminated, truncated, info = self.env.step(action)
         if step in self._nan_at:
             obs = self._poison(obs)
             reward = float("nan")
+        if self._in_window(self._reward_window, step):
+            reward = float(reward) * self._reward_scale if reward else self._reward_scale
+        if self._in_window(self._corrupt_window, step):
+            obs = self._corrupt(obs, self._corrupt_scale, step)
         return obs, reward, terminated, truncated, info
 
 

@@ -95,14 +95,18 @@ def _fsync_dir(dirname: str) -> None:
         os.close(dfd)
 
 
-def save_state(path: str, state: Dict[str, Any]) -> None:
+def save_state(path: str, state: Dict[str, Any]) -> Dict[str, Any]:
     """Layout: header pickle (magic/version/manifest), state pickle (streamed
     through a CRC), footer pickle ({"crc32": ...}).
 
     Durability: the temp file is fsync'd (and the directory before AND after
     the ``os.replace``) so a preemption/power cut at any instant leaves either
     the old checkpoint or the complete new one — never a torn file under the
-    final name."""
+    final name.
+
+    Returns ``{"crc32": ..., "size": ...}`` of the written file so callers
+    (checkpoint certification) can record integrity facts in a sidecar without
+    re-reading a potentially multi-GB checkpoint."""
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
     host_state = _to_host(state)
@@ -119,9 +123,11 @@ def save_state(path: str, state: Dict[str, Any]) -> None:
         pickle.dump({"crc32": writer.crc}, f, protocol=pickle.HIGHEST_PROTOCOL)
         f.flush()
         os.fsync(f.fileno())
+        size = f.tell()
     _fsync_dir(parent)
     os.replace(tmp, path)
     _fsync_dir(parent)
+    return {"crc32": writer.crc, "size": size}
 
 
 def _v1_header_at_head(head: bytes) -> bool:
@@ -202,6 +208,94 @@ def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]
     if isinstance(obj, dict) and obj.get("__format__") == _CKPT_MAGIC:
         return obj.get("manifest")
     return None
+
+
+# ----------------------------------------------------------------------------- #
+# Checkpoint certification ("last_good" sidecars)
+#
+# The health sentinel (core/health.py) gates which checkpoints are safe rollback
+# targets: a checkpoint written while the run was already diverging restores a
+# poisoned state. A checkpoint saved while the sentinel reports healthy gets a
+# tiny `<ckpt>.certified.json` sidecar carrying the CRC/size `save_state`
+# computed, marking it `last_good`. Rollback (`latest_certified`) and the
+# corruption fallback in `load_state` trust certified files FIRST; garbage
+# collection (`CheckpointCallback._gc`) never deletes them past their own
+# keep-last budget.
+# ----------------------------------------------------------------------------- #
+
+CERTIFIED_SUFFIX = ".certified.json"
+
+
+def certified_sidecar(path: str) -> str:
+    """Sidecar path for a checkpoint file."""
+    return path + CERTIFIED_SUFFIX
+
+
+def certify(path: str, crc32: Optional[int] = None, size: Optional[int] = None, **extra: Any) -> str:
+    """Write the ``last_good`` sidecar for ``path`` (atomic, fsync'd).
+
+    ``crc32``/``size`` come from :func:`save_state`'s return so certification
+    costs one tiny JSON write, not a re-read of the checkpoint. Extra fields
+    (e.g. ``policy_step``) ride along for operators and the rollback smoke."""
+    import json
+
+    sidecar = certified_sidecar(path)
+    payload = {"certified": True, "ckpt": os.path.basename(path), "crc32": crc32, "size": size}
+    payload.update(extra)
+    tmp = sidecar + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, sidecar)
+    _fsync_dir(os.path.dirname(os.path.abspath(sidecar)))
+    return sidecar
+
+
+def is_certified(path: str) -> bool:
+    """True when ``path`` has a parseable ``last_good`` sidecar whose recorded
+    size matches the file on disk (a size mismatch means the checkpoint was
+    overwritten after certification — the sidecar no longer vouches for it)."""
+    import json
+
+    sidecar = certified_sidecar(path)
+    try:
+        with open(sidecar) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if not (isinstance(payload, dict) and payload.get("certified") is True):
+        return False
+    size = payload.get("size")
+    if size is not None:
+        try:
+            if os.path.getsize(path) != size:
+                return False
+        except OSError:
+            return False
+    return os.path.exists(path)
+
+
+def latest_certified(ckpt_dir: str) -> Optional[str]:
+    """Newest certified ``*.ckpt`` in ``ckpt_dir`` by mtime, or None."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    best: Optional[Tuple[float, str]] = None
+    for name in names:
+        if not name.endswith(".ckpt"):
+            continue
+        cand = os.path.join(ckpt_dir, name)
+        if not is_certified(cand):
+            continue
+        try:
+            mtime = os.path.getmtime(cand)
+        except OSError:
+            continue
+        if best is None or mtime > best[0]:
+            best = (mtime, cand)
+    return best[1] if best else None
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -289,13 +383,22 @@ def _older_sibling_ckpts(path: str) -> List[str]:
 def load_state(path: str, fallback_to_older: bool = True) -> Dict[str, Any]:
     """Load a checkpoint; on corruption (CRC/footer/manifest failure) fall back
     to the newest OLDER ``*.ckpt`` in the same directory before giving up, so a
-    write torn by preemption costs one checkpoint interval instead of the run."""
+    write torn by preemption costs one checkpoint interval instead of the run.
+
+    Certified (``last_good``) siblings are tried before merely-newer
+    uncertified ones: an uncertified sibling may have been written while the
+    run was already diverging, and resuming from it re-imports the failure the
+    fallback exists to escape."""
     try:
         return _load_state_file(path)
     except CheckpointCorruptionError as primary:
         if not fallback_to_older:
             raise
-        for cand in _older_sibling_ckpts(path):
+        siblings = _older_sibling_ckpts(path)
+        ordered = [c for c in siblings if is_certified(c)] + [
+            c for c in siblings if not is_certified(c)
+        ]
+        for cand in ordered:
             try:
                 state = _load_state_file(cand)
             except (RuntimeError, OSError):
@@ -361,7 +464,8 @@ class CheckpointCallback:
         state: Dict[str, Any],
         replay_buffer=None,
         io_lock=None,
-        **_: Any,
+        healthy: Optional[bool] = None,
+        **extra: Any,
     ) -> None:
         # The truncated-flag patch, the buffer read (state_dict returns VIEWS of the
         # ring storage, so the patch must outlive the pickle), and the unpatch must
@@ -376,31 +480,72 @@ class CheckpointCallback:
                     replay_buffer.state_dict() if hasattr(replay_buffer, "state_dict") else replay_buffer
                 )
             if runtime is None or runtime.is_global_zero:
-                save_state(ckpt_path, state)
+                info = save_state(ckpt_path, state)
+                # healthy=None means the loop has no sentinel (or it's disabled):
+                # no sidecar is written and GC behaves exactly as before.
+                if healthy:
+                    certify(
+                        ckpt_path,
+                        crc32=info.get("crc32"),
+                        size=info.get("size"),
+                        policy_step=extra.get("policy_step"),
+                    )
                 self._gc(os.path.dirname(ckpt_path))
             if replay_buffer is not None:
                 self._fix_buffer_post(replay_buffer, originals)
 
     # decoupled variants keep the same surface as the reference callback
     def on_checkpoint_player(
-        self, runtime, ckpt_path: str, state: Dict[str, Any], replay_buffer=None, io_lock=None, **_: Any
+        self,
+        runtime,
+        ckpt_path: str,
+        state: Dict[str, Any],
+        replay_buffer=None,
+        io_lock=None,
+        healthy: Optional[bool] = None,
+        **extra: Any,
     ):
-        self.on_checkpoint_coupled(runtime, ckpt_path, state, replay_buffer, io_lock)
+        self.on_checkpoint_coupled(runtime, ckpt_path, state, replay_buffer, io_lock, healthy, **extra)
 
-    def on_checkpoint_trainer(self, runtime, player, ckpt_path: str, state: Dict[str, Any], **_: Any):
-        self.on_checkpoint_coupled(runtime, ckpt_path, state)
+    def on_checkpoint_trainer(
+        self, runtime, player, ckpt_path: str, state: Dict[str, Any], healthy: Optional[bool] = None, **extra: Any
+    ):
+        self.on_checkpoint_coupled(runtime, ckpt_path, state, healthy=healthy, **extra)
 
     def _gc(self, ckpt_dir: str) -> None:
+        """keep_last pruning, certification-aware.
+
+        Certified (``last_good``) checkpoints and their sidecars are exempt
+        from the main keep_last window — deleting the only certified file
+        would leave the health sentinel with no rollback target. Certified
+        files age out under their OWN keep_last budget (newest ``keep_last``
+        certified survive) so disk use stays bounded, and orphan sidecars
+        (checkpoint deleted out-of-band) are swept."""
         if not self.keep_last:
             return
         try:
-            ckpts = sorted(
-                (f for f in os.listdir(ckpt_dir) if f.endswith(".ckpt")),
-                key=lambda f: os.path.getmtime(os.path.join(ckpt_dir, f)),
-            )
+            names = os.listdir(ckpt_dir)
         except FileNotFoundError:
             return
-        for f in ckpts[: -self.keep_last]:
+
+        def mtime(name: str) -> float:
+            try:
+                return os.path.getmtime(os.path.join(ckpt_dir, name))
+            except OSError:
+                return 0.0
+
+        ckpts = sorted((f for f in names if f.endswith(".ckpt")), key=mtime)
+        certified = [f for f in ckpts if is_certified(os.path.join(ckpt_dir, f))]
+        plain = [f for f in ckpts if f not in set(certified)]
+        doomed = list(plain[: -self.keep_last])
+        for f in certified[: -self.keep_last]:
+            doomed.append(f)
+            doomed.append(f + CERTIFIED_SUFFIX)
+        # orphan sidecars: checkpoint removed out-of-band, sidecar left behind
+        for f in names:
+            if f.endswith(CERTIFIED_SUFFIX) and f[: -len(CERTIFIED_SUFFIX)] not in set(ckpts):
+                doomed.append(f)
+        for f in doomed:
             try:
                 os.remove(os.path.join(ckpt_dir, f))
             except OSError:

@@ -26,6 +26,58 @@ def _to_float(value) -> float:
     return float(arr.mean()) if arr.size > 1 else float(arr)
 
 
+class EWMAStat:
+    """Exponentially weighted running mean/variance with z-scores.
+
+    Host-side scalar statistics for the health sentinel's divergence and stall
+    detectors (``core/health.py``): O(1) memory, O(1) update, no window buffer.
+    ``window`` sets the smoothing as ``alpha = 2 / (window + 1)`` (the classic
+    EWMA span), so ``window=64`` weights roughly the last 64 samples. Variance
+    uses the exponentially weighted recurrence
+    ``var <- (1 - a) * (var + a * delta^2)`` (West 1979), which is exact for
+    the EW moments and never goes negative.
+    """
+
+    def __init__(self, window: int = 64):
+        self.window = max(int(window), 2)
+        self.alpha = 2.0 / (self.window + 1.0)
+        self.count = 0
+        self.mean = 0.0
+        self.var = 0.0
+
+    def update(self, value: float) -> None:
+        v = float(value)
+        if not math.isfinite(v):
+            return  # callers treat non-finite as anomalous; never poison moments
+        self.count += 1
+        if self.count == 1:
+            self.mean = v
+            self.var = 0.0
+            return
+        delta = v - self.mean
+        self.mean += self.alpha * delta
+        self.var = (1.0 - self.alpha) * (self.var + self.alpha * delta * delta)
+
+    @property
+    def std(self) -> float:
+        return math.sqrt(self.var) if self.var > 0.0 else 0.0
+
+    def zscore(self, value: float) -> float:
+        """Deviation of ``value`` from the EW mean in EW-std units.
+
+        0.0 until two samples exist (no spread to judge against). The std is
+        floored relative to the mean's magnitude so a perfectly constant
+        stream doesn't turn harmless float jitter into an infinite z.
+        """
+        if self.count < 2:
+            return 0.0
+        v = float(value)
+        if not math.isfinite(v):
+            return math.inf
+        floor = 1e-8 + 1e-6 * abs(self.mean)
+        return (v - self.mean) / max(self.std, floor)
+
+
 class Metric:
     """Base accumulator. Subclasses implement update/compute/reset."""
 

@@ -1,0 +1,80 @@
+"""Acceptance gate for the health sentinel: with ``health.enabled=false`` the
+loops must be bit-identical to a build without the subsystem, and with the
+sentinel enabled-but-never-tripping the trained parameters must STILL be
+bit-identical (the traced ``lr_scale`` operand is 1.0 and ``x * 1.0`` is exact
+in IEEE arithmetic; the observe path is pure host-side bookkeeping)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.cli import run
+from sheeprl_tpu.utils.checkpoint import load_state
+
+
+def _run_and_load(tmp_path, subdir, extra):
+    root = tmp_path / subdir
+    root.mkdir()
+    args = [
+        "dry_run=True",
+        "exp=ppo",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.num_envs=1",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "fabric.devices=1",
+        "metric.log_level=0",
+        "seed=7",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "buffer.memmap=False",
+        "checkpoint.save_last=True",
+        f"root_dir={root}",
+    ] + extra
+    run(overrides=args)
+    ckpts = []
+    for r, _, files in os.walk(root):
+        ckpts += [os.path.join(r, f) for f in files if f.endswith(".ckpt")]
+    assert len(ckpts) == 1, ckpts
+    return load_state(ckpts[0])
+
+
+def _assert_tree_equal(a, b, path=""):
+    if isinstance(a, dict):
+        assert set(a) == set(b), path
+        for k in a:
+            _assert_tree_equal(a[k], b[k], f"{path}/{k}")
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_tree_equal(x, y, f"{path}[{i}]")
+    elif isinstance(a, np.ndarray) or hasattr(a, "dtype"):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=path)
+    # scalars/None/str in the state dict: exact match
+    elif a is not None and not isinstance(a, float):
+        assert a == b, path
+
+
+@pytest.mark.timeout(300)
+def test_ppo_bitwise_parity_health_off_vs_untripped(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    baseline = _run_and_load(tmp_path, "off", ["health.enabled=false"])
+    # enabled with thresholds a 1-iteration dry run can never trip
+    enabled = _run_and_load(
+        tmp_path,
+        "on",
+        [
+            "health.enabled=true",
+            "health.divergence.warmup=64",
+            "health.stall.warmup=64",
+        ],
+    )
+    _assert_tree_equal(baseline["agent"], enabled["agent"], "agent")
+    _assert_tree_equal(baseline["optimizer"], enabled["optimizer"], "optimizer")
+    np.testing.assert_array_equal(np.asarray(baseline["rng"]), np.asarray(enabled["rng"]))

@@ -180,8 +180,8 @@ def _capture_ppo(standard_args, pipelined, monkeypatch):
     def spy_make_train_fn(*args, **kwargs):
         train_fn = real_make_train_fn(*args, **kwargs)
 
-        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef):
-            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef, *rest):
+            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef, *rest)
             captured.append(
                 {
                     "data": {k: np.asarray(jax.device_get(v)) for k, v in data.items()},

@@ -41,8 +41,8 @@ def _run_and_capture(standard_args, backend, monkeypatch):
     def spy_make_train_fn(*args, **kwargs):
         train_fn = real_make_train_fn(*args, **kwargs)
 
-        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef):
-            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef)
+        def wrapped(params, opt_state, data, next_values, key, clip_coef, ent_coef, *rest):
+            out = train_fn(params, opt_state, data, next_values, key, clip_coef, ent_coef, *rest)
             captured.append(
                 {
                     "data": {k: np.asarray(jax.device_get(v)) for k, v in data.items()},

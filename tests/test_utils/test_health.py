@@ -1,0 +1,455 @@
+"""Health sentinel unit coverage: detector math (EWMA/z-score, streaks,
+hysteresis), the response ladder, checkpoint certification gating, the
+certification-aware keep_last GC, load_state's certified-first fallback, and
+rollback digest parity (save -> certify -> take_rollback_state -> tree-equal)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.core import health
+from sheeprl_tpu.utils.checkpoint import (
+    CheckpointCallback,
+    certified_sidecar,
+    certify,
+    is_certified,
+    latest_certified,
+    load_state,
+    save_state,
+)
+from sheeprl_tpu.utils.metric import EWMAStat
+
+
+def _cfg(**over):
+    """Minimal dict-config with the health group enabled + overrides."""
+    group = {
+        "enabled": True,
+        "divergence": {"window": 16, "warmup": 4, "z_threshold": 6.0, "z_clear": 3.0, "streak": 2},
+        "stall": {"enabled": False},
+        "response": {"recover_iters": 3, "grace_iters": 2, "rollback_budget": 2},
+    }
+
+    def merge(dst, src):
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(group, over)
+    return {"health": group}
+
+
+# --------------------------------------------------------------------------- #
+# EWMAStat
+# --------------------------------------------------------------------------- #
+
+
+def test_ewma_tracks_mean_and_variance():
+    stat = EWMAStat(window=8)
+    rng = np.random.default_rng(0)
+    xs = rng.normal(5.0, 2.0, size=2000)
+    for x in xs:
+        stat.update(float(x))
+    assert abs(stat.mean - 5.0) < 0.8
+    assert abs(stat.std - 2.0) < 0.8
+
+
+def test_ewma_zscore_flags_outliers_not_inliers():
+    stat = EWMAStat(window=16)
+    for _ in range(50):
+        stat.update(1.0)
+    assert abs(stat.zscore(1.0)) < 1.0
+    assert abs(stat.zscore(1e6)) > 100.0
+
+
+def test_ewma_ignores_nonfinite_and_zscore_is_inf():
+    stat = EWMAStat(window=8)
+    for _ in range(10):
+        stat.update(2.0)
+    mean_before = stat.mean
+    stat.update(float("nan"))
+    stat.update(float("inf"))
+    assert stat.mean == mean_before  # non-finite samples never poison the moments
+    assert math.isinf(stat.zscore(float("nan")))
+
+
+def test_ewma_zscore_zero_until_two_samples():
+    stat = EWMAStat(window=8)
+    assert stat.zscore(123.0) == 0.0
+    stat.update(1.0)
+    assert stat.zscore(123.0) == 0.0
+    stat.update(1.0)
+    assert stat.zscore(123.0) != 0.0
+
+
+# --------------------------------------------------------------------------- #
+# Detectors
+# --------------------------------------------------------------------------- #
+
+
+def test_divergence_quiet_on_stationary_signal():
+    det = health.DivergenceDetector(warmup=4, streak=2)
+    rng = np.random.default_rng(1)
+    for x in rng.normal(0.5, 0.01, size=200):
+        fired, _ = det.check({"Loss/value_loss": float(x)})
+        assert not fired
+
+
+def test_divergence_fires_after_streak_not_single_blip():
+    det = health.DivergenceDetector(warmup=4, z_threshold=6.0, streak=3)
+    for _ in range(20):
+        det.check({"Loss/value_loss": 1.0})
+    fired, _ = det.check({"Loss/value_loss": 1e4})
+    assert not fired  # streak 1 of 3
+    fired, _ = det.check({"Loss/value_loss": 1e4})
+    assert not fired
+    fired, reason = det.check({"Loss/value_loss": 1e4})
+    assert fired and "Loss/value_loss" in reason
+
+
+def test_divergence_anomalous_samples_do_not_move_baseline():
+    det = health.DivergenceDetector(warmup=4, streak=100)  # huge streak: never fires
+    for _ in range(20):
+        det.check({"k": 1.0})
+    baseline = det._stats["k"].mean
+    for _ in range(50):
+        det.check({"k": 1e4})
+    assert det._stats["k"].mean == baseline
+
+
+def test_divergence_nan_is_immediate_anomaly():
+    det = health.DivergenceDetector(warmup=4, streak=1)
+    fired, reason = det.check({"k": float("nan")})
+    assert fired and "inf" in reason
+
+
+def test_divergence_hysteresis_z_clear_keeps_episode_open():
+    det = health.DivergenceDetector(warmup=4, z_threshold=8.0, z_clear=3.0, streak=1)
+    rng = np.random.default_rng(2)
+    for x in rng.normal(0.0, 1.0, size=100):
+        det.check({"k": float(x)})
+    std = max(det._stats["k"].std, 1e-8)
+    mean = det._stats["k"].mean
+    det.check({"k": mean + 20 * std})  # open the episode (z > 8)
+    assert det._in_anomaly["k"]
+    det.check({"k": mean + 5 * std})  # 3 < z < 8: stays OPEN under hysteresis
+    assert det._in_anomaly["k"]
+    det.check({"k": mean})  # back under z_clear: closes
+    assert not det._in_anomaly["k"]
+
+
+def test_stall_detector_fires_on_sps_collapse():
+    det = health.StallDetector(warmup=4, floor_ratio=0.2, streak=2)
+    for _ in range(10):
+        fired, _ = det.check(steps=1000.0, elapsed_s=1.0)
+        assert not fired
+    fired, _ = det.check(steps=10.0, elapsed_s=1.0)
+    assert not fired  # streak 1 of 2
+    fired, reason = det.check(steps=10.0, elapsed_s=1.0)
+    assert fired and "stall" in reason
+
+
+def test_stall_detector_deadline():
+    det = health.StallDetector(warmup=2, deadline_s=0.5)
+    fired, reason = det.check(steps=100.0, elapsed_s=2.0)
+    assert fired and "deadline" in reason
+
+
+def test_thrash_detector_skip_and_retrace_streaks():
+    det = health.ThrashDetector(skip_streak=3, retrace_streak=2)
+    assert not det.check(skipped=1, retraces=0)[0]
+    assert not det.check(skipped=1, retraces=0)[0]
+    assert det.check(skipped=1, retraces=0)[0]
+    det.reset()
+    assert not det.check(skipped=0, retraces=1)[0]
+    fired, reason = det.check(skipped=0, retraces=1)
+    assert fired and "retrace" in reason
+    # a clean check resets both streaks
+    det.reset()
+    det.check(skipped=1, retraces=0)
+    det.check(skipped=0, retraces=0)
+    assert not det.check(skipped=1, retraces=0)[0]
+
+
+# --------------------------------------------------------------------------- #
+# Sentinel ladder
+# --------------------------------------------------------------------------- #
+
+
+def _feed_healthy(sentinel, n, start=0, step=64):
+    for i in range(n):
+        action = sentinel.observe(start + i * step, train_metrics={"Loss/value_loss": 1.0})
+        assert action.kind == "none"
+    return start + n * step
+
+
+def test_sentinel_disabled_is_noop(tmp_path):
+    sentinel = health.HealthSentinel({}, log_dir=str(tmp_path))
+    action = sentinel.observe(0, train_metrics={"Loss/value_loss": float("nan")})
+    assert action is health.NO_ACTION
+    assert sentinel.lr_scale == 1.0
+    assert not sentinel.certifiable  # disabled runs never certify
+    assert not os.path.exists(tmp_path / "health")
+
+
+def test_sentinel_ladder_escalates_and_backs_off(tmp_path):
+    sentinel = health.HealthSentinel(_cfg(), log_dir=str(tmp_path))
+    step = _feed_healthy(sentinel, 20)
+    kinds = []
+    for i in range(4):
+        a = sentinel.observe(step + i * 64, train_metrics={"Loss/value_loss": 1e6})
+        kinds.append(a.kind)
+    # streak=2 delays the first detection one check; then warn -> backoff -> rollback
+    assert kinds == ["none", "warn", "backoff", "rollback"]
+    assert sentinel.lr_scale == pytest.approx(0.5)
+    assert not sentinel.certifiable  # open anomaly episode blocks certification
+    events = [
+        json.loads(l)
+        for l in open(tmp_path / "health" / "events.jsonl").read().splitlines()
+    ]
+    assert [e["event"] for e in events] == ["warn", "backoff", "rollback_requested"]
+    # flight recorder flushed on each ladder action
+    assert len(list((tmp_path / "health").glob("flight_*.jsonl"))) == 3
+
+
+def test_sentinel_recovers_after_healthy_streak(tmp_path):
+    sentinel = health.HealthSentinel(_cfg(), log_dir=str(tmp_path))
+    step = _feed_healthy(sentinel, 20)
+    sentinel.observe(step, train_metrics={"Loss/value_loss": 1e6})
+    sentinel.observe(step + 64, train_metrics={"Loss/value_loss": 1e6})  # warn
+    assert sentinel._level == 1
+    _feed_healthy(sentinel, 5, start=step + 128)  # recover_iters=3
+    assert sentinel._level == 0 and sentinel.lr_scale == 1.0
+    assert sentinel.certifiable
+
+
+def test_sentinel_supports_filters_ladder(tmp_path):
+    sentinel = health.HealthSentinel(_cfg(), log_dir=str(tmp_path), supports=("warn",))
+    step = _feed_healthy(sentinel, 20)
+    kinds = [
+        sentinel.observe(step + i * 64, train_metrics={"Loss/value_loss": 1e6}).kind
+        for i in range(5)
+    ]
+    assert set(kinds) <= {"none", "warn"}  # backoff/rollback fall back to warn
+    assert sentinel.lr_scale == 1.0
+
+
+def test_sentinel_counters_drain_deltas(tmp_path):
+    class Agg:
+        def __init__(self):
+            self.seen = {}
+
+        def __contains__(self, k):
+            return True
+
+        def update(self, k, v):
+            self.seen[k] = self.seen.get(k, 0) + v
+
+    sentinel = health.HealthSentinel(_cfg(), log_dir=str(tmp_path))
+    step = _feed_healthy(sentinel, 20)
+    for i in range(3):
+        sentinel.observe(step + i * 64, train_metrics={"Loss/value_loss": 1e6})
+    agg = Agg()
+    sentinel.drain(agg)
+    assert agg.seen["Health/detections"] == 2  # streak=2 eats the first check
+    assert agg.seen["Health/warns"] == 1
+    assert agg.seen["Health/backoffs"] == 1
+    first = dict(agg.seen)
+    sentinel.drain(agg)  # no new events: counters must NOT double-count
+    assert agg.seen["Health/detections"] == first["Health/detections"]
+
+
+# --------------------------------------------------------------------------- #
+# Certification + GC + load_state preference
+# --------------------------------------------------------------------------- #
+
+
+def _write_ckpt(path, iter_num, mtime):
+    save_state(str(path), {"iter_num": iter_num, "agent": np.full((3,), iter_num, np.float32)})
+    os.utime(path, (mtime, mtime))
+
+
+def _corrupt(path):
+    st = path.stat()
+    raw = bytearray(path.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    os.utime(path, (st.st_atime, st.st_mtime))
+
+
+def test_certify_roundtrip_and_size_guard(tmp_path):
+    p = tmp_path / "ckpt_10_0.ckpt"
+    info = save_state(str(p), {"iter_num": 10})
+    assert not is_certified(str(p))
+    certify(str(p), crc32=info["crc32"], size=info["size"], policy_step=10)
+    assert is_certified(str(p))
+    payload = json.loads(open(certified_sidecar(str(p))).read())
+    assert payload["policy_step"] == 10 and payload["crc32"] == info["crc32"]
+    # overwriting the checkpoint after certification voids the sidecar
+    save_state(str(p), {"iter_num": 11, "pad": np.zeros(64, np.float32)})
+    assert not is_certified(str(p))
+
+
+def test_checkpoint_callback_certifies_only_when_healthy(tmp_path):
+    cb = CheckpointCallback()
+    good = tmp_path / "ckpt_10_0.ckpt"
+    bad = tmp_path / "ckpt_20_0.ckpt"
+    cb.on_checkpoint_coupled(None, str(good), {"iter_num": 10}, healthy=True, policy_step=10)
+    cb.on_checkpoint_coupled(None, str(bad), {"iter_num": 20}, healthy=False, policy_step=20)
+    assert is_certified(str(good))
+    assert not os.path.exists(certified_sidecar(str(bad)))
+    # healthy=None (loop without a sentinel): no sidecar either
+    legacy = tmp_path / "ckpt_30_0.ckpt"
+    cb.on_checkpoint_coupled(None, str(legacy), {"iter_num": 30})
+    assert not os.path.exists(certified_sidecar(str(legacy)))
+
+
+def test_gc_exempts_certified_from_main_window(tmp_path):
+    cb = CheckpointCallback(keep_last=1)
+    cert = tmp_path / "ckpt_10_0.ckpt"
+    info = save_state(str(cert), {"iter_num": 10})
+    os.utime(cert, (1000, 1000))
+    certify(str(cert), crc32=info["crc32"], size=info["size"])
+    _write_ckpt(tmp_path / "ckpt_20_0.ckpt", 20, 2000)
+    _write_ckpt(tmp_path / "ckpt_30_0.ckpt", 30, 3000)
+    cb._gc(str(tmp_path))
+    names = sorted(f.name for f in tmp_path.glob("ckpt_*.ckpt"))
+    # the certified OLDEST survives keep_last=1; the newest plain survives too
+    assert names == ["ckpt_10_0.ckpt", "ckpt_30_0.ckpt"]
+    assert is_certified(str(cert))
+
+
+def test_gc_ages_out_certified_under_own_budget(tmp_path):
+    cb = CheckpointCallback(keep_last=1)
+    for step, mtime in ((10, 1000), (20, 2000), (30, 3000)):
+        p = tmp_path / f"ckpt_{step}_0.ckpt"
+        info = save_state(str(p), {"iter_num": step})
+        os.utime(p, (mtime, mtime))
+        certify(str(p), crc32=info["crc32"], size=info["size"])
+    cb._gc(str(tmp_path))
+    assert sorted(f.name for f in tmp_path.glob("ckpt_*.ckpt")) == ["ckpt_30_0.ckpt"]
+    # sidecars of the aged-out certified files went with them
+    assert sorted(f.name for f in tmp_path.glob("*.certified.json")) == [
+        "ckpt_30_0.ckpt.certified.json"
+    ]
+
+
+def test_gc_sweeps_orphan_sidecars(tmp_path):
+    cb = CheckpointCallback(keep_last=2)
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    orphan = tmp_path / "ckpt_99_0.ckpt.certified.json"
+    orphan.write_text(json.dumps({"certified": True, "ckpt": "ckpt_99_0.ckpt"}))
+    cb._gc(str(tmp_path))
+    assert not orphan.exists()
+
+
+def test_latest_certified_picks_newest_by_mtime(tmp_path):
+    assert latest_certified(str(tmp_path)) is None
+    for step, mtime in ((10, 1000), (20, 2000)):
+        p = tmp_path / f"ckpt_{step}_0.ckpt"
+        info = save_state(str(p), {"iter_num": step})
+        os.utime(p, (mtime, mtime))
+        certify(str(p), crc32=info["crc32"], size=info["size"])
+    _write_ckpt(tmp_path / "ckpt_30_0.ckpt", 30, 3000)  # newest but NOT certified
+    assert latest_certified(str(tmp_path)).endswith("ckpt_20_0.ckpt")
+
+
+def test_load_state_fallback_prefers_certified_sibling(tmp_path):
+    # newest corrupt; among the older siblings the CERTIFIED one wins even
+    # though a newer non-certified sibling exists
+    cert = tmp_path / "ckpt_10_0.ckpt"
+    info = save_state(str(cert), {"iter_num": 10, "agent": np.zeros(3, np.float32)})
+    os.utime(cert, (1000, 1000))
+    certify(str(cert), crc32=info["crc32"], size=info["size"])
+    _write_ckpt(tmp_path / "ckpt_20_0.ckpt", 20, 2000)
+    newest = tmp_path / "ckpt_30_0.ckpt"
+    _write_ckpt(newest, 30, 3000)
+    _corrupt(newest)
+    with pytest.warns(UserWarning, match="older sibling"):
+        state = load_state(str(newest))
+    assert state["iter_num"] == 10
+
+
+# --------------------------------------------------------------------------- #
+# Rollback
+# --------------------------------------------------------------------------- #
+
+
+def _armed_sentinel(tmp_path, **over):
+    sentinel = health.HealthSentinel(_cfg(**over), log_dir=str(tmp_path))
+    _feed_healthy(sentinel, 20)
+    return sentinel
+
+
+def test_rollback_digest_parity(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    state = {
+        "agent": {"w": np.arange(12, dtype=np.float32).reshape(3, 4), "b": np.ones(4, np.float32)},
+        "iter_num": 7,
+    }
+    p = ckpt_dir / "ckpt_7_0.ckpt"
+    info = save_state(str(p), state)
+    certify(str(p), crc32=info["crc32"], size=info["size"])
+    sentinel = _armed_sentinel(tmp_path)
+    restored = sentinel.take_rollback_state(str(ckpt_dir))
+    assert restored is not None
+    np.testing.assert_array_equal(restored["agent"]["w"], state["agent"]["w"])
+    np.testing.assert_array_equal(restored["agent"]["b"], state["agent"]["b"])
+    assert restored["iter_num"] == 7
+    # post-rollback: detectors reset, grace window armed, scale tightened
+    assert sentinel._grace == 2 and sentinel.lr_scale == pytest.approx(0.5)
+    events = [
+        json.loads(l)
+        for l in open(tmp_path / "health" / "events.jsonl").read().splitlines()
+    ]
+    assert events[-1]["event"] == "rollback"
+    assert events[-1]["path"].endswith("ckpt_7_0.ckpt")
+
+
+def test_rollback_refuses_uncertified(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    _write_ckpt(ckpt_dir / "ckpt_7_0.ckpt", 7, 1000)  # present but never certified
+    sentinel = _armed_sentinel(tmp_path)
+    assert sentinel.take_rollback_state(str(ckpt_dir)) is None
+
+
+def test_rollback_budget_is_bounded(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    p = ckpt_dir / "ckpt_7_0.ckpt"
+    info = save_state(str(p), {"iter_num": 7})
+    certify(str(p), crc32=info["crc32"], size=info["size"])
+    sentinel = _armed_sentinel(tmp_path, response={"rollback_budget": 1, "grace_iters": 0})
+    assert sentinel.take_rollback_state(str(ckpt_dir)) is not None
+    assert sentinel.take_rollback_state(str(ckpt_dir)) is None  # budget spent
+
+
+def test_grace_window_suppresses_detection(tmp_path):
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    p = ckpt_dir / "ckpt_7_0.ckpt"
+    info = save_state(str(p), {"iter_num": 7})
+    certify(str(p), crc32=info["crc32"], size=info["size"])
+    sentinel = _armed_sentinel(tmp_path)
+    assert sentinel.take_rollback_state(str(ckpt_dir)) is not None
+    assert not sentinel.certifiable  # never certify inside the grace window
+    # grace_iters=2: the next two observes ignore even NaN losses
+    a1 = sentinel.observe(10_000, train_metrics={"Loss/value_loss": float("nan")})
+    assert not sentinel.certifiable  # still one grace check left
+    a2 = sentinel.observe(10_064, train_metrics={"Loss/value_loss": float("nan")})
+    assert a1.kind == "none" and a2.kind == "none"
+
+
+def test_resolve_tolerates_missing_group():
+    view = health.resolve({})
+    assert view.enabled is False
+    assert view.divergence.z_threshold == 8.0
+    view2 = health.resolve({"health": {"enabled": True}})
+    assert view2.enabled is True
+    assert view2.response.ladder == ["warn", "backoff", "rollback"]
