@@ -385,6 +385,40 @@ def bench_health() -> dict:
     }
 
 
+def bench_orchestrate() -> dict:
+    """Elastic-population drill: preemption-recovery latency + resow wall clock.
+
+    Reuses the scripts/population_smoke.py fleet chaos drill (two PPO trials on
+    two preemptible slots: controller kill-and-restart, two injected slot
+    preemptions, one ChaosEnv divergence resown from the clean peer's certified
+    checkpoint). Recovery latency is SIGTERM-exit to respawn of the resumed
+    incarnation; resow wall is divergence verdict to the resown spawn. Both
+    measure the orchestration machinery on the CPU backend — comparable across
+    rounds, silent about accelerator throughput.
+    """
+    import importlib.util
+    import os
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "population_smoke",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "population_smoke.py"),
+    )
+    population_smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(population_smoke)
+
+    t0 = time.perf_counter()
+    smoke = population_smoke.main(tempfile.mkdtemp(prefix="bench_orchestrate_"))
+    return {
+        "orchestrate_preempt_recovery_s": smoke["preempt_recovery_latency_s"],
+        "orchestrate_preempt_recoveries": smoke["preempt_recovery_latencies_s"],
+        "orchestrate_resow_wall_s": smoke["resow_wall_s"],
+        "orchestrate_injections": smoke["injections"],
+        "orchestrate_controller_incarnations": smoke["controller_incarnations"],
+        "orchestrate_drill_wall_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 def _target_metric(target: str) -> str:
     """Headline metric name for a bench target — the watchdog's failure record
     must name the metric the selected target WOULD have produced, not hardcode
@@ -395,6 +429,7 @@ def _target_metric(target: str) -> str:
         "dv3": "dv3_gsteps_per_sec",
         "compile": "compile_warm_first_train_step_s",
         "health": "health_detection_latency_s",
+        "orchestrate": "orchestrate_preempt_recovery_s",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -450,7 +485,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "health", "all"),
+        choices=("ppo", "dv3", "compile", "health", "orchestrate", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -564,6 +599,14 @@ if __name__ == "__main__":
                 result.update(health)
                 result.setdefault("metric", headline_metric)
                 result.setdefault("value", health.get("health_detection_latency_s"))
+                result.setdefault("unit", "s")
+            if cli_args.target == "orchestrate":
+                # opt-in only, like health: a CPU-backend fleet drill measuring
+                # the population controller, not the accelerator
+                orch = bench_orchestrate()
+                result.update(orch)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", orch.get("orchestrate_preempt_recovery_s"))
                 result.setdefault("unit", "s")
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
         # numbers are real but from the CPU backend — flag them as incomparable

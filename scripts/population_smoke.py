@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Population smoke: the fleet-level chaos drill for sheeprl_tpu/orchestrate/.
+
+Runs a tiny PPO population (one clean trial + one ChaosEnv trial with a
+reward-spike divergence window) on a pool of 2 preemptible slots and proves the
+elastic orchestration end-to-end:
+
+1. **controller preemption** — the controller itself is SIGTERMed mid-drill
+   (after the trial guards arm); it forwards the signal to every trial, each
+   trial writes its emergency checkpoint, the journal records the fleet as
+   requeued, and a SECOND controller incarnation resumes from the journal with
+   no duplicated or lost trials;
+2. **slot preemptions** — the restarted controller injects >= 2 SIGTERM
+   preemptions into running trials; each victim checkpoints, requeues with
+   jittered backoff, and resumes from its own newest checkpoint;
+3. **divergence -> resow** — the chaos trial's HealthSentinel records a
+   divergence verdict in ``health/events.jsonl``; the controller kills the
+   trial and resows it from the clean peer's newest *certified* checkpoint
+   with perturbed hyperparameters (exploit/explore), recording the edge in
+   ``lineage.jsonl``;
+4. **clean finish** — every trial ends completed-or-resown (no trial failed,
+   none lost), the best-trial lineage is reconstructable, and zero trial
+   subprocesses are left orphaned.
+
+Run directly (``python scripts/population_smoke.py``) or through the
+registered tier-1 test (tests/test_utils/test_population_smoke.py).
+``bench.py --target orchestrate`` reuses :func:`main` and reports the
+preemption-recovery latency and resow wall clock from the controller counters.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Mirror of the proven health_smoke PPO-dummy configuration, shrunk for fleet
+# duty: policy steps == env steps (rollout 4 x 1 sync env), certified
+# checkpoints every 16 steps, and the sentinel tuned so the injected reward
+# spike (z ~ 1e6+) is unmistakable against clean early-training drift (z ~ 10).
+_BASE_OVERRIDES = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=2",
+    "algo.update_epochs=1",
+    "algo.total_steps=256",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+    "algo.run_test=False",
+    "buffer.memmap=False",
+    "checkpoint.every=16",
+    "checkpoint.save_last=False",
+    "health.enabled=True",
+    "health.check_every=1",
+    "health.divergence.warmup=4",
+    "health.divergence.streak=1",
+    "health.divergence.z_threshold=50.0",
+    "health.divergence.z_clear=20.0",
+    "health.stall.enabled=False",
+    "health.response.grace_iters=3",
+    "health.response.recover_iters=4",
+    "health.response.rollback_budget=2",
+]
+
+# Gen-0-only environmental fault: rewards x1e6 for env steps [40, 64) — the
+# spike lands AFTER the clean peer's first certified checkpoints exist, and a
+# resown generation is rescheduled weather-free.
+_CHAOS_OVERRIDES = [
+    "env.wrapper._target_=sheeprl_tpu.envs.chaos.chaos_dummy_env",
+    "env.wrapper.chaos.reward_scale_from=40",
+    "env.wrapper.chaos.reward_scale_until=64",
+    "env.wrapper.chaos.reward_scale=1e6",
+]
+
+_SPEC = {
+    "orchestrate": {
+        "slots": 2,
+        "poll_interval_s": 0.2,
+        "trial": {
+            "max_preemptions": 8,
+            "max_failures": 3,
+            "requeue_backoff_base_s": 0.2,
+            "requeue_backoff_max_s": 2.0,
+        },
+        "resow": {
+            "enabled": True,
+            "max_per_trial": 2,
+            "parent_wait_s": 120.0,
+            "perturb": {"keys": ["algo.optimizer.lr"], "factors": [0.8, 1.25]},
+        },
+        "exploit": {"interval_s": 0.0},
+        "shutdown": {"drain_timeout_s": 90.0},
+    },
+    "trials": [
+        {
+            "key": "a_clean",
+            "overrides": _BASE_OVERRIDES + ["seed=7"],
+            "hyperparams": {"algo.optimizer.lr": 1e-3},
+        },
+        {
+            "key": "b_chaos",
+            "overrides": _BASE_OVERRIDES + ["seed=11"],
+            "hyperparams": {"algo.optimizer.lr": 1e-3},
+            "chaos_overrides": _CHAOS_OVERRIDES,
+        },
+    ],
+}
+
+
+def _controller(spec_path: str, state_dir: str, inject: int, spacing: float) -> subprocess.Popen:
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu.orchestrate.controller",
+            "--spec",
+            spec_path,
+            "--state-dir",
+            state_dir,
+            "--inject-preempt",
+            str(inject),
+            "--inject-spacing-s",
+            str(spacing),
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu")),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _ready_files(state_dir: str) -> list:
+    found = []
+    trials_dir = os.path.join(state_dir, "trials")
+    try:
+        keys = os.listdir(trials_dir)
+    except OSError:
+        return found
+    for key in keys:
+        if os.path.exists(os.path.join(trials_dir, key, ".guard_ready")):
+            found.append(key)
+    return found
+
+
+def _journal(state_dir: str) -> dict:
+    try:
+        with open(os.path.join(state_dir, "journal.json")) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _pid_dead(pid) -> bool:
+    if not pid:
+        return True
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, PermissionError, OSError):
+        return True
+    return False
+
+
+def main(
+    workdir: str | None = None,
+    timeout: float = 900.0,
+    inject: int = 2,
+    restart_controller: bool = True,
+) -> dict:
+    workdir = workdir or tempfile.mkdtemp(prefix="population_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    state_dir = os.path.join(workdir, "orchestrate")
+    spec_path = os.path.join(workdir, "population.json")
+    with open(spec_path, "w") as f:
+        json.dump(_SPEC, f, indent=2)
+    deadline = time.time() + timeout
+    transcript: list = []
+
+    if restart_controller:
+        # Phase 1: start the fleet, wait until every slot's trial guard is
+        # armed, then preempt the CONTROLLER itself (acceptance criterion:
+        # restart resumes from the journal with no duplicated/lost trials).
+        proc = _controller(spec_path, state_dir, inject=0, spacing=2.0)
+        try:
+            while time.time() < deadline:
+                if proc.poll() is not None:
+                    out = proc.stdout.read()
+                    raise SystemExit(
+                        f"phase-1 controller exited early (rc={proc.returncode}):\n{out[-3000:]}"
+                    )
+                if len(_ready_files(state_dir)) >= 2:
+                    break
+                time.sleep(0.25)
+            else:
+                raise SystemExit("phase 1: trial guards never armed within the timeout")
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=max(deadline - time.time(), 30.0))
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        phase1_out = proc.stdout.read()
+        transcript.append(phase1_out)
+        if rc != 0:
+            raise SystemExit(f"preempted controller must exit 0, got {rc}:\n{phase1_out[-3000:]}")
+        snap = _journal(state_dir)
+        states = {t["spec"]["key"]: t["state"] for t in snap.get("trials", [])}
+        if sorted(states) != ["a_clean", "b_chaos"]:
+            raise SystemExit(f"journal lost/duplicated trials across controller kill: {states}")
+        if any(s == "running" for s in states.values()):
+            raise SystemExit(f"drained journal still claims running trials: {states}")
+
+    # Phase 2 (or the whole drill): run to completion with injected slot
+    # preemptions; the chaos trial must diverge, be killed, and be resown.
+    proc = _controller(spec_path, state_dir, inject=inject, spacing=2.0)
+    try:
+        out, _ = proc.communicate(timeout=max(deadline - time.time(), 60.0))
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise SystemExit(f"controller did not finish within the timeout; tail:\n{out[-3000:]}")
+    transcript.append(out)
+    if proc.returncode != 0:
+        raise SystemExit(f"controller exited rc={proc.returncode}; tail:\n{out[-3000:]}")
+    result_line = next(
+        (line for line in reversed(out.splitlines()) if line.startswith("ORCHESTRATE_RESULT ")), None
+    )
+    if result_line is None:
+        raise SystemExit(f"no ORCHESTRATE_RESULT line; tail:\n{out[-3000:]}")
+    summary = json.loads(result_line.split("ORCHESTRATE_RESULT ", 1)[1])
+    if summary["status"] != "done":
+        raise SystemExit(f"fleet did not finish: {summary}")
+
+    # Every trial completed-or-resown: a resown trial ends COMPLETED with
+    # generation >= 1; FAILED or still-queued trials mean the drill is broken.
+    for key, info in summary["trials"].items():
+        if info["state"] != "completed":
+            raise SystemExit(f"trial {key} ended {info['state']}, not completed: {summary}")
+    counters = summary["counters"]
+    if counters["injections"] < inject:
+        raise SystemExit(f"only {counters['injections']}/{inject} preemptions were injected")
+    if restart_controller and counters["controller_incarnations"] < 2:
+        raise SystemExit(f"controller restart did not happen: {counters}")
+
+    # Divergence -> resow from a peer's CERTIFIED checkpoint, recorded in lineage.
+    lineage_path = os.path.join(state_dir, "lineage.jsonl")
+    with open(lineage_path) as f:
+        edges = [json.loads(line) for line in f if line.strip()]
+    resows = [e for e in edges if e["kind"] == "resow" and e.get("parent")]
+    if not resows:
+        raise SystemExit(f"no resow edge in lineage; kinds={[e['kind'] for e in edges]}")
+    resow = resows[0]
+    if resow["trial"] != "b_chaos" or resow["parent"] != "a_clean":
+        raise SystemExit(f"unexpected resow edge: {resow}")
+    if not resow.get("ckpt") or not os.path.exists(resow["ckpt"] + ".certified.json"):
+        raise SystemExit(f"resow did not come from a certified peer checkpoint: {resow}")
+    if summary["trials"]["b_chaos"]["generation"] < 1:
+        raise SystemExit("diverged trial was not resown into a new generation")
+    seeds = [e for e in edges if e["kind"] == "seed"]
+    if len(seeds) != 2:
+        raise SystemExit(f"expected exactly one seed edge per trial, got {len(seeds)}")
+
+    # Zero orphaned slots: the journal's final snapshot has no running trials
+    # and every recorded pid is dead.
+    snap = _journal(state_dir)
+    for t in snap.get("trials", []):
+        if t["state"] == "running" or not _pid_dead(t.get("pid")):
+            raise SystemExit(f"orphaned trial slot: {t['spec']['key']} state={t['state']} pid={t.get('pid')}")
+
+    recoveries = [r["latency_s"] for r in counters.get("preempt_recoveries", [])]
+    resow_walls = [r["wall_s"] for r in counters.get("resow_walls", [])]
+    return {
+        "workdir": workdir,
+        "state_dir": state_dir,
+        "trials": summary["trials"],
+        "injections": counters["injections"],
+        "controller_incarnations": counters["controller_incarnations"],
+        "resow_edges": len(resows),
+        "preempt_recovery_latencies_s": recoveries,
+        "preempt_recovery_latency_s": round(sorted(recoveries)[len(recoveries) // 2], 3) if recoveries else None,
+        "resow_wall_s": round(resow_walls[0], 3) if resow_walls else None,
+        "lineage": lineage_path,
+        "transcript_tail": transcript[-1][-800:],
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None, help="drill directory (default: fresh tempdir)")
+    parser.add_argument("--timeout", type=float, default=900.0, help="whole-drill timeout in seconds")
+    parser.add_argument("--inject", type=int, default=2, help="slot preemptions to inject (phase 2)")
+    parser.add_argument(
+        "--skip-restart-phase",
+        action="store_true",
+        help="skip the controller-kill-and-restart phase (single-phase drill)",
+    )
+    cli = parser.parse_args()
+    result = main(
+        cli.workdir, cli.timeout, inject=cli.inject, restart_controller=not cli.skip_restart_phase
+    )
+    print(
+        "population smoke OK: "
+        f"{result['injections']} injected preemptions survived "
+        f"(median recovery {result['preempt_recovery_latency_s']}s), "
+        f"diverged trial resown from certified peer in {result['resow_wall_s']}s, "
+        f"{result['controller_incarnations']} controller incarnation(s), "
+        f"lineage at {result['lineage']}"
+    )

@@ -96,11 +96,22 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     merged.root_dir = cfg.root_dir
     merged.seed = cfg.seed
     merged.fabric = cfg.fabric
-    # Fault-tolerance knobs describe the RESUMING environment (deadlines,
-    # restart budgets, a test run's stop_after_iters), not the experiment
-    # identity — always take the new invocation's values over the sidecar's.
+    # Fault-tolerance and health knobs describe the RESUMING environment
+    # (deadlines, restart budgets, a test run's stop_after_iters, sentinel
+    # thresholds), not the experiment identity — always take the new
+    # invocation's values over the sidecar's.
     if cfg.get("fault_tolerance") is not None:
         merged.fault_tolerance = cfg.fault_tolerance
+    if cfg.get("health") is not None:
+        merged.health = cfg.health
+    # Explicitly-preserved dotted keys: the population controller's
+    # exploit/explore step resumes a trial from a PEER's checkpoint with
+    # perturbed hyperparameters; without this hook the sidecar merge would
+    # silently swallow those overrides and every resow would be a no-op clone.
+    from sheeprl_tpu.utils.utils import get_nested, set_nested
+
+    for key in cfg.checkpoint.get("resume_preserve") or []:
+        set_nested(merged, str(key), get_nested(cfg, str(key)))
     return merged
 
 

@@ -161,6 +161,47 @@ def resolve(cfg: Any) -> _View:
     return _View(_merge(_DEFAULTS, group))
 
 
+EVENTS_FILENAME = "events.jsonl"
+
+DIVERGENCE_EVENT_KINDS = ("warn", "backoff", "rollback_requested", "rollback")
+
+
+def read_events(path: str, offset: int = 0) -> Tuple[List[Dict[str, Any]], int]:
+    """Incrementally parse a sentinel ``events.jsonl``; returns
+    ``(new_events, new_offset)``.
+
+    ``path`` may be the events file itself or the ``health/`` directory holding
+    it. ``offset`` is the byte position a previous call returned, so a
+    supervising process (the population controller reads every trial's event
+    stream as its fitness/kill signal) tails the file without re-parsing it.
+    A torn final line (the writer appends whole lines, but the reader can race
+    the write) is left for the next call by not advancing past it.
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, EVENTS_FILENAME)
+    events: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            f.seek(offset)
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                if not line.endswith("\n"):
+                    break  # torn tail: re-read it next call
+                offset = f.tell()
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    events.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError:
+        pass
+    return events, offset
+
+
 class HealthAction:
     """What the sentinel asks the loop to do after a check."""
 

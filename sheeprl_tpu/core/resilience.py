@@ -31,6 +31,7 @@ worker processes — keep module-level imports free of jax.
 from __future__ import annotations
 
 import os
+import random
 import signal
 import threading
 import time
@@ -43,6 +44,28 @@ import numpy as np
 # harness (scripts/chaos_smoke.py) polls it so its SIGTERM lands mid-iteration
 # instead of racing process startup.
 READY_FILE_ENV_VAR = "SHEEPRL_PREEMPTION_READY_FILE"
+
+# Env var naming a file the guard touches when a REAL signal is received (not
+# the stop_after_iters test knob). A supervising parent — the population
+# controller in sheeprl_tpu/orchestrate/ — reads it to tell "exited 0 because
+# preempted (requeue + resume)" apart from "exited 0 because finished".
+FLAG_FILE_ENV_VAR = "SHEEPRL_PREEMPTION_FLAG_FILE"
+
+
+def jittered_backoff(
+    base_s: float, attempt: int, max_s: float, rng: Optional[random.Random] = None
+) -> float:
+    """Exponential backoff with jitter: ``uniform(0.5, 1.0) * min(base * 2^(n-1), max)``.
+
+    Lockstep ``base * 2**n`` delays turn a correlated fault (one SIGTERM batch
+    killing every env worker, one preemption emptying a slot pool) into a
+    thundering herd — every victim sleeps the same delay and restarts in the
+    same instant. The jitter factor spreads the herd across half the nominal
+    delay while keeping the bounded-exponential envelope.
+    """
+    nominal = min(float(base_s) * (2 ** (max(int(attempt), 1) - 1)), float(max_s))
+    draw = (rng or random).uniform(0.5, 1.0)
+    return draw * nominal
 
 _DEFAULTS: Dict[str, Dict[str, Any]] = {
     "preemption": {"enabled": True, "stop_after_iters": None},
@@ -134,19 +157,61 @@ class PreemptionGuard:
     don't depend on delivery timing. Handlers are only installed in the main
     thread (``signal.signal`` raises ValueError elsewhere) and the previous
     handlers are restored on exit.
+
+    ``forward_to_children`` (opt-in) re-delivers the received signal to every
+    PID registered via :meth:`register_child`: a preempted *controller* then
+    SIGTERMs its trial subprocesses — each of which runs its own guard and
+    writes its own emergency checkpoint — instead of orphaning them to the
+    process reaper. Registration is idempotent and dead PIDs are skipped.
     """
 
-    def __init__(self, enabled: bool = True, stop_after_iters: Optional[int] = None):
+    def __init__(
+        self,
+        enabled: bool = True,
+        stop_after_iters: Optional[int] = None,
+        forward_to_children: bool = False,
+    ):
         self._enabled = bool(enabled)
         self._stop_after = int(stop_after_iters) if stop_after_iters else None
+        self._forward = bool(forward_to_children)
+        self._children: List[int] = []
         self._completed = 0
         self._triggered = False
         self._signum: Optional[int] = None
         self._prev: Dict[int, Any] = {}
 
+    def register_child(self, pid: int) -> None:
+        """Track a subprocess for signal forwarding (no-op unless
+        ``forward_to_children``; safe to call either way)."""
+        pid = int(pid)
+        if pid not in self._children:
+            self._children.append(pid)
+
+    def unregister_child(self, pid: int) -> None:
+        try:
+            self._children.remove(int(pid))
+        except ValueError:
+            pass
+
     def _handle(self, signum, frame) -> None:  # signal-handler signature
         self._triggered = True
         self._signum = signum
+        flag = os.environ.get(FLAG_FILE_ENV_VAR)
+        if flag:
+            # os.open/write are safe enough here: Python handlers run between
+            # bytecodes, not in true async-signal context
+            try:
+                fd = os.open(flag, os.O_WRONLY | os.O_CREAT | os.O_TRUNC)
+                os.write(fd, str(int(signum)).encode())
+                os.close(fd)
+            except OSError:
+                pass
+        if self._forward:
+            for pid in list(self._children):
+                try:
+                    os.kill(pid, signum)
+                except (ProcessLookupError, PermissionError, OSError):
+                    pass
 
     def __enter__(self) -> "PreemptionGuard":
         if self._enabled and threading.current_thread() is threading.main_thread():
@@ -241,7 +306,7 @@ class WorkerSupervisor(gym.Wrapper):
                 f"env worker failed {self._restarts} times, past max_restarts="
                 f"{self._max_restarts}; giving up. Last error: {type(err).__name__}: {err}"
             ) from err
-        delay = min(self._backoff_base_s * (2 ** (self._restarts - 1)), self._backoff_max_s)
+        delay = jittered_backoff(self._backoff_base_s, self._restarts, self._backoff_max_s)
         if delay > 0:
             time.sleep(delay)
         try:
@@ -389,7 +454,7 @@ class SupervisedVectorEnv:
                 f"vector env hit its step deadline {self._group_restarts} times, past "
                 f"max_restarts={self._max_restarts}; a worker is persistently wedged."
             ) from err
-        delay = min(self._backoff_base_s * (2 ** (self._group_restarts - 1)), self._backoff_max_s)
+        delay = jittered_backoff(self._backoff_base_s, self._group_restarts, self._backoff_max_s)
         if delay > 0:
             time.sleep(delay)
         try:

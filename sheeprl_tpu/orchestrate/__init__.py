@@ -1,0 +1,108 @@
+"""Elastic population orchestration on preemptible fleets (ROADMAP item 5).
+
+PRs 2 and 5 made a *single* run survive preemption, worker death, and
+divergence (``core/resilience.py``, ``core/health.py``, certified checkpoint
+sidecars). This package composes those guarantees at the *fleet* level: a
+controller runs N concurrent trials — PBT-style hyperparameter populations, or
+one agent across a scenario matrix — as supervised subprocesses on a pool of
+preemptible slots, treating preemption and divergence as routine scheduling
+events:
+
+- a preempted slot's trial checkpoints (its own ``PreemptionGuard``) and is
+  requeued with jittered bounded backoff, resuming from its newest checkpoint;
+- a diverged trial (verdict read from its ``HealthSentinel``'s
+  ``health/events.jsonl``) is killed and *resown* from a healthy peer's newest
+  **certified** checkpoint with perturbed hyperparameters (exploit/explore);
+- the controller itself is preemptible: crash-safe fsync'd JSON journal
+  (:mod:`.journal`), SIGTERM forwarded to children
+  (``PreemptionGuard(forward_to_children=True)``), restart resumes the fleet
+  with no duplicated or lost trials;
+- every seed/resume/resow edge lands in ``orchestrate/lineage.jsonl``
+  (:mod:`.lineage`) so the best trial's ancestry is reconstructable.
+
+Config lives in the ``orchestrate`` Hydra group; every read goes through
+:func:`resolve` so specs and sidecars without the group still work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.orchestrate.journal import Journal
+from sheeprl_tpu.orchestrate.lineage import LineageLog, ancestry, read_lineage
+from sheeprl_tpu.orchestrate.trial import Trial, TrialSpec
+
+_DEFAULTS: Dict[str, Any] = {
+    "slots": 2,
+    "poll_interval_s": 0.25,
+    "trial": {
+        "max_preemptions": 8,
+        "max_failures": 2,
+        "requeue_backoff_base_s": 0.5,
+        "requeue_backoff_max_s": 30.0,
+    },
+    "resow": {
+        "enabled": True,
+        "max_per_trial": 2,
+        "parent_wait_s": 120.0,
+        "perturb": {"keys": [], "factors": [0.8, 1.25]},
+    },
+    "exploit": {"interval_s": 0.0, "quantile": 0.25, "min_peers": 3, "min_lead": 1},
+    "shutdown": {"drain_timeout_s": 60.0},
+}
+
+
+class _View:
+    """Attribute view over a plain dict (mirrors ``resilience._View``)."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self._d = d
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            v = self._d[name]
+        except KeyError:
+            raise AttributeError(name) from None
+        return _View(v) if isinstance(v, dict) else v
+
+
+def _merge(defaults: Any, got: Any) -> Any:
+    if not isinstance(defaults, dict):
+        return defaults if got is None else got
+    out = {}
+    for k, dv in defaults.items():
+        gv = None
+        if got is not None:
+            gv = got.get(k) if hasattr(got, "get") else getattr(got, k, None)
+        out[k] = _merge(dv, gv)
+    return out
+
+
+def resolve(cfg: Any) -> _View:
+    """Defaults-filled view of the ``orchestrate`` group.
+
+    Accepts a full run config (reads ``cfg.orchestrate``), a bare group dict,
+    or None. Missing keys fall back to the defaults above (which mirror
+    ``configs/orchestrate/default.yaml``)."""
+    group = None
+    if cfg is not None:
+        try:
+            group = cfg.get("orchestrate") if hasattr(cfg, "get") else None
+        except Exception:
+            group = None
+        if group is None and hasattr(cfg, "get"):
+            # a bare orchestrate-group dict (the population spec embeds one)
+            if any(k in cfg for k in _DEFAULTS):
+                group = cfg
+    return _View(_merge(_DEFAULTS, group))
+
+
+__all__ = [
+    "Journal",
+    "LineageLog",
+    "Trial",
+    "TrialSpec",
+    "ancestry",
+    "read_lineage",
+    "resolve",
+]

@@ -1,0 +1,77 @@
+"""Crash-safe controller state: an fsync'd, atomically-replaced JSON journal.
+
+The population controller must itself be preemptible: SIGTERM (or kill -9) at
+any instant, then a restart with the same ``--state-dir``, must resume the
+fleet with no duplicated or lost trials. The journal is therefore written with
+the same durability discipline as checkpoints (``utils/checkpoint.save_state``):
+temp file -> fsync -> ``os.replace`` -> directory fsync, so the file under the
+final name is always either the previous snapshot or the complete new one.
+
+A snapshot (not an event log) keeps recovery trivial — ``Journal.load`` is the
+whole story — while the append-only *lineage* record lives separately in
+``lineage.jsonl`` (see :mod:`sheeprl_tpu.orchestrate.lineage`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.orchestrate.trial import Trial
+
+JOURNAL_VERSION = 1
+
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+class Journal:
+    """Snapshot store for the controller's full mutable state."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    def save(self, trials: List[Trial], counters: Optional[Dict[str, Any]] = None) -> None:
+        payload = {
+            "version": JOURNAL_VERSION,
+            "updated": time.time(),
+            "trials": [t.to_dict() for t in trials],
+            "counters": dict(counters or {}),
+        }
+        parent = os.path.dirname(self.path)
+        os.makedirs(parent, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        _fsync_dir(parent)
+
+    def load(self) -> Optional[Dict[str, Any]]:
+        """The raw snapshot dict, or None when no journal exists yet. A torn or
+        unparseable file is impossible by construction (atomic replace), so a
+        parse error here is real corruption and should surface, not be eaten."""
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def load_trials(self) -> List[Trial]:
+        snap = self.load()
+        if not snap:
+            return []
+        return [Trial.from_dict(d) for d in snap.get("trials", [])]

@@ -1,0 +1,87 @@
+"""Append-only lineage log: every seed/resume/resow edge of the population.
+
+One JSON line per scheduling event, so the best trial's ancestry — which peer
+checkpoint it was resown from, with which perturbed hyperparameters, how many
+preemptions each generation survived — is reconstructable after the fact. PBT
+papers call this the "lineage" of the winning member; operationally it is the
+audit trail that turns "trial 2 won" into "trial 2 is trial 1's step-48
+certified checkpoint with lr x1.25, resown after trial 2's original weights
+diverged under a reward spike".
+
+Edge kinds:
+
+- ``seed``    — generation 0 starts from scratch;
+- ``resume``  — the same generation continues from its OWN newest checkpoint
+  after a preemption;
+- ``resow``   — a new generation starts from a *peer's* certified checkpoint
+  with perturbed hyperparameters (the exploit/explore step).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class LineageLog:
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+
+    def record(
+        self,
+        kind: str,
+        trial: str,
+        generation: int,
+        parent: Optional[str] = None,
+        ckpt: Optional[str] = None,
+        hyperparams: Optional[Dict[str, Any]] = None,
+        **extra: Any,
+    ) -> None:
+        row = {
+            "kind": kind,
+            "trial": trial,
+            "generation": int(generation),
+            "parent": parent,
+            "ckpt": ckpt,
+            "hyperparams": dict(hyperparams or {}),
+            "time": time.time(),
+            **extra,
+        }
+        with open(self.path, "a") as f:
+            f.write(json.dumps(row) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+
+def read_lineage(path: str) -> List[Dict[str, Any]]:
+    try:
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]
+    except FileNotFoundError:
+        return []
+
+
+def ancestry(path: str, trial: str) -> List[Dict[str, Any]]:
+    """The edge chain that produced ``trial``, oldest first.
+
+    Walks resow edges backwards across trials: a trial resown from a peer
+    inherits the peer's history *up to the resow point* (later peer edges did
+    not contribute to the child's weights). Bounded by the edge count, so a
+    (journal-corruption) cycle cannot loop forever.
+    """
+    edges = read_lineage(path)
+
+    def _chain(key: str, before: float, hops: int) -> List[Dict[str, Any]]:
+        if hops > len(edges):
+            return []
+        own = [e for e in edges if e.get("trial") == key and e.get("time", 0.0) <= before]
+        resows = [e for e in own if e.get("parent") and e.get("parent") != key]
+        if not resows:
+            return own
+        last = resows[-1]
+        return _chain(last["parent"], last.get("time", before), hops + 1) + own
+
+    return _chain(trial, float("inf"), 0)

@@ -1,0 +1,108 @@
+"""Exploit/explore policy: pick a parent, perturb its hyperparameters.
+
+The transfer medium between population members is the certified checkpoint
+sidecar (``utils/checkpoint.py``): a peer is a legitimate parent only if its
+newest checkpoint was written while its own HealthSentinel reported healthy —
+resowing a diverged trial from an *uncertified* peer checkpoint risks copying
+the same poisoned state the resow exists to escape.
+
+Fitness is the certified ``policy_step`` recorded in the sidecar: among
+still-healthy peers, the one whose certified training state is furthest along.
+That is deliberately cheap (no eval rollouts) — the controller runs on the
+fleet's coordinator host and must never need an accelerator to make a
+scheduling decision.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.utils.checkpoint import certified_sidecar, certified_under
+
+
+def certified_fitness(trial_dir: str) -> Optional[Tuple[str, int]]:
+    """``(ckpt_path, policy_step)`` of the newest certified checkpoint anywhere
+    under ``trial_dir`` (a trial's incarnations each get their own run dir), or
+    None when the trial has produced no certified checkpoint yet. A sidecar
+    without ``policy_step`` (older writer) counts as step 0 — certified at all
+    still beats nothing."""
+    ckpt = certified_under(trial_dir)
+    if ckpt is None:
+        return None
+    step = 0
+    try:
+        with open(certified_sidecar(ckpt)) as f:
+            payload = json.load(f)
+        step = int(payload.get("policy_step") or 0)
+    except (OSError, ValueError, TypeError):
+        step = 0
+    return ckpt, step
+
+
+def select_parent(
+    trial_dirs: Dict[str, str],
+    exclude: Optional[List[str]] = None,
+) -> Optional[Tuple[str, str, int]]:
+    """Best resow parent among ``{trial_key: trial_dir}``.
+
+    Returns ``(parent_key, ckpt_path, policy_step)`` for the eligible peer with
+    the highest certified fitness (ties broken by key for determinism), or None
+    when no peer has certified anything yet — the caller then either waits
+    (``resow.parent_wait_s``) or falls back to a from-scratch requeue.
+    ``exclude`` lists keys that must not parent (the diverged trial itself, and
+    any peer currently diverged)."""
+    banned = set(exclude or ())
+    best: Optional[Tuple[str, str, int]] = None
+    for key in sorted(trial_dirs):
+        if key in banned:
+            continue
+        fit = certified_fitness(trial_dirs[key])
+        if fit is None:
+            continue
+        ckpt, step = fit
+        if best is None or step > best[2]:
+            best = (key, ckpt, step)
+    return best
+
+
+def perturb(
+    hyperparams: Dict[str, Any],
+    keys: List[str],
+    factors: List[float],
+    rng: Optional[random.Random] = None,
+) -> Dict[str, Any]:
+    """PBT-style explore step: multiply each listed numeric hyperparameter by a
+    factor chosen uniformly from ``factors`` (classic PBT uses {0.8, 1.2}).
+
+    Non-numeric or absent keys pass through untouched — perturbation must never
+    invent a hyperparameter the trial did not declare, or a resown run would
+    silently train under a config its lineage cannot explain."""
+    rng = rng or random
+    out = dict(hyperparams)
+    if not factors:
+        return out
+    for key in keys:
+        val = out.get(key)
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        out[key] = val * rng.choice(list(factors))
+    return out
+
+
+def bottom_quantile(
+    fitness_by_key: Dict[str, int],
+    quantile: float,
+) -> List[str]:
+    """Trial keys in the bottom ``quantile`` of the population by fitness —
+    candidates for the periodic exploit step (``orchestrate.exploit``). At
+    least one key is returned when the population is non-empty and the
+    quantile is positive; ties at the cut keep population order stable by
+    sorting (fitness, key)."""
+    if not fitness_by_key or quantile <= 0:
+        return []
+    ranked = sorted(fitness_by_key.items(), key=lambda kv: (kv[1], kv[0]))
+    n = max(int(len(ranked) * float(quantile)), 1)
+    return [k for k, _ in ranked[:n]]

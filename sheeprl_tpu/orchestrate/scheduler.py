@@ -1,0 +1,97 @@
+"""Slot scheduler: bounded-backoff requeue over a preemptible slot pool.
+
+Pure bookkeeping (no subprocess knowledge) so every policy decision — who gets
+the next free slot, when a preempted trial becomes eligible again, when a
+budget is exhausted — is unit-testable without spawning anything.
+
+Preemption is *routine* here: a preempted trial consumes one unit of its
+preemption budget and re-enters the queue after a jittered exponential backoff
+(:func:`sheeprl_tpu.core.resilience.jittered_backoff` — the same anti-herd
+policy the env-worker supervisor uses, because a fleet-wide preemption batch
+would otherwise slam every slot back at the same instant). Failures have their
+own smaller budget; past either budget the trial is FAILED, because a trial
+that keeps dying is a bug, not weather.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Any, List, Optional
+
+from sheeprl_tpu.core.resilience import jittered_backoff
+from sheeprl_tpu.orchestrate import trial as T
+from sheeprl_tpu.orchestrate.trial import Trial
+
+
+class SlotScheduler:
+    def __init__(
+        self,
+        slots: int,
+        max_preemptions: int = 8,
+        max_failures: int = 2,
+        backoff_base_s: float = 0.5,
+        backoff_max_s: float = 30.0,
+        rng: Optional[random.Random] = None,
+    ):
+        self.slots = max(int(slots), 1)
+        self.max_preemptions = int(max_preemptions)
+        self.max_failures = int(max_failures)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self._rng = rng or random.Random()
+
+    # -- slot accounting ------------------------------------------------------ #
+
+    def free_slots(self, trials: List[Trial]) -> int:
+        return self.slots - sum(1 for t in trials if t.state == T.RUNNING)
+
+    def next_to_run(self, trials: List[Trial], now: Optional[float] = None) -> List[Trial]:
+        """Queued trials eligible NOW, oldest-eligibility first, capped at the
+        free slot count. The caller spawns them and flips them to RUNNING."""
+        now = time.time() if now is None else now
+        free = self.free_slots(trials)
+        if free <= 0:
+            return []
+        eligible = [t for t in trials if t.queued and t.next_eligible <= now]
+        eligible.sort(key=lambda t: (t.next_eligible, t.key))
+        return eligible[:free]
+
+    # -- requeue policies ----------------------------------------------------- #
+
+    def requeue_preempted(self, trial: Trial, resume_ckpt: Optional[str], now: Optional[float] = None) -> str:
+        """PREEMPTED -> RESUMED (jittered backoff, budgeted) or FAILED.
+
+        Returns the resulting state. ``resume_ckpt`` None means no checkpoint
+        survived (preempted before the first save): the trial requeues from
+        scratch — the generation keeps its identity, nothing is lost but the
+        steps since the last save."""
+        now = time.time() if now is None else now
+        trial.preemptions += 1
+        if trial.preemptions > self.max_preemptions:
+            trial.to(T.FAILED, reason=f"preemption budget exhausted ({trial.preemptions - 1})")
+            return trial.state
+        delay = jittered_backoff(self.backoff_base_s, trial.preemptions, self.backoff_max_s, self._rng)
+        trial.resume_ckpt = resume_ckpt
+        trial.next_eligible = now + delay
+        trial.to(T.RESUMED, resume_ckpt=resume_ckpt, backoff_s=round(delay, 3))
+        return trial.state
+
+    def requeue_failed(self, trial: Trial, reason: str, now: Optional[float] = None) -> str:
+        """RUNNING -> FAILED (terminal) or back into the queue with backoff.
+
+        A non-zero exit is retried like a preemption (the slot may simply have
+        been bad — OOM neighbor, dirty /tmp) but against the smaller failure
+        budget."""
+        now = time.time() if now is None else now
+        trial.failures += 1
+        if trial.failures > self.max_failures:
+            trial.to(T.FAILED, reason=f"failure budget exhausted: {reason}")
+            return trial.state
+        delay = jittered_backoff(self.backoff_base_s, trial.failures, self.backoff_max_s, self._rng)
+        trial.next_eligible = now + delay
+        # a crashed incarnation resumes from its newest save when one exists;
+        # the caller passes that through trial.resume_ckpt before spawning
+        trial.to(T.PREEMPTED, reason=reason, exit_kind="failure")
+        trial.to(T.RESUMED, backoff_s=round(delay, 3))
+        return trial.state

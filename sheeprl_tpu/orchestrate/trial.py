@@ -1,0 +1,186 @@
+"""Trial state machine for the elastic population controller.
+
+A *trial* is one member of the population: a training run identity (overrides +
+hyperparameters + seed) that survives preemption and divergence by moving
+through *incarnations* (generations). The controller owns N of these and a pool
+of preemptible slots; this module owns only the bookkeeping — which transitions
+are legal, what history is recorded, and how a trial serializes into the
+crash-safe journal.
+
+State graph (ISSUE 6 / ROADMAP item 5)::
+
+    pending ──► running ──► completed            (terminal)
+                  │ ├─────► failed               (terminal)
+                  │ └─────► preempted ──► resumed ──► running ...
+                  └───────► diverged  ──► resown  ──► running ...
+                                 │             (new generation, peer ckpt)
+                                 └──────► failed
+
+``resumed`` and ``resown`` are *queued* states: the scheduler treats them like
+``pending`` (eligible for a slot once their backoff elapses), but they carry
+the resume checkpoint — the trial's own newest for ``resumed``, a healthy
+peer's newest **certified** checkpoint for ``resown``.
+
+Keep this module import-light (no jax): the journal loads it in the controller
+process and in tests without touching an accelerator.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+# -- states ------------------------------------------------------------------ #
+
+PENDING = "pending"
+RUNNING = "running"
+PREEMPTED = "preempted"
+DIVERGED = "diverged"
+RESUMED = "resumed"
+RESOWN = "resown"
+COMPLETED = "completed"
+FAILED = "failed"
+
+QUEUED_STATES = (PENDING, RESUMED, RESOWN)
+TERMINAL_STATES = (COMPLETED, FAILED)
+
+_TRANSITIONS: Dict[str, tuple] = {
+    PENDING: (RUNNING,),
+    RESUMED: (RUNNING,),
+    RESOWN: (RUNNING,),
+    RUNNING: (COMPLETED, PREEMPTED, DIVERGED, FAILED),
+    PREEMPTED: (RESUMED, FAILED),
+    DIVERGED: (RESOWN, FAILED),
+    COMPLETED: (),
+    FAILED: (),
+}
+
+
+class IllegalTransition(RuntimeError):
+    """A state change the trial graph does not allow — always a controller bug,
+    never weather; raised loudly instead of silently corrupting the journal."""
+
+
+class TrialSpec:
+    """Immutable identity of a population member.
+
+    ``overrides`` are the Hydra-style dotlist every incarnation runs with;
+    ``chaos_overrides`` ride along ONLY on generation 0 (they model transient
+    environmental faults injected by the chaos drills — a resown generation is
+    rescheduled 'weather-free', exactly like a trial migrated off a bad host).
+    ``hyperparams`` maps dotted config keys to values; the exploit/explore step
+    perturbs these, and on resume they are pushed through
+    ``checkpoint.resume_preserve`` so the sidecar merge cannot swallow them.
+    """
+
+    def __init__(
+        self,
+        key: str,
+        overrides: List[str],
+        hyperparams: Optional[Dict[str, Any]] = None,
+        chaos_overrides: Optional[List[str]] = None,
+    ):
+        self.key = str(key)
+        self.overrides = list(overrides)
+        self.hyperparams = dict(hyperparams or {})
+        self.chaos_overrides = list(chaos_overrides or [])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "key": self.key,
+            "overrides": self.overrides,
+            "hyperparams": self.hyperparams,
+            "chaos_overrides": self.chaos_overrides,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TrialSpec":
+        return cls(
+            key=d["key"],
+            overrides=d.get("overrides", []),
+            hyperparams=d.get("hyperparams"),
+            chaos_overrides=d.get("chaos_overrides"),
+        )
+
+
+class Trial:
+    """One population member's mutable runtime state.
+
+    Everything here must survive a controller kill: the journal serializes the
+    full object (``to_dict``/``from_dict``) on every transition, and the
+    restarted controller reconciles ``running`` trials against what it finds on
+    disk (markers, checkpoints, live pids).
+    """
+
+    def __init__(self, spec: TrialSpec):
+        self.spec = spec
+        self.state = PENDING
+        self.generation = 0
+        self.hyperparams = dict(spec.hyperparams)
+        self.preemptions = 0
+        self.failures = 0
+        self.resows = 0
+        self.resume_ckpt: Optional[str] = None
+        self.parent: Optional[str] = None  # trial key a resow seeded from
+        self.pid: Optional[int] = None
+        self.next_eligible: float = 0.0  # monotonic-free: wall clock is fine here
+        self.history: List[Dict[str, Any]] = []
+
+    @property
+    def key(self) -> str:
+        return self.spec.key
+
+    @property
+    def queued(self) -> bool:
+        return self.state in QUEUED_STATES
+
+    @property
+    def terminal(self) -> bool:
+        return self.state in TERMINAL_STATES
+
+    def to(self, state: str, **detail: Any) -> None:
+        """Transition with validation; every transition is a history row."""
+        allowed = _TRANSITIONS.get(self.state, ())
+        if state not in allowed:
+            raise IllegalTransition(
+                f"trial {self.key}: {self.state} -> {state} is not a legal transition "
+                f"(allowed: {list(allowed)})"
+            )
+        self.state = state
+        self.history.append(
+            {"state": state, "generation": self.generation, "time": time.time(), **detail}
+        )
+
+    # -- serialization -------------------------------------------------------- #
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "spec": self.spec.to_dict(),
+            "state": self.state,
+            "generation": self.generation,
+            "hyperparams": self.hyperparams,
+            "preemptions": self.preemptions,
+            "failures": self.failures,
+            "resows": self.resows,
+            "resume_ckpt": self.resume_ckpt,
+            "parent": self.parent,
+            "pid": self.pid,
+            "next_eligible": self.next_eligible,
+            "history": self.history,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Trial":
+        trial = cls(TrialSpec.from_dict(d["spec"]))
+        trial.state = d.get("state", PENDING)
+        trial.generation = int(d.get("generation", 0))
+        trial.hyperparams = dict(d.get("hyperparams", {}))
+        trial.preemptions = int(d.get("preemptions", 0))
+        trial.failures = int(d.get("failures", 0))
+        trial.resows = int(d.get("resows", 0))
+        trial.resume_ckpt = d.get("resume_ckpt")
+        trial.parent = d.get("parent")
+        trial.pid = d.get("pid")
+        trial.next_eligible = float(d.get("next_eligible", 0.0))
+        trial.history = list(d.get("history", []))
+        return trial

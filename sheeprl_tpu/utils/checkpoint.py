@@ -252,10 +252,42 @@ def certify(path: str, crc32: Optional[int] = None, size: Optional[int] = None, 
     return sidecar
 
 
+def read_footer_crc(path: str) -> Optional[int]:
+    """The CRC recorded in a v1 checkpoint's footer pickle, from an O(1) tail
+    read — no unpickling of the (potentially multi-GB) state.
+
+    ``save_state`` writes the footer ``{"crc32": ...}`` as the file's LAST
+    pickle, so its PROTO opcode (``\\x80``) sits within the final few dozen
+    bytes; scan candidate offsets from the right and take the first suffix
+    that parses into a dict carrying ``crc32`` (the Unpickler stops at its own
+    STOP opcode, and the true footer ends the file, so the match is exact).
+    Returns None for legacy bare-pickle checkpoints or unreadable files.
+    """
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(max(size - 128, 0))
+            tail = f.read()
+    except OSError:
+        return None
+    for i in range(len(tail) - 2, -1, -1):
+        if tail[i] != 0x80:  # PROTO opcode starts every HIGHEST_PROTOCOL pickle
+            continue
+        try:
+            obj = pickle.loads(tail[i:])
+        except Exception:
+            continue
+        if isinstance(obj, dict) and "crc32" in obj:
+            return obj.get("crc32")
+    return None
+
+
 def is_certified(path: str) -> bool:
     """True when ``path`` has a parseable ``last_good`` sidecar whose recorded
-    size matches the file on disk (a size mismatch means the checkpoint was
-    overwritten after certification — the sidecar no longer vouches for it)."""
+    size matches the file on disk AND whose recorded CRC matches the
+    checkpoint's own footer CRC. A mismatch on either means the checkpoint was
+    overwritten after certification (a same-size overwrite fools the size
+    check alone) — the sidecar no longer vouches for the bytes on disk."""
     import json
 
     sidecar = certified_sidecar(path)
@@ -273,29 +305,69 @@ def is_certified(path: str) -> bool:
                 return False
         except OSError:
             return False
+    crc = payload.get("crc32")
+    if crc is not None:
+        footer_crc = read_footer_crc(path)
+        if footer_crc is not None and footer_crc != crc:
+            return False
     return os.path.exists(path)
 
 
+def ckpt_sort_key(path: str) -> Tuple[float, int, str]:
+    """Total order for sibling checkpoints: (mtime, step-parsed-from-name,
+    basename). Filesystems with coarse mtime granularity (or a burst of
+    checkpoints in one second) produce mtime TIES; the numeric step embedded in
+    ``ckpt_<step>_<rank>.ckpt`` breaks them toward the later training state,
+    and the basename makes the order deterministic even for foreign names."""
+    import re
+
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    name = os.path.basename(path)
+    ints = re.findall(r"\d+", name)
+    step = int(ints[0]) if ints else -1
+    return (mtime, step, name)
+
+
 def latest_certified(ckpt_dir: str) -> Optional[str]:
-    """Newest certified ``*.ckpt`` in ``ckpt_dir`` by mtime, or None."""
+    """Newest certified ``*.ckpt`` in ``ckpt_dir``, or None. "Newest" is by
+    :func:`ckpt_sort_key` — mtime first, policy-step-in-name as the
+    deterministic tie-break."""
     try:
         names = os.listdir(ckpt_dir)
     except OSError:
         return None
-    best: Optional[Tuple[float, str]] = None
-    for name in names:
-        if not name.endswith(".ckpt"):
-            continue
-        cand = os.path.join(ckpt_dir, name)
-        if not is_certified(cand):
-            continue
-        try:
-            mtime = os.path.getmtime(cand)
-        except OSError:
-            continue
-        if best is None or mtime > best[0]:
-            best = (mtime, cand)
-    return best[1] if best else None
+    certified = [
+        os.path.join(ckpt_dir, n)
+        for n in names
+        if n.endswith(".ckpt") and is_certified(os.path.join(ckpt_dir, n))
+    ]
+    if not certified:
+        return None
+    return max(certified, key=ckpt_sort_key)
+
+
+def certified_under(root: str) -> Optional[str]:
+    """Newest certified checkpoint anywhere under ``root`` (recursive).
+
+    The population controller keeps each trial's incarnations in their own
+    timestamped run dirs under one trial dir; the exploit/explore transfer
+    medium is the newest certified checkpoint across ALL of them."""
+    best: Optional[str] = None
+    best_key: Optional[Tuple[float, int, str]] = None
+    for base, _, files in os.walk(root):
+        for name in files:
+            if not name.endswith(".ckpt"):
+                continue
+            cand = os.path.join(base, name)
+            if not is_certified(cand):
+                continue
+            key = ckpt_sort_key(cand)
+            if best_key is None or key > best_key:
+                best, best_key = cand, key
+    return best
 
 
 class CheckpointCorruptionError(RuntimeError):

@@ -10,7 +10,12 @@ import pytest
 from sheeprl_tpu.utils.checkpoint import (
     CheckpointCallback,
     CheckpointCorruptionError,
+    certified_under,
+    certify,
+    is_certified,
+    latest_certified,
     load_state,
+    read_footer_crc,
     save_state,
 )
 
@@ -100,3 +105,89 @@ def test_gc_disabled_keeps_everything(tmp_path):
     assert len(list(tmp_path.glob("*.ckpt"))) == 3
     # a vanished directory is a no-op, not a crash
     CheckpointCallback(keep_last=2)._gc(str(tmp_path / "missing"))
+
+
+# --------------------------------------------------------------------------- #
+# latest_certified edge cases (population-controller transfer medium)
+# --------------------------------------------------------------------------- #
+
+
+def _write_certified(path, iter_num, mtime):
+    """A real checkpoint with a truthful last_good sidecar, pinned mtime."""
+    facts = save_state(str(path), {"iter_num": iter_num, "agent": np.full((3,), iter_num, np.float32)})
+    certify(str(path), crc32=facts["crc32"], size=facts["size"], policy_step=iter_num)
+    os.utime(path, (mtime, mtime))
+    return facts
+
+
+def test_latest_certified_skips_sidecar_whose_checkpoint_was_deleted(tmp_path):
+    _write_certified(tmp_path / "ckpt_16_0.ckpt", 16, 1000)
+    newest = tmp_path / "ckpt_32_0.ckpt"
+    _write_certified(newest, 32, 2000)
+    os.remove(newest)  # sidecar survives, checkpoint is gone (e.g. manual cleanup)
+    assert os.path.exists(str(newest) + ".certified.json")
+    assert latest_certified(str(tmp_path)) == str(tmp_path / "ckpt_16_0.ckpt")
+    # no certified checkpoint at all -> None, not a crash
+    os.remove(tmp_path / "ckpt_16_0.ckpt")
+    assert latest_certified(str(tmp_path)) is None
+    assert latest_certified(str(tmp_path / "missing_dir")) is None
+
+
+def test_latest_certified_skips_crc_mismatch_to_next_newest_sibling(tmp_path):
+    """A same-size overwrite AFTER certification fools the size check alone;
+    the sidecar-vs-footer CRC comparison must catch it and fall back to the
+    next-newest certified sibling."""
+    older = tmp_path / "ckpt_16_0.ckpt"
+    _write_certified(older, 16, 1000)
+    newest = tmp_path / "ckpt_32_0.ckpt"
+    facts = _write_certified(newest, 32, 2000)
+    # overwrite with different state of the SAME shapes -> same byte size,
+    # different footer CRC; keep the sidecar and mtime as certification left them
+    save_state(str(newest), {"iter_num": 99, "agent": np.full((3,), 99, np.float32)})
+    os.utime(newest, (2000, 2000))
+    assert os.path.getsize(newest) == facts["size"]
+    assert read_footer_crc(str(newest)) != facts["crc32"]
+    assert not is_certified(str(newest))
+    assert latest_certified(str(tmp_path)) == str(older)
+
+
+def test_latest_certified_breaks_mtime_ties_by_step_in_name(tmp_path):
+    """Coarse-mtime filesystems (or a checkpoint burst within one second)
+    produce ties; the numeric step embedded in the filename must break them
+    toward the later training state, deterministically."""
+    a = tmp_path / "ckpt_16_0.ckpt"
+    b = tmp_path / "ckpt_32_0.ckpt"
+    _write_certified(b, 32, 5000)  # written FIRST but carries the later step
+    _write_certified(a, 16, 5000)
+    assert os.path.getmtime(a) == os.path.getmtime(b)
+    assert latest_certified(str(tmp_path)) == str(b)
+
+
+def test_read_footer_crc_matches_save_state_and_rejects_legacy(tmp_path):
+    import pickle
+
+    path = tmp_path / "ckpt_8_0.ckpt"
+    facts = save_state(str(path), {"iter_num": 8, "agent": np.zeros((4,), np.float32)})
+    assert read_footer_crc(str(path)) == facts["crc32"]
+    legacy = tmp_path / "legacy.ckpt"
+    with open(legacy, "wb") as f:
+        pickle.dump({"iter_num": 1}, f, protocol=pickle.HIGHEST_PROTOCOL)
+    assert read_footer_crc(str(legacy)) is None  # bare pickle: no footer
+    assert read_footer_crc(str(tmp_path / "missing.ckpt")) is None
+
+
+def test_certified_under_walks_incarnation_subdirs(tmp_path):
+    """The population controller keeps each trial incarnation in its own run
+    dir; the exploit/explore transfer medium is the newest certified checkpoint
+    across ALL of them."""
+    gen0 = tmp_path / "inc0000" / "checkpoints"
+    gen1 = tmp_path / "inc0003" / "checkpoints"
+    gen0.mkdir(parents=True)
+    gen1.mkdir(parents=True)
+    _write_certified(gen0 / "ckpt_16_0.ckpt", 16, 1000)
+    _write_certified(gen1 / "ckpt_48_0.ckpt", 48, 3000)
+    uncert = gen1 / "ckpt_64_0.ckpt"
+    save_state(str(uncert), {"iter_num": 64})  # newer but NEVER certified
+    os.utime(uncert, (4000, 4000))
+    assert certified_under(str(tmp_path)) == str(gen1 / "ckpt_48_0.ckpt")
+    assert certified_under(str(tmp_path / "void")) is None

@@ -1,0 +1,579 @@
+"""Unit layer of the elastic population controller (sheeprl_tpu/orchestrate/):
+trial state machine, crash-safe journal, slot scheduler, exploit/explore resow
+policy, lineage reconstruction, health-event tailing, and the full controller
+loop driven against a stub trainee (no jax import) — including killing the
+controller mid-drill and resuming from the journal."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from sheeprl_tpu.core.health import read_events
+from sheeprl_tpu.orchestrate import resolve
+from sheeprl_tpu.orchestrate import trial as T
+from sheeprl_tpu.orchestrate.controller import ENTRY_ENV_VAR, PopulationController
+from sheeprl_tpu.orchestrate.journal import Journal
+from sheeprl_tpu.orchestrate.lineage import LineageLog, ancestry, read_lineage
+from sheeprl_tpu.orchestrate.resow import bottom_quantile, perturb, select_parent
+from sheeprl_tpu.orchestrate.scheduler import SlotScheduler
+from sheeprl_tpu.orchestrate.trial import IllegalTransition, Trial, TrialSpec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------------------- #
+# Trial state machine
+# --------------------------------------------------------------------------- #
+
+
+def _trial(key="t0", **kw):
+    return Trial(TrialSpec(key=key, overrides=["exp=ppo"], **kw))
+
+
+def test_trial_legal_lifecycle_and_history():
+    t = _trial()
+    t.to(T.RUNNING)
+    t.to(T.PREEMPTED)
+    t.to(T.RESUMED)
+    t.to(T.RUNNING)
+    t.to(T.DIVERGED)
+    t.generation += 1
+    t.to(T.RESOWN)
+    t.to(T.RUNNING)
+    t.to(T.COMPLETED)
+    assert t.terminal
+    assert [h["state"] for h in t.history] == [
+        T.RUNNING, T.PREEMPTED, T.RESUMED, T.RUNNING,
+        T.DIVERGED, T.RESOWN, T.RUNNING, T.COMPLETED,
+    ]
+
+
+def test_trial_illegal_transitions_raise():
+    t = _trial()
+    with pytest.raises(IllegalTransition, match="pending -> completed"):
+        t.to(T.COMPLETED)
+    t.to(T.RUNNING)
+    t.to(T.COMPLETED)
+    with pytest.raises(IllegalTransition):  # terminal states are sinks
+        t.to(T.RUNNING)
+
+
+def test_trial_serialization_roundtrip():
+    t = _trial(hyperparams={"algo.optimizer.lr": 1e-3}, chaos_overrides=["env.wrapper.x=1"])
+    t.to(T.RUNNING, pid=123)
+    t.to(T.PREEMPTED)
+    t.resume_ckpt = "/tmp/ckpt_16_0.ckpt"
+    back = Trial.from_dict(json.loads(json.dumps(t.to_dict())))
+    assert back.key == t.key and back.state == T.PREEMPTED
+    assert back.spec.chaos_overrides == ["env.wrapper.x=1"]
+    assert back.hyperparams == {"algo.optimizer.lr": 1e-3}
+    assert back.resume_ckpt == t.resume_ckpt
+    assert back.history == t.history
+
+
+# --------------------------------------------------------------------------- #
+# Journal
+# --------------------------------------------------------------------------- #
+
+
+def test_journal_roundtrip_and_atomic_replace(tmp_path):
+    journal = Journal(str(tmp_path / "journal.json"))
+    assert journal.load() is None and journal.load_trials() == []
+    trials = [_trial("t0"), _trial("t1")]
+    trials[0].to(T.RUNNING)
+    journal.save(trials, {"spawn_seq": 2})
+    loaded = journal.load_trials()
+    assert [t.key for t in loaded] == ["t0", "t1"]
+    assert loaded[0].state == T.RUNNING
+    assert journal.load()["counters"]["spawn_seq"] == 2
+    # a second save fully replaces the snapshot and leaves no temp debris
+    journal.save(trials[:1], {})
+    assert len(journal.load_trials()) == 1
+    assert not os.path.exists(journal.path + ".tmp")
+
+
+# --------------------------------------------------------------------------- #
+# SlotScheduler
+# --------------------------------------------------------------------------- #
+
+
+def test_scheduler_respects_slots_and_eligibility():
+    sched = SlotScheduler(slots=2)
+    trials = [_trial(f"t{i}") for i in range(4)]
+    picked = sched.next_to_run(trials, now=100.0)
+    assert [t.key for t in picked] == ["t0", "t1"]  # capped at free slots
+    trials[0].to(T.RUNNING)
+    picked = sched.next_to_run(trials, now=100.0)
+    assert [t.key for t in picked] == ["t1"]  # one slot taken
+    trials[1].next_eligible = 200.0  # backing off: not eligible yet
+    assert [t.key for t in sched.next_to_run(trials, now=100.0)] == ["t2"]
+
+
+def test_scheduler_preemption_requeues_with_jittered_backoff():
+    import random
+
+    sched = SlotScheduler(slots=1, max_preemptions=2, rng=random.Random(0))
+    t = _trial()
+    t.to(T.RUNNING)
+    t.to(T.PREEMPTED)
+    assert sched.requeue_preempted(t, "/ck/pt.ckpt", now=50.0) == T.RESUMED
+    assert t.resume_ckpt == "/ck/pt.ckpt"
+    delay = t.next_eligible - 50.0
+    # jittered envelope of attempt 1: uniform(0.5, 1.0) * base(0.5)
+    assert 0.25 <= delay <= 0.5
+    # attempt 2 doubles the nominal backoff: uniform(0.5, 1.0) * 1.0
+    t.to(T.RUNNING)
+    t.to(T.PREEMPTED)
+    assert sched.requeue_preempted(t, "/ck/pt.ckpt", now=60.0) == T.RESUMED
+    assert 0.5 <= t.next_eligible - 60.0 <= 1.0
+    # past the budget the trial is terminal
+    t.to(T.RUNNING)
+    t.to(T.PREEMPTED)
+    assert t.preemptions == 2
+    assert sched.requeue_preempted(t, None, now=70.0) == T.FAILED
+
+
+def test_scheduler_failure_budget():
+    sched = SlotScheduler(slots=1, max_failures=1, backoff_base_s=0.0)
+    t = _trial()
+    t.to(T.RUNNING)
+    assert sched.requeue_failed(t, "rc=1", now=10.0) == T.RESUMED
+    t.to(T.RUNNING)
+    assert sched.requeue_failed(t, "rc=1", now=11.0) == T.FAILED
+
+
+# --------------------------------------------------------------------------- #
+# Resow policy
+# --------------------------------------------------------------------------- #
+
+
+def _fake_certified(dirpath, name, step):
+    os.makedirs(dirpath, exist_ok=True)
+    ckpt = os.path.join(dirpath, name)
+    with open(ckpt, "wb") as f:
+        f.write(b"weights")
+    with open(ckpt + ".certified.json", "w") as f:
+        json.dump({"certified": True, "ckpt": name, "crc32": None, "size": 7, "policy_step": step}, f)
+    return ckpt
+
+
+def test_select_parent_prefers_highest_certified_step(tmp_path):
+    dirs = {k: str(tmp_path / k) for k in ("a", "b", "c")}
+    _fake_certified(dirs["a"], "ckpt_16_0.ckpt", 16)
+    _fake_certified(dirs["b"], "ckpt_48_0.ckpt", 48)
+    os.makedirs(dirs["c"], exist_ok=True)  # never certified anything
+    key, ckpt, step = select_parent(dirs)
+    assert key == "b" and step == 48 and ckpt.endswith("ckpt_48_0.ckpt")
+    # excluding the leader falls through to the runner-up; excluding all -> None
+    assert select_parent(dirs, exclude=["b"])[0] == "a"
+    assert select_parent(dirs, exclude=["a", "b"]) is None
+
+
+def test_select_parent_ignores_uncertified_checkpoints(tmp_path):
+    dirs = {"a": str(tmp_path / "a"), "b": str(tmp_path / "b")}
+    os.makedirs(dirs["a"], exist_ok=True)
+    with open(os.path.join(dirs["a"], "ckpt_99_0.ckpt"), "wb") as f:
+        f.write(b"poisoned")  # newest but uncertified: never a parent
+    _fake_certified(dirs["b"], "ckpt_8_0.ckpt", 8)
+    assert select_parent(dirs)[0] == "b"
+
+
+def test_perturb_only_touches_declared_numeric_keys():
+    import random
+
+    out = perturb(
+        {"algo.optimizer.lr": 1e-3, "algo.ent_coef": "auto", "algo.clip": True},
+        keys=["algo.optimizer.lr", "algo.ent_coef", "algo.clip", "algo.missing"],
+        factors=[2.0],
+        rng=random.Random(1),
+    )
+    assert out["algo.optimizer.lr"] == pytest.approx(2e-3)
+    assert out["algo.ent_coef"] == "auto"  # non-numeric untouched
+    assert out["algo.clip"] is True  # bools are not numbers here
+    assert "algo.missing" not in out  # never invents a hyperparameter
+
+
+def test_bottom_quantile_returns_at_least_one():
+    fits = {"a": 10, "b": 2, "c": 5, "d": 7}
+    assert bottom_quantile(fits, 0.25) == ["b"]
+    assert bottom_quantile(fits, 0.5) == ["b", "c"]
+    assert bottom_quantile({}, 0.5) == []
+    assert bottom_quantile(fits, 0.0) == []
+
+
+# --------------------------------------------------------------------------- #
+# Lineage
+# --------------------------------------------------------------------------- #
+
+
+def test_lineage_ancestry_walks_resow_edges(tmp_path):
+    log = LineageLog(str(tmp_path / "lineage.jsonl"))
+    log.record("seed", "a", 0)
+    log.record("seed", "b", 0)
+    log.record("resume", "a", 0)
+    log.record("resow", "b", 1, parent="a", ckpt="/x/ckpt_32_0.ckpt", hyperparams={"lr": 2e-3})
+    log.record("resume", "a", 0)  # after the resow: not part of b's ancestry
+    chain = ancestry(str(tmp_path / "lineage.jsonl"), "b")
+    kinds = [(e["kind"], e["trial"]) for e in chain]
+    assert kinds == [("seed", "a"), ("resume", "a"), ("seed", "b"), ("resow", "b")]
+    assert read_lineage(str(tmp_path / "missing.jsonl")) == []
+
+
+# --------------------------------------------------------------------------- #
+# Health event tailing (core/health.read_events)
+# --------------------------------------------------------------------------- #
+
+
+def test_read_events_incremental_offsets_and_torn_tail(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text(json.dumps({"event": "warn"}) + "\n")
+    events, off = read_events(str(path), 0)
+    assert [e["event"] for e in events] == ["warn"]
+    # nothing new: same offset, no re-parse
+    events, off2 = read_events(str(path), off)
+    assert events == [] and off2 == off
+    # a torn final line (writer mid-append) is left for the next call
+    with open(path, "a") as f:
+        f.write(json.dumps({"event": "backoff"}) + "\n")
+        f.write('{"event": "roll')
+    events, off3 = read_events(str(path), off)
+    assert [e["event"] for e in events] == ["backoff"]
+    with open(path, "a") as f:
+        f.write('back"}\n')
+    events, _ = read_events(str(path), off3)
+    assert [e["event"] for e in events] == ["rollback"]
+
+
+def test_read_events_accepts_directory_and_missing_file(tmp_path):
+    (tmp_path / "events.jsonl").write_text('{"event": "warn"}\n')
+    events, _ = read_events(str(tmp_path), 0)  # health/ dir, not the file
+    assert len(events) == 1
+    assert read_events(str(tmp_path / "nope" / "events.jsonl"), 0) == ([], 0)
+
+
+# --------------------------------------------------------------------------- #
+# Controller end-to-end against a stub trainee (no jax)
+# --------------------------------------------------------------------------- #
+
+# Emulates exactly the contract the controller relies on: touches the guard
+# ready file, writes (and certifies) checkpoints, appends health events, turns
+# SIGTERM into flag-file + final checkpoint + exit 0, resumes from
+# checkpoint.resume_from, and diverges on demand via a stub.diverge_at override.
+_STUB_TRAINEE = textwrap.dedent(
+    """
+    import json, os, signal, sys, time
+
+    cfg = {}
+    for arg in sys.argv[1:]:
+        k, _, v = arg.partition("=")
+        cfg[k] = v
+
+    run_name = cfg["run_name"]
+    run_dir = os.path.join(os.getcwd(), "logs", run_name)
+    ckpt_dir = os.path.join(run_dir, "checkpoints")
+    health_dir = os.path.join(run_dir, "health")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    os.makedirs(health_dir, exist_ok=True)
+
+    start = 0
+    resume = cfg.get("checkpoint.resume_from")
+    if resume:
+        with open(resume) as f:
+            start = json.load(f)["iter"]
+
+    stopping = {"flag": False}
+
+    def _on_term(signum, frame):
+        stopping["flag"] = True
+        flag = os.environ.get("SHEEPRL_PREEMPTION_FLAG_FILE")
+        if flag:
+            with open(flag, "w") as f:
+                f.write(str(signum))
+
+    signal.signal(signal.SIGTERM, _on_term)
+
+    ready = os.environ.get("SHEEPRL_PREEMPTION_READY_FILE")
+    if ready:
+        with open(ready, "w") as f:
+            f.write(str(os.getpid()))
+
+    total = int(cfg.get("stub.total_iters", "40"))
+    diverge_at = int(cfg.get("stub.diverge_at", "-1"))
+    tick = float(cfg.get("stub.tick_s", "0.05"))
+
+    def save(i, certified):
+        path = os.path.join(ckpt_dir, "ckpt_%d_0.ckpt" % i)
+        with open(path, "w") as f:
+            json.dump({"iter": i, "lr": cfg.get("algo.optimizer.lr")}, f)
+        if certified:
+            with open(path + ".certified.json", "w") as f:
+                json.dump({"certified": True, "ckpt": os.path.basename(path),
+                           "crc32": None, "size": os.path.getsize(path),
+                           "policy_step": i}, f)
+
+    for i in range(start, total):
+        time.sleep(tick)
+        if stopping["flag"]:
+            save(i, certified=False)  # emergency checkpoint: never certified
+            sys.exit(0)
+        if i and i % 5 == 0:
+            save(i, certified=True)
+        if diverge_at >= 0 and i >= diverge_at and not resume:
+            with open(os.path.join(health_dir, "events.jsonl"), "a") as f:
+                f.write(json.dumps({"event": "warn", "reason": "divergence: Loss/value_loss", "step": i}) + "\\n")
+                f.flush()
+            # a diverged run would thrash on forever; the controller must kill us
+            # (PEP 475: one long sleep would NOT be interrupted by the handled
+            # signal, so poll the stop flag instead)
+            for _ in range(1200):
+                if stopping["flag"]:
+                    save(i, certified=False)
+                    sys.exit(0)
+                time.sleep(0.05)
+            sys.exit(7)
+    save(total, certified=True)
+    sys.exit(0)
+    """
+)
+
+
+@pytest.fixture()
+def stub_entry(tmp_path, monkeypatch):
+    entry = tmp_path / "stub_trainee.py"
+    entry.write_text(_STUB_TRAINEE)
+    monkeypatch.setenv(ENTRY_ENV_VAR, str(entry))
+    return entry
+
+
+def _specs(n_clean=2, chaos=True, total=20, tick=0.02):
+    specs = [
+        TrialSpec(
+            key=f"t{i}",
+            overrides=[f"stub.total_iters={total}", f"stub.tick_s={tick}"],
+            hyperparams={"algo.optimizer.lr": 1e-3},
+        )
+        for i in range(n_clean)
+    ]
+    if chaos:
+        specs.append(
+            TrialSpec(
+                key="t_chaos",
+                overrides=[f"stub.total_iters={total}", f"stub.tick_s={tick}"],
+                hyperparams={"algo.optimizer.lr": 1e-3},
+                chaos_overrides=["stub.diverge_at=8"],
+            )
+        )
+    return specs
+
+
+_POLICY = {
+    "orchestrate": {
+        "slots": 2,
+        "poll_interval_s": 0.05,
+        "trial": {"requeue_backoff_base_s": 0.05, "requeue_backoff_max_s": 0.2},
+        "resow": {"parent_wait_s": 20.0, "perturb": {"keys": ["algo.optimizer.lr"], "factors": [0.8, 1.25]}},
+        "shutdown": {"drain_timeout_s": 20.0},
+    }
+}
+
+
+@pytest.mark.timeout(120)
+def test_controller_completes_clean_population(stub_entry, tmp_path):
+    ctrl = PopulationController(_specs(n_clean=2, chaos=False), str(tmp_path / "state"), cfg=_POLICY)
+    assert ctrl.run(max_runtime_s=60.0) == "done"
+    assert all(t.state == T.COMPLETED for t in ctrl.trials)
+    edges = read_lineage(str(tmp_path / "state" / "lineage.jsonl"))
+    assert [e["kind"] for e in edges] == ["seed", "seed"]
+
+
+@pytest.mark.timeout(120)
+def test_controller_resows_diverged_trial_from_certified_peer(stub_entry, tmp_path):
+    ctrl = PopulationController(_specs(n_clean=1, chaos=True), str(tmp_path / "state"), cfg=_POLICY)
+    assert ctrl.run(max_runtime_s=90.0) == "done"
+    chaos = next(t for t in ctrl.trials if t.key == "t_chaos")
+    assert chaos.state == T.COMPLETED
+    assert chaos.generation >= 1 and chaos.parent == "t0"
+    edges = read_lineage(str(tmp_path / "state" / "lineage.jsonl"))
+    resows = [e for e in edges if e["kind"] == "resow"]
+    assert len(resows) >= 1
+    # resown from the PEER's certified checkpoint, not from scratch
+    assert resows[0]["parent"] == "t0" and "/t0/" in resows[0]["ckpt"]
+    assert os.path.exists(resows[0]["ckpt"] + ".certified.json")
+    # the explore step actually perturbed the declared hyperparameter
+    lr = resows[0]["hyperparams"]["algo.optimizer.lr"]
+    assert lr in (pytest.approx(0.8e-3), pytest.approx(1.25e-3))
+    # ancestry of the resown trial reaches back through the parent's seed edge
+    kinds = [(e["kind"], e["trial"]) for e in ancestry(str(tmp_path / "state" / "lineage.jsonl"), "t_chaos")]
+    assert ("seed", "t0") in kinds and ("resow", "t_chaos") in kinds
+
+
+@pytest.mark.timeout(120)
+def test_controller_injected_preemptions_resume_from_own_checkpoint(stub_entry, tmp_path):
+    ctrl = PopulationController(
+        _specs(n_clean=2, chaos=False, total=60, tick=0.05),
+        str(tmp_path / "state"),
+        cfg=_POLICY,
+        inject_preempt=2,
+        inject_spacing_s=0.3,
+    )
+    assert ctrl.run(max_runtime_s=90.0) == "done"
+    assert ctrl.counters["injections"] == 2
+    assert all(t.state == T.COMPLETED for t in ctrl.trials)
+    preempted = [t for t in ctrl.trials if t.preemptions]
+    assert sum(t.preemptions for t in ctrl.trials) == 2
+    # every preempted trial resumed from a checkpoint (resume lineage edge with ckpt)
+    edges = read_lineage(str(tmp_path / "state" / "lineage.jsonl"))
+    resumes = [e for e in edges if e["kind"] == "resume"]
+    assert len(resumes) == 2
+    assert all(e["ckpt"] and e["ckpt"].endswith(".ckpt") for e in resumes)
+    assert ctrl.counters["preempt_recoveries"], "recovery latency not recorded"
+
+
+def _run_controller_subprocess(spec_path, state_dir, entry, extra=()):
+    env = dict(os.environ, **{ENTRY_ENV_VAR: str(entry)})
+    return subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu.orchestrate.controller",
+            "--spec",
+            str(spec_path),
+            "--state-dir",
+            str(state_dir),
+            *extra,
+        ],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+@pytest.mark.timeout(180)
+def test_controller_killed_mid_drill_resumes_from_journal(stub_entry, tmp_path):
+    """Acceptance criterion: SIGTERM the controller mid-drill, restart it with
+    the same --state-dir, and the fleet resumes with no duplicated or lost
+    trials (journal reconciliation + preemption-guard fan-out)."""
+    spec_path = tmp_path / "population.json"
+    spec_path.write_text(
+        json.dumps(
+            {
+                **_POLICY,
+                "trials": [s.to_dict() for s in _specs(n_clean=2, chaos=False, total=80, tick=0.05)],
+            }
+        )
+    )
+    state_dir = tmp_path / "state"
+    proc = _run_controller_subprocess(spec_path, state_dir, stub_entry)
+    journal = state_dir / "journal.json"
+    deadline = time.time() + 60.0
+    running = []
+    while time.time() < deadline:
+        if journal.exists():
+            snap = json.loads(journal.read_text())
+            running = [t for t in snap.get("trials", []) if t["state"] == "running"]
+            if len(running) == 2:
+                break
+        time.sleep(0.1)
+    assert len(running) == 2, "fleet never reached 2 running trials"
+    time.sleep(1.0)  # let the stubs write their first certified checkpoints
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=60) == 0  # "preempted" is a clean controller exit
+    out1 = proc.stdout.read()
+    assert '"status": "preempted"' in out1
+
+    # journal after the kill: both trials requeued, neither lost nor duplicated
+    snap = json.loads(journal.read_text())
+    assert sorted(t["spec"]["key"] for t in snap["trials"]) == ["t0", "t1"]
+    assert all(t["state"] in ("resumed", "preempted") for t in snap["trials"])
+
+    proc = _run_controller_subprocess(spec_path, state_dir, stub_entry)
+    rc = proc.wait(timeout=120)
+    out2 = proc.stdout.read()
+    assert rc == 0, out2[-2000:]
+    summary = json.loads(out2.splitlines()[-1].split("ORCHESTRATE_RESULT ", 1)[1])
+    assert summary["status"] == "done"
+    assert sorted(summary["trials"]) == ["t0", "t1"]
+    assert all(v["state"] == "completed" for v in summary["trials"].values())
+    assert summary["counters"]["controller_incarnations"] == 2
+    # exactly one seed edge per trial across BOTH controller incarnations: the
+    # restart resumed the journaled trials instead of re-seeding them
+    edges = read_lineage(str(state_dir / "lineage.jsonl"))
+    assert sum(1 for e in edges if e["kind"] == "seed") == 2
+    resumed = [e for e in edges if e["kind"] == "resume"]
+    assert len(resumed) >= 2  # both trials came back after the controller kill
+    # the resumed incarnations picked up each trial's own newest checkpoint
+    assert all(e["ckpt"] for e in resumed)
+    # no orphaned trial subprocesses: every journaled pid is dead
+    snap = json.loads(journal.read_text())
+    for t in snap["trials"]:
+        if t.get("pid"):
+            with pytest.raises(OSError):
+                os.kill(int(t["pid"]), 0)
+
+
+@pytest.mark.timeout(120)
+def test_controller_reconciles_orphans_after_hard_kill(stub_entry, tmp_path):
+    """SIGKILL (no drain, no journal update) leaves RUNNING entries whose
+    processes may still be alive: the restarted controller must terminate the
+    orphans and requeue their trials rather than double-spawning them."""
+    spec_path = tmp_path / "population.json"
+    spec_path.write_text(
+        json.dumps(
+            {
+                **_POLICY,
+                "trials": [s.to_dict() for s in _specs(n_clean=1, chaos=False, total=300, tick=0.05)],
+            }
+        )
+    )
+    state_dir = tmp_path / "state"
+    proc = _run_controller_subprocess(spec_path, state_dir, stub_entry)
+    journal = state_dir / "journal.json"
+    orphan_pid = None
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        if journal.exists():
+            snap = json.loads(journal.read_text())
+            pids = [t.get("pid") for t in snap.get("trials", []) if t["state"] == "running"]
+            if pids and pids[0]:
+                orphan_pid = pids[0]
+                break
+        time.sleep(0.1)
+    assert orphan_pid, "trial never started"
+    proc.kill()  # controller dies WITHOUT forwarding anything
+    proc.wait(timeout=30)
+    os.kill(orphan_pid, 0)  # trainee survived its controller: it is an orphan
+
+    proc = _run_controller_subprocess(spec_path, state_dir, stub_entry)
+    rc = proc.wait(timeout=90)
+    out = proc.stdout.read()
+    assert rc == 0, out[-2000:]
+    assert '"status": "done"' in out
+    assert "reconcile: orphan pid" in out
+    with pytest.raises(OSError):  # orphan was terminated, not leaked
+        os.kill(orphan_pid, 0)
+    edges = read_lineage(str(state_dir / "lineage.jsonl"))
+    assert sum(1 for e in edges if e["kind"] == "seed") == 1  # not re-seeded
+
+
+# --------------------------------------------------------------------------- #
+# resolve()
+# --------------------------------------------------------------------------- #
+
+
+def test_resolve_fills_defaults_and_accepts_bare_group():
+    cfg = resolve(None)
+    assert cfg.slots == 2 and cfg.resow.enabled is True
+    cfg = resolve({"orchestrate": {"slots": 5, "resow": {"max_per_trial": 1}}})
+    assert cfg.slots == 5
+    assert cfg.resow.max_per_trial == 1
+    assert cfg.resow.enabled is True  # untouched keys keep defaults
+    cfg = resolve({"slots": 3})  # bare group dict (population spec style)
+    assert cfg.slots == 3

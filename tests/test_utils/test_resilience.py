@@ -128,6 +128,108 @@ def test_preemption_guard_touches_ready_file(tmp_path, monkeypatch):
         assert ready.read_text() == str(os.getpid())
 
 
+def test_preemption_guard_forwards_signal_to_registered_children(tmp_path):
+    """A preempted CONTROLLER must SIGTERM its trial subprocesses (each runs its
+    own guard and writes its own emergency checkpoint) instead of orphaning
+    them — opt-in via forward_to_children (population controller satellite)."""
+    import subprocess
+    import sys
+    import textwrap
+
+    marker = tmp_path / "child_got_sigterm"
+    child_src = textwrap.dedent(
+        f"""
+        import signal, sys, time
+        def handler(signum, frame):
+            open({str(marker)!r}, "w").write(str(signum))
+            sys.exit(0)
+        signal.signal(signal.SIGTERM, handler)
+        print("armed", flush=True)
+        for _ in range(600):
+            time.sleep(0.05)
+        sys.exit(1)
+        """
+    )
+    child = subprocess.Popen([sys.executable, "-c", child_src], stdout=subprocess.PIPE, text=True)
+    try:
+        assert child.stdout.readline().strip() == "armed"
+        with PreemptionGuard(enabled=True, forward_to_children=True) as guard:
+            guard.register_child(child.pid)
+            guard.register_child(child.pid)  # idempotent
+            guard.register_child(99999999)  # dead/unknown pid must be skipped quietly
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5.0
+            while not guard.should_stop and time.time() < deadline:
+                time.sleep(0.01)
+            assert guard.should_stop
+        assert child.wait(timeout=10) == 0
+        assert marker.read_text() == str(int(signal.SIGTERM))
+    finally:
+        if child.poll() is None:
+            child.kill()
+
+
+def test_preemption_guard_without_forwarding_leaves_children_alone():
+    import subprocess
+    import sys
+
+    child = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(30)"])
+    try:
+        with PreemptionGuard(enabled=True) as guard:  # forward_to_children defaults off
+            guard.register_child(child.pid)  # safe no-op registration
+            os.kill(os.getpid(), signal.SIGTERM)
+            deadline = time.time() + 5.0
+            while not guard.should_stop and time.time() < deadline:
+                time.sleep(0.01)
+        assert child.poll() is None  # untouched
+    finally:
+        child.kill()
+        child.wait(timeout=10)
+
+
+def test_preemption_guard_touches_flag_file_on_real_signal(tmp_path, monkeypatch):
+    """The flag file tells a supervising controller 'exited 0 because preempted'
+    apart from 'exited 0 because finished' (byte-identical returncodes)."""
+    flag = tmp_path / "preempt_flag"
+    monkeypatch.setenv(resilience.FLAG_FILE_ENV_VAR, str(flag))
+    with PreemptionGuard(enabled=True, stop_after_iters=1) as guard:
+        guard.completed_iteration()  # the TEST knob trips the guard...
+        assert guard.should_stop
+    assert not flag.exists()  # ...but only a REAL signal touches the flag
+    with PreemptionGuard(enabled=True) as guard:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not guard.should_stop and time.time() < deadline:
+            time.sleep(0.01)
+    assert flag.read_text() == str(int(signal.SIGTERM))
+
+
+# --------------------------------------------------------------------------- #
+# jittered_backoff
+# --------------------------------------------------------------------------- #
+
+
+def test_jittered_backoff_envelope_and_cap():
+    import random
+
+    rng = random.Random(0)
+    for attempt, nominal in [(1, 0.5), (2, 1.0), (3, 2.0), (10, 30.0)]:
+        for _ in range(50):
+            d = resilience.jittered_backoff(0.5, attempt, 30.0, rng)
+            assert 0.5 * nominal <= d <= nominal, (attempt, d)
+
+
+def test_jittered_backoff_breaks_lockstep():
+    """Simultaneously-killed workers must NOT all sleep the same delay — the
+    whole point of the jitter is to spread the thundering herd."""
+    import random
+
+    delays = {round(resilience.jittered_backoff(1.0, 3, 60.0, random.Random(i)), 6) for i in range(20)}
+    assert len(delays) > 15  # near-unique draws, never one lockstep value
+    # zero-base configs (tests, hot restarts) must stay zero-delay
+    assert resilience.jittered_backoff(0.0, 5, 30.0) == 0.0
+
+
 # --------------------------------------------------------------------------- #
 # WorkerSupervisor / SupervisedVectorEnv
 # --------------------------------------------------------------------------- #
