@@ -419,6 +419,136 @@ def bench_orchestrate() -> dict:
     }
 
 
+def _serve_level(addr, obs: dict, qps: float, duration_s: float) -> dict:
+    """One open-loop load level: send at the offered rate WITHOUT waiting for
+    responses (a closed-loop client would never overrun the server, hiding the
+    backpressure behavior the sweep exists to show), collect latencies on a
+    reader thread, report percentiles + terminal-status mix."""
+    import json as _json
+    import socket
+    import threading
+
+    sent: dict = {}
+    latencies: list = []
+    statuses: dict = {}
+    lock = threading.Lock()
+    sock = socket.create_connection(addr, timeout=10.0)
+    rw = sock.makefile("rwb")
+
+    def reader():
+        while True:
+            try:
+                line = rw.readline()
+            except (OSError, ValueError):
+                return
+            if not line:
+                return
+            resp = _json.loads(line)
+            t1 = time.monotonic()
+            with lock:
+                t0 = sent.pop(resp.get("id"), None)
+                statuses[resp["status"]] = statuses.get(resp["status"], 0) + 1
+                if resp.get("status") == "ok" and t0 is not None:
+                    latencies.append((t1 - t0) * 1000.0)
+
+    rt = threading.Thread(target=reader, daemon=True)
+    rt.start()
+    n = max(1, int(qps * duration_s))
+    interval = 1.0 / qps
+    t_start = time.monotonic()
+    for i in range(n):
+        target_t = t_start + i * interval
+        now = time.monotonic()
+        if target_t > now:
+            time.sleep(target_t - now)
+        rid = f"q{qps}-{i}"
+        with lock:
+            sent[rid] = time.monotonic()
+        rw.write((_json.dumps({"id": rid, "obs": obs}) + "\n").encode())
+        rw.flush()
+    send_elapsed = time.monotonic() - t_start
+    settle_until = time.monotonic() + 10.0
+    while time.monotonic() < settle_until:
+        with lock:
+            if not sent:
+                break
+        time.sleep(0.02)
+    with lock:
+        unresolved = len(sent)
+    sock.close()
+    rt.join(timeout=2.0)
+    latencies.sort()
+    pct = lambda p: round(latencies[min(len(latencies) - 1, int(len(latencies) * p))], 3) if latencies else None
+    return {
+        "offered_qps": qps,
+        "achieved_qps": round(n / send_elapsed, 1),
+        "sent": n,
+        "ok": statuses.get("ok", 0),
+        "rejected": statuses.get("rejected", 0),
+        "shed": statuses.get("shed", 0),
+        "deadline_missed": statuses.get("deadline_expired", 0),
+        "errors": statuses.get("error", 0),
+        "unresolved": unresolved,
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+    }
+
+
+def bench_serve(qps_levels=(25, 50, 100, 200), duration_s: float = 3.0) -> dict:
+    """Policy-serving QPS sweep: offered load vs p50/p99 latency.
+
+    Reuses the scripts/serve_smoke.py fixture (tiny certified PPO checkpoint,
+    subprocess server) and drives an open-loop generator at each offered QPS
+    level. The sweep's invariant — asserted, not just reported — is ZERO
+    retraces after warmup: every request mix lands on an AOT bucket. Headline
+    ``serve_p99_ms`` is the p99 at the highest offered level.
+    """
+    import importlib.util
+    import os
+    import signal
+    import subprocess
+    import tempfile
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_smoke",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "scripts", "serve_smoke.py"),
+    )
+    serve_smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(serve_smoke)
+
+    t0 = time.perf_counter()
+    workdir = tempfile.mkdtemp(prefix="bench_serve_")
+    fixture = serve_smoke.build_fixture(workdir)
+    ready_file = os.path.join(workdir, "ready.json")
+    stats_file = os.path.join(workdir, "stats.json")
+    log_file = os.path.join(workdir, "server.log")
+    proc = serve_smoke.launch_server(fixture, ready_file, stats_file, log_file)
+    result: dict = {}
+    try:
+        info = serve_smoke.wait_ready(ready_file, proc, log_file, timeout=240.0)
+        addr = (info["host"], info["port"])
+        levels = [_serve_level(addr, fixture["obs"], qps, duration_s) for qps in qps_levels]
+        stats = serve_smoke.rpc(addr, {"op": "stats"})
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=90)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    retraces = stats.get("Compile/retraces")
+    if retraces != 0:
+        raise RuntimeError(f"{retraces} steady-state retraces during the QPS sweep (must be 0)")
+    result["serve_levels"] = levels
+    result["serve_retraces"] = retraces
+    result["serve_aot_compiles"] = stats.get("Compile/aot_compiles")
+    result["serve_batch_occupancy"] = stats.get("Serve/batch_occupancy")
+    top = levels[-1]
+    result["serve_p50_ms"] = top["p50_ms"]
+    result["serve_p99_ms"] = top["p99_ms"]
+    result["serve_offered_qps"] = top["offered_qps"]
+    result["serve_sweep_wall_s"] = round(time.perf_counter() - t0, 3)
+    return result
+
+
 def _target_metric(target: str) -> str:
     """Headline metric name for a bench target — the watchdog's failure record
     must name the metric the selected target WOULD have produced, not hardcode
@@ -430,9 +560,24 @@ def _target_metric(target: str) -> str:
         "compile": "compile_warm_first_train_step_s",
         "health": "health_detection_latency_s",
         "orchestrate": "orchestrate_preempt_recovery_s",
+        "serve": "serve_p99_ms",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
+
+
+# unit for each headline metric: the watchdog's error record used to GUESS
+# from the metric name ("env_steps" in it or not), which filed seconds- and
+# milliseconds-unit targets as "g-steps/s" (see BENCH_r05.json's null row)
+_METRIC_UNITS = {
+    "ppo_cartpole_env_steps_per_sec": "env-steps/s",
+    "dv3_gsteps_per_sec": "g-steps/s",
+    "compile_warm_first_train_step_s": "s",
+    "health_detection_latency_s": "s",
+    "orchestrate_preempt_recovery_s": "s",
+    "serve_p99_ms": "ms",
+    "ppo_smoke_env_steps_per_sec": "env-steps/s",
+}
 
 
 def _regression_check(result: dict) -> None:
@@ -485,7 +630,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "health", "orchestrate", "all"),
+        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -529,7 +674,7 @@ if __name__ == "__main__":
                         {
                             "metric": headline_metric,
                             "value": None,
-                            "unit": "env-steps/s" if "env_steps" in headline_metric else "g-steps/s",
+                            "unit": _METRIC_UNITS.get(headline_metric, "s"),
                             "vs_baseline": None,
                             "error": "backend discovery exceeded 180s even on the CPU "
                             "fallback (broken jax install?)",
@@ -608,6 +753,15 @@ if __name__ == "__main__":
                 result.setdefault("metric", headline_metric)
                 result.setdefault("value", orch.get("orchestrate_preempt_recovery_s"))
                 result.setdefault("unit", "s")
+            if cli_args.target == "serve":
+                # opt-in only: offered-QPS sweep over the policy-serving
+                # runtime (subprocess server on the session's backend)
+                sv = bench_serve()
+                result.update(sv)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", sv.get("serve_p99_ms"))
+                result.setdefault("unit", "ms")
+                result.setdefault("vs_baseline", None)
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
         # numbers are real but from the CPU backend — flag them as incomparable
         result["cpu_fallback"] = True

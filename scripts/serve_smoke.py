@@ -1,0 +1,410 @@
+#!/usr/bin/env python
+"""Serve smoke: chaos-under-load drill for the policy-serving runtime.
+
+1. synthesize a tiny certified PPO checkpoint + sidecar config WITHOUT
+   training (compose config, init agent params, save_state, certify);
+2. launch ``sheeprl_serve.py`` as a subprocess and drive sustained load from
+   concurrent closed-loop clients (unique request ids, retry on backpressure
+   and connection loss);
+3. mid-load, certify a SECOND checkpoint generation and wait for responses
+   stamped with the new generation id — a hot-reload under traffic;
+4. SIGTERM the server under load: it must stop admitting (``rejected /
+   draining`` — still a response), drain everything admitted, write a final
+   stats snapshot, and exit 0;
+5. restart the server; its reloader must pick the newest certified generation
+   back up and traffic must resume;
+6. audit: every request id issued resolved to exactly one terminal status
+   (zero non-shed losses), the server-side counters satisfy
+   ``requests_total == ok + shed + rejected + deadline_missed + errors`` at
+   both shutdowns, and ``Compile/retraces`` stayed 0 — no request mix ever
+   retraced after warmup.
+
+Run directly (``python scripts/serve_smoke.py``) or through the registered
+tier-1 test (tests/test_utils/test_serve_smoke.py). ``bench.py --target
+serve`` reuses :func:`build_fixture`/:func:`launch_server` for its QPS sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# Tiny MLP agent on the dummy discrete env: big enough to exercise the real
+# build_agent/player path, small enough that boot + 3-bucket AOT warmup is
+# seconds on CPU.
+FIXTURE_OVERRIDES = [
+    "exp=ppo",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.num_envs=1",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "metric.log_level=0",
+    "seed=3",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.dense_units=8",
+    "algo.mlp_layers=1",
+]
+
+# Serve knobs for the drill, passed as CLI overrides so the sidecar config
+# stays a plain training config (the common production shape).
+SERVE_OVERRIDES = [
+    "serve.batch.max_size=4",
+    "serve.batch.max_wait_ms=4.0",
+    "serve.queue.max_depth=64",
+    "serve.queue.deadline_ms=30000",
+    "serve.reload.poll_s=0.25",
+]
+
+
+# --------------------------------------------------------------------------- fixture
+def write_generation(ckpt_dir: str, state: dict, step: int) -> str:
+    """Save + certify one checkpoint generation (``ckpt_<step>_0.ckpt``)."""
+    from sheeprl_tpu.utils.checkpoint import certify, save_state
+
+    path = os.path.join(ckpt_dir, f"ckpt_{step}_0.ckpt")
+    info = save_state(path, state)
+    certify(path, crc32=info.get("crc32"), size=info.get("size"), policy_step=step)
+    return path
+
+
+def perturb(state: dict) -> dict:
+    """A distinguishable next generation: nudge every float leaf."""
+    import jax
+    import numpy as np
+
+    def bump(a):
+        arr = np.asarray(a)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr + np.asarray(0.01, dtype=arr.dtype)
+        return a
+
+    return {"agent": jax.tree_util.tree_map(bump, state["agent"])}
+
+
+def build_fixture(workdir: str) -> dict:
+    """Synthesize a servable certified run dir (config sidecar + checkpoint)
+    without training — the serve smoke/bench bootstrap."""
+    import numpy as np
+    import yaml
+
+    from sheeprl_tpu.config import compose
+    from sheeprl_tpu.serve.engine import init_agent_state, spaces_from_config
+
+    cfg = compose(config_name="config", overrides=FIXTURE_OVERRIDES)
+    state = init_agent_state(cfg)
+    obs_space, _, _ = spaces_from_config(cfg)
+    obs = {
+        k: np.zeros(obs_space[k].shape, dtype=np.float32).tolist()
+        for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+    }
+    run_dir = os.path.join(workdir, "run")
+    ckpt_dir = os.path.join(run_dir, "checkpoint")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    with open(os.path.join(run_dir, "config.yaml"), "w") as f:
+        yaml.safe_dump(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg), f)
+    ckpt = write_generation(ckpt_dir, state, step=100)
+    return {"run_dir": run_dir, "ckpt_dir": ckpt_dir, "ckpt": ckpt, "state": state, "obs": obs}
+
+
+# --------------------------------------------------------------------------- server
+def launch_server(fixture: dict, ready_file: str, stats_file: str, log_file: str, extra=()) -> subprocess.Popen:
+    cmd = [
+        sys.executable,
+        os.path.join(REPO_ROOT, "sheeprl_serve.py"),
+        f"checkpoint_path={fixture['ckpt']}",
+        f"serve.server.ready_file={ready_file}",
+        f"stats_file={stats_file}",
+        *SERVE_OVERRIDES,
+        *extra,
+    ]
+    log = open(log_file, "a")
+    return subprocess.Popen(
+        cmd,
+        cwd=os.path.dirname(fixture["run_dir"]),
+        env=dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu")),
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def wait_ready(ready_file: str, proc: subprocess.Popen, log_file: str, timeout: float) -> dict:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            with open(log_file) as f:
+                tail = f.read()[-2000:]
+            raise SystemExit(f"server exited rc={proc.returncode} before ready; log tail:\n{tail}")
+        if os.path.isfile(ready_file):
+            try:
+                with open(ready_file) as f:
+                    return json.load(f)
+            except ValueError:
+                pass  # mid-replace; retry
+        time.sleep(0.05)
+    raise SystemExit(f"server not ready within {timeout}s (see {log_file})")
+
+
+def rpc(addr, payload: dict, timeout: float = 10.0) -> dict:
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        f = sock.makefile("rwb")
+        f.write((json.dumps(payload) + "\n").encode())
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise ConnectionError("server closed connection")
+    return json.loads(line)
+
+
+# --------------------------------------------------------------------------- load
+class LoadClient(threading.Thread):
+    """Closed-loop client: one outstanding request, unique monotonically
+    numbered ids, retries the SAME id through backpressure (``rejected``) and
+    connection loss (kill/restart window) until it gets a terminal answer."""
+
+    def __init__(self, name: str, holder: dict, obs: dict, stop: threading.Event, pace_s: float = 0.002):
+        super().__init__(name=name, daemon=True)
+        self.client = name
+        self.holder = holder
+        self.obs = obs
+        self.stop_event = stop
+        self.pace_s = pace_s
+        self.results: dict = {}  # id -> terminal response
+        self.unresolved: set = set()
+        self.gens: set = set()
+        self.retries = 0
+        self._sock = None
+        self._file = None
+
+    # -- connection management ----------------------------------------------------
+    def _disconnect(self) -> None:
+        for closable in (self._file, self._sock):
+            if closable is not None:
+                try:
+                    closable.close()
+                except OSError:
+                    pass
+        self._sock = self._file = None
+
+    def _connect(self) -> None:
+        if self._sock is not None:
+            return
+        self._sock = socket.create_connection(self.holder["addr"], timeout=10.0)
+        self._file = self._sock.makefile("rwb")
+
+    # -- request loop --------------------------------------------------------------
+    def _resolve(self, rid: str):
+        """Retry until a TERMINAL response for ``rid`` (or the drill stops)."""
+        while not self.stop_event.is_set():
+            try:
+                self._connect()
+                self._file.write((json.dumps({"id": rid, "obs": self.obs}) + "\n").encode())
+                self._file.flush()
+                line = self._file.readline()
+                if not line:
+                    raise ConnectionError("eof")
+                resp = json.loads(line)
+            except (OSError, ValueError, ConnectionError):
+                self._disconnect()
+                self.retries += 1
+                time.sleep(0.1)
+                continue
+            if resp.get("status") == "rejected":
+                # backpressure or draining: still an answer; retry the same id
+                self.retries += 1
+                time.sleep(max(resp.get("retry_after_ms", 50.0), 50.0) / 1000.0)
+                continue
+            return resp
+        return None
+
+    def run(self) -> None:
+        n = 0
+        while not self.stop_event.is_set():
+            rid = f"{self.client}-{n}"
+            self.unresolved.add(rid)
+            resp = self._resolve(rid)
+            if resp is None:
+                break  # drill stopped mid-retry; this id stays in unresolved
+            self.unresolved.discard(rid)
+            self.results[rid] = resp
+            if resp.get("gen") is not None:
+                self.gens.add(resp["gen"])
+            n += 1
+            time.sleep(self.pace_s)
+        self._disconnect()
+
+
+# --------------------------------------------------------------------------- audit
+def _audit_stats(stats: dict, label: str) -> None:
+    total = stats["Serve/requests_total"]
+    parts = (
+        stats["Serve/ok"]
+        + stats["Serve/shed"]
+        + stats["Serve/rejected"]
+        + stats["Serve/deadline_missed"]
+        + stats["Serve/errors"]
+    )
+    if total != parts:
+        raise SystemExit(f"{label}: accounting broken — requests_total={total} but terminal sum={parts}")
+    if stats.get("Compile/retraces", 0) != 0:
+        raise SystemExit(f"{label}: {stats['Compile/retraces']} steady-state retraces (must be 0)")
+
+
+def _wait_until(pred, timeout: float, what: str, log_file: str = None) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    tail = ""
+    if log_file and os.path.isfile(log_file):
+        with open(log_file) as f:
+            tail = "; server log tail:\n" + f.read()[-2000:]
+    raise SystemExit(f"timed out after {timeout}s waiting for {what}{tail}")
+
+
+# --------------------------------------------------------------------------- drill
+def main(workdir: str | None = None, timeout: float = 420.0) -> dict:
+    workdir = workdir or tempfile.mkdtemp(prefix="serve_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    started = time.monotonic()
+    fixture = build_fixture(workdir)
+
+    rf1 = os.path.join(workdir, "ready1.json")
+    sf1 = os.path.join(workdir, "stats1.json")
+    log1 = os.path.join(workdir, "server1.log")
+    proc1 = launch_server(fixture, rf1, sf1, log1)
+    holder = {"addr": None}
+    try:
+        info = wait_ready(rf1, proc1, log1, timeout=min(240.0, timeout))
+        holder["addr"] = (info["host"], info["port"])
+
+        stop = threading.Event()
+        clients = [LoadClient(f"c{i}", holder, fixture["obs"], stop) for i in range(3)]
+        for c in clients:
+            c.start()
+
+        def ok_count():
+            return sum(1 for c in clients for r in c.results.values() if r.get("status") == "ok")
+
+        # phase 1: steady traffic on the boot generation
+        _wait_until(lambda: ok_count() >= 20, 60, "20 ok responses on gen 1", log1)
+
+        # phase 2: certify a second generation mid-load; responses must start
+        # carrying gen 2 without any client seeing an error or a dropped id
+        write_generation(fixture["ckpt_dir"], perturb(fixture["state"]), step=200)
+        _wait_until(lambda: any(2 in c.gens for c in clients), 60, "a response from generation 2", log1)
+        reload_latency_s = time.monotonic() - started
+
+        # phase 3: SIGTERM under load — drain, final stats, rc 0
+        ok_before_kill = ok_count()
+        proc1.send_signal(signal.SIGTERM)
+        rc1 = proc1.wait(timeout=90)
+        if rc1 != 0:
+            with open(log1) as f:
+                raise SystemExit(f"server A exited rc={rc1} on SIGTERM; log tail:\n{f.read()[-2000:]}")
+        with open(sf1) as f:
+            stats1 = json.load(f)
+        if not stats1.get("drained"):
+            raise SystemExit(f"server A did not report a clean drain: {stats1}")
+        _audit_stats(stats1, "server A shutdown stats")
+
+        # phase 4: restart on the same checkpoint dir; the reloader must catch
+        # the step-200 generation back up and traffic must resume
+        rf2 = os.path.join(workdir, "ready2.json")
+        sf2 = os.path.join(workdir, "stats2.json")
+        log2 = os.path.join(workdir, "server2.log")
+        proc2 = launch_server(fixture, rf2, sf2, log2)
+        try:
+            info2 = wait_ready(rf2, proc2, log2, timeout=min(240.0, timeout))
+            holder["addr"] = (info2["host"], info2["port"])
+            _wait_until(lambda: ok_count() >= ok_before_kill + 15, 90, "15 ok responses after restart", log2)
+            _wait_until(
+                lambda: rpc(holder["addr"], {"op": "health"}).get("gen", 0) >= 2,
+                60,
+                "restarted server to hot-reload generation 2",
+                log2,
+            )
+
+            # phase 5: stop load, audit live counters, graceful shutdown
+            stop.set()
+            for c in clients:
+                c.join(timeout=30)
+            stats_live = rpc(holder["addr"], {"op": "stats"})
+            _audit_stats(stats_live, "server B live stats")
+            proc2.send_signal(signal.SIGTERM)
+            rc2 = proc2.wait(timeout=90)
+            if rc2 != 0:
+                with open(log2) as f:
+                    raise SystemExit(f"server B exited rc={rc2} on SIGTERM; log tail:\n{f.read()[-2000:]}")
+            with open(sf2) as f:
+                stats2 = json.load(f)
+            if not stats2.get("drained"):
+                raise SystemExit(f"server B did not report a clean drain: {stats2}")
+            _audit_stats(stats2, "server B shutdown stats")
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+    finally:
+        stop = locals().get("stop")
+        if stop is not None:
+            stop.set()
+        if proc1.poll() is None:
+            proc1.kill()
+
+    # client-side audit: every issued id resolved, except at most the one id
+    # per client that was mid-retry when the drill stopped it
+    unresolved = [rid for c in clients for rid in c.unresolved]
+    if any(len(c.unresolved) > 1 for c in clients):
+        raise SystemExit(f"non-shed request losses: {unresolved}")
+    statuses: dict = {}
+    gens: set = set()
+    for c in clients:
+        gens |= c.gens
+        for r in c.results.values():
+            statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    if statuses.get("error"):
+        raise SystemExit(f"client saw {statuses['error']} error responses: statuses={statuses}")
+    if 1 not in gens or 2 not in gens:
+        raise SystemExit(f"expected responses from generations 1 and 2, saw {sorted(gens)}")
+
+    return {
+        "workdir": workdir,
+        "wall_s": round(time.monotonic() - started, 2),
+        "client_statuses": statuses,
+        "client_retries": sum(c.retries for c in clients),
+        "generations_seen": sorted(gens),
+        "reload_latency_s": round(reload_latency_s, 2),
+        "serverA_stats": {k: v for k, v in stats1.items() if k.startswith(("Serve/", "Compile/"))},
+        "serverB_stats": {k: v for k, v in stats2.items() if k.startswith(("Serve/", "Compile/"))},
+        "unresolved_at_stop": unresolved,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None, help="drill directory (default: fresh tempdir)")
+    parser.add_argument("--timeout", type=float, default=420.0, help="overall budget in seconds")
+    cli = parser.parse_args()
+    result = main(cli.workdir, cli.timeout)
+    print(
+        "serve smoke OK: "
+        f"{result['client_statuses'].get('ok', 0)} requests served across generations "
+        f"{result['generations_seen']} with a mid-load hot-reload and a kill/restart, "
+        f"{result['client_retries']} client retries, zero losses, zero retraces "
+        f"({result['wall_s']}s)"
+    )

@@ -1,0 +1,7 @@
+"""Thin shim: `python sheeprl_serve.py checkpoint_path=...` or
+`python sheeprl_serve.py model_name=<registered model>` (mirrors sheeprl_eval.py)."""
+
+from sheeprl_tpu.cli import serve
+
+if __name__ == "__main__":
+    serve()

@@ -264,9 +264,13 @@ class PPOPlayer:
         def _act_raw(params, obs, key):
             return _act(params, _normalize(obs), key)
 
+        def _greedy_raw(params, obs, key):
+            return _greedy(params, _normalize(obs), key)
+
         self._act = jax_compile.guarded_jit(_act, name="ppo.act")
         self._act_raw = jax_compile.guarded_jit(_act_raw, name="ppo.act_raw")
         self._greedy = jax_compile.guarded_jit(_greedy, name="ppo.greedy")
+        self._greedy_raw = jax_compile.guarded_jit(_greedy_raw, name="ppo.greedy_raw")
         self._values = jax_compile.guarded_jit(_values, name="ppo.values")
         self._act_impl = _act  # unjitted: fused into the packed-act trace
         self._packed_act_fns: Dict[Any, Any] = {}
@@ -309,6 +313,20 @@ class PPOPlayer:
         if greedy:
             return self._greedy(self.params, obs, key)
         _, env_actions, _, _, key = self._act(self.params, obs, key)
+        return env_actions, key
+
+    def get_actions_raw(
+        self, obs: Dict[str, Any], key: jax.Array, greedy: bool = False, params: Any = None
+    ):
+        """:meth:`get_actions` over RAW host obs (normalization fused in-graph,
+        same single-dispatch rationale as :meth:`act_raw`). ``params`` overrides
+        ``self.params`` so callers that swap weight generations atomically (the
+        serve runtime) can pin a batch to one generation without mutating the
+        shared player. Returns (env-facing actions, next_key)."""
+        p = self.params if params is None else params
+        if greedy:
+            return self._greedy_raw(p, obs, key)
+        _, env_actions, _, _, key = self._act_raw(p, obs, key)
         return env_actions, key
 
     def get_values(self, obs: Dict[str, jax.Array]) -> jax.Array:

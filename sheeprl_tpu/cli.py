@@ -285,6 +285,20 @@ def evaluation(overrides: Optional[Sequence[str]] = None) -> None:
     if ckpt_path is None:
         raise ConfigError("You must specify checkpoint_path=<path> for evaluation")
     ckpt_path = os.path.abspath(ckpt_path)
+    # Prefer a CERTIFIED sibling over an uncertified request: the requested file
+    # may be a mid-rollback or corrupt artifact the health ladder already
+    # refused to vouch for. prefer_certified=False keeps the literal path.
+    if cli_cfg.get("prefer_certified", True):
+        from sheeprl_tpu.utils.checkpoint import is_certified, latest_certified
+
+        if not is_certified(ckpt_path):
+            certified = latest_certified(os.path.dirname(ckpt_path))
+            if certified is not None and os.path.abspath(certified) != ckpt_path:
+                warnings.warn(
+                    f"checkpoint_path '{ckpt_path}' is not certified; evaluating the certified "
+                    f"sibling '{certified}' instead (pass prefer_certified=False to override)"
+                )
+                ckpt_path = os.path.abspath(certified)
     cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
     if not os.path.isfile(cfg_path):
         raise RuntimeError(f"The config file of the checkpoint does not exist: {cfg_path}")
@@ -359,6 +373,92 @@ def registration(overrides: Optional[Sequence[str]] = None) -> None:
     registered = register_model_from_checkpoint(runtime, cfg, state, log_models_fn)
     for name, version in registered.items():
         runtime.print(f"{name}: registered as '{version.name}' v{version.version} at {version.path}")
+
+
+def serve(overrides: Optional[Sequence[str]] = None) -> None:
+    """`sheeprl-serve` entry: batched policy inference with certified hot-reload.
+
+    Two sources, same runtime:
+
+    - ``checkpoint_path=<ckpt>``: boot from the checkpoint's sidecar config,
+      preferring the newest CERTIFIED sibling in the same dir (the trainer may
+      still be writing there — the hot-reloader then keeps following
+      ``latest_certified``). ``prefer_certified=False`` pins the literal path.
+    - ``model_name=<registered name>`` (optionally ``model_version=N``): serve
+      a registry version directly by name. The registration flow stores each
+      version's run config next to its weights, so no checkpoint dir is needed
+      (and hot-reload is off: registry versions are immutable).
+
+    Any ``serve.*`` dotted override reaches the config group
+    (``serve.queue.admission=shed_oldest`` etc.); ``stats_file=<path>`` writes
+    the final ``Serve/*`` snapshot on graceful shutdown.
+    """
+    import yaml
+
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    overrides = list(overrides if overrides is not None else sys.argv[1:])
+    cli_cfg: Dict[str, Any] = {}
+    for ov in overrides:
+        key, _, value = ov.partition("=")
+        cli_cfg[key.strip()] = yaml.safe_load(value)
+
+    model_name = cli_cfg.pop("model_name", None)
+    ckpt_path = cli_cfg.pop("checkpoint_path", None)
+    stats_file = cli_cfg.pop("stats_file", None)
+    prefer_certified = cli_cfg.pop("prefer_certified", True)
+    ckpt_dir: Optional[str] = None
+    boot_info: Optional[Dict[str, Any]] = None
+    if model_name is not None:
+        from sheeprl_tpu.utils.model_manager import LocalModelManager, default_registry_dir
+
+        registry_dir = cli_cfg.pop("model_manager.registry_dir", None) or default_registry_dir(None)
+        manager = LocalModelManager(None, registry_dir)
+        version = cli_cfg.pop("model_version", None)
+        if version is None:
+            version = manager.get_latest_version(model_name).version
+        state = {"agent": manager.load_model(model_name, version)}
+        cfg = manager.load_version_config(model_name, version)
+        source = f"registry://{model_name}/v{version}"
+    elif ckpt_path is not None:
+        from sheeprl_tpu.utils.checkpoint import certified_info, is_certified, latest_certified
+
+        ckpt_path = os.path.abspath(ckpt_path)
+        ckpt_dir = os.path.dirname(ckpt_path)
+        if prefer_certified and not is_certified(ckpt_path):
+            certified = latest_certified(ckpt_dir)
+            if certified is not None:
+                warnings.warn(
+                    f"checkpoint_path '{ckpt_path}' is not certified; serving the certified "
+                    f"sibling '{certified}' instead (pass prefer_certified=False to override)"
+                )
+                ckpt_path = os.path.abspath(certified)
+        cfg_path = os.path.join(ckpt_dir, os.pardir, "config.yaml")
+        if not os.path.isfile(cfg_path):
+            raise RuntimeError(f"The config file of the checkpoint does not exist: {cfg_path}")
+        with open(cfg_path) as f:
+            cfg = dotdict(yaml.safe_load(f))
+        state = load_state(ckpt_path)
+        source = ckpt_path
+        # sidecar identity (crc) lets the hot-reloader skip the artifact that
+        # is already serving instead of re-loading it as a new generation
+        boot_info = certified_info(ckpt_path)
+    else:
+        raise ConfigError("You must specify checkpoint_path=<path> or model_name=<name> for serving")
+
+    for key, value in cli_cfg.items():  # dotted overrides, e.g. serve.queue.admission=...
+        node = cfg
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, dotdict({}))
+        node[parts[-1]] = value
+    cfg.fabric.devices = 1
+    seed_everything(cfg.seed)
+    _apply_global_flags(cfg)
+    server = PolicyServer(cfg, state, source=source, ckpt_dir=ckpt_dir, boot_info=boot_info)
+    server.start()
+    print(f"serving on {server.host}:{server.port} (source {source})", flush=True)
+    server.serve_until_stopped(stats_file=stats_file)
 
 
 def run(overrides: Optional[Sequence[str]] = None) -> None:

@@ -355,6 +355,14 @@ class GuardedFn:
         _logger.debug("[compile] AOT %s compiled in %.3fs", self.name, dt)
         return exe
 
+    def aot_ready(self, *specs: Any, **kwspecs: Any) -> bool:
+        """True when an AOT executable is registered for the specs' abstract
+        signature — the serve readiness probe: a server only advertises ready
+        once every bucket it may route to dispatches without tracing."""
+        sig = abstract_signature(specs, kwspecs)
+        with _LOCK:
+            return _routing_key(sig) in self._aot
+
     # ----- call path ------------------------------------------------------------
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         self.calls += 1

@@ -170,6 +170,7 @@ class PreemptionGuard:
         enabled: bool = True,
         stop_after_iters: Optional[int] = None,
         forward_to_children: bool = False,
+        on_signal: Optional[Callable[[int], None]] = None,
     ):
         self._enabled = bool(enabled)
         self._stop_after = int(stop_after_iters) if stop_after_iters else None
@@ -179,6 +180,11 @@ class PreemptionGuard:
         self._triggered = False
         self._signum: Optional[int] = None
         self._prev: Dict[int, Any] = {}
+        # ``on_signal`` wakes event-driven loops (the serve frontend blocks on a
+        # condition, not an iteration boundary) the instant the signal lands
+        # instead of at the next poll tick. Runs in handler context between
+        # bytecodes: keep it to an Event.set() or similar.
+        self._on_signal = on_signal
 
     def register_child(self, pid: int) -> None:
         """Track a subprocess for signal forwarding (no-op unless
@@ -212,6 +218,11 @@ class PreemptionGuard:
                     os.kill(pid, signum)
                 except (ProcessLookupError, PermissionError, OSError):
                     pass
+        if self._on_signal is not None:
+            try:
+                self._on_signal(signum)
+            except Exception:  # a broken callback must not mask the stop flag
+                pass
 
     def __enter__(self) -> "PreemptionGuard":
         if self._enabled and threading.current_thread() is threading.main_thread():

@@ -58,6 +58,23 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["DeviceSequentialReplayBuffer", "ShardedDeviceSequentialReplayBuffer"]
 
+try:  # jax >= 0.6: top-level public API, replication check renamed to check_vma
+    _shard_map_impl = jax.shard_map
+    _SHARD_MAP_CHECK_KW = "check_vma"
+except AttributeError:  # jax 0.4.x: experimental module, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+    _SHARD_MAP_CHECK_KW = "check_rep"
+
+
+def _shard_map(body, *, mesh, in_specs, out_specs):
+    """Version-portable ``shard_map`` with the replication check disabled (the
+    buffer bodies are purely shard-local scatters/gathers; the check only costs
+    trace time and rejects the tminor layout's mixed-rank outputs)."""
+    return _shard_map_impl(
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **{_SHARD_MAP_CHECK_KW: False}
+    )
+
 
 class _LeafMeta(NamedTuple):
     feat: Tuple[int, ...]  # logical per-step feature shape (leaf.shape[2:])
@@ -594,7 +611,7 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
 
                 return {key: one(key, store_tree[key], block_tree[key]) for key in store_tree}
 
-            smapped = jax.shard_map(
+            smapped = _shard_map(
                 body,
                 mesh=self._mesh,
                 in_specs=(
@@ -604,7 +621,6 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
                     P(self._axis),
                 ),
                 out_specs={key: self._storage_spec(key) for key in keys_sig},
-                check_vma=False,
             )
             self._write_fns[cache_key] = jax.jit(smapped, donate_argnums=(0,))
         return self._write_fns[cache_key]
@@ -732,7 +748,7 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
                 return {key: one(key, store_tree[key]) for key in store_tree}
 
             out_rank = {key: 3 + len(metas[key].feat) for key in keys_sig}
-            smapped = jax.shard_map(
+            smapped = _shard_map(
                 body,
                 mesh=self._mesh,
                 in_specs=(
@@ -743,7 +759,6 @@ class ShardedDeviceSequentialReplayBuffer(DeviceSequentialReplayBuffer):
                 out_specs={
                     key: P(None, None, self._axis, *([None] * (out_rank[key] - 3))) for key in keys_sig
                 },
-                check_vma=False,
             )
             self._gather_fns[cache_key] = jax.jit(smapped)
         return self._gather_fns[cache_key]

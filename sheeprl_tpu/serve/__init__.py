@@ -1,0 +1,86 @@
+"""Robust policy-serving runtime.
+
+Composes the pieces earlier rounds built for training into an inference path
+whose headline property is robustness under load and failure, not just
+throughput:
+
+- :mod:`sheeprl_tpu.serve.batcher` — micro-batcher coalescing concurrent
+  observation requests onto fixed :func:`~sheeprl_tpu.core.compile.pow2_bucket`
+  batch shapes (no request mix ever retraces), with bounded-queue admission
+  control (reject-with-retry-after vs shed-oldest) and per-request deadline
+  budgets that drop work already past its deadline.
+- :mod:`sheeprl_tpu.serve.engine` — rebuilds the agent from a checkpoint's
+  sidecar config, AOT-warms every bucket it may route to, and runs the fused
+  raw-obs act path pinned to an immutable weight :class:`Generation`.
+- :mod:`sheeprl_tpu.serve.reload` — certified hot-reload: poll
+  ``latest_certified``, warm + canary the new params off the serving path, and
+  atomically swap generations without dropping in-flight requests (rollback on
+  a failed post-swap canary).
+- :mod:`sheeprl_tpu.serve.server` — the TCP frontend: JSON-lines protocol,
+  ``Serve/*`` stats, readiness/liveness surface, graceful SIGTERM drain under
+  :class:`~sheeprl_tpu.core.resilience.PreemptionGuard`.
+
+Config group: ``sheeprl_tpu/configs/serve/default.yaml``; :func:`resolve`
+fills defaults so sidecar configs recorded before this subsystem existed still
+serve.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    "server": {"host": "127.0.0.1", "port": 0, "ready_file": None},
+    "policy": {"greedy": True},
+    "batch": {"max_size": 16, "max_wait_ms": 5.0},
+    "queue": {
+        "max_depth": 128,
+        "admission": "reject",
+        "retry_after_ms": 25.0,
+        "deadline_ms": 1000.0,
+    },
+    "reload": {"enabled": True, "poll_s": 1.0, "canary": True, "degraded_after": 3},
+}
+
+
+class _View:
+    """Attribute view over a plain dict (so code reads ``sv.queue.admission``)."""
+
+    def __init__(self, d: Dict[str, Any]):
+        self._d = d
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            v = self._d[name]
+        except KeyError:
+            raise AttributeError(name) from None
+        return _View(v) if isinstance(v, dict) else v
+
+
+def resolve(cfg: Any) -> _View:
+    """Defaults-filled view of ``cfg.serve``.
+
+    Tolerates a MISSING group entirely: serving boots from the checkpoint's
+    sidecar config, and runs recorded before this subsystem existed have no
+    ``serve`` section (same contract as ``resilience.resolve``).
+    """
+    try:
+        group = cfg.get("serve") if hasattr(cfg, "get") else None
+    except Exception:
+        group = None
+    merged: Dict[str, Any] = {}
+    for section, defaults in _DEFAULTS.items():
+        got = None
+        if group is not None:
+            got = group.get(section) if hasattr(group, "get") else getattr(group, section, None)
+        merged[section] = dict(defaults)
+        if got is not None:
+            for k in defaults:
+                v = got.get(k, defaults[k]) if hasattr(got, "get") else getattr(got, k, defaults[k])
+                merged[section][k] = v
+    return _View(merged)
+
+
+class ServeError(RuntimeError):
+    """Unrecoverable serving misconfiguration (unsupported algorithm, invalid
+    bucket ladder, no loadable checkpoint)."""

@@ -1,0 +1,7 @@
+"""`python -m sheeprl_tpu.serve checkpoint_path=...` — same surface as the
+root sheeprl_serve.py shim."""
+
+from sheeprl_tpu.cli import serve
+
+if __name__ == "__main__":
+    serve()

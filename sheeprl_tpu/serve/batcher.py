@@ -1,0 +1,205 @@
+"""Micro-batcher: coalesce concurrent inference requests onto pow-2 buckets.
+
+The worker thread closes a batch when either ``max_batch`` requests are queued
+or the OLDEST queued request has waited ``max_wait``; the batch is then padded
+to :func:`~sheeprl_tpu.core.compile.pow2_bucket` by the engine, so any request
+mix routes to one of O(log max_batch) AOT-compiled shapes and never retraces.
+
+Backpressure is explicit and graded (shed load before missing deadlines,
+reject before crashing):
+
+- the queue is bounded (``queue.max_depth``); past it, admission either
+  rejects the NEW request with a retry-after hint (``admission: reject``) or
+  evicts the OLDEST queued request (``admission: shed_oldest`` — freshest
+  observations win, the natural policy for control loops where a stale obs is
+  worth less than a fresh one);
+- every request carries a deadline budget; work already past its deadline is
+  dropped at batch-assembly time instead of computing a dead answer.
+
+Every submitted request resolves to EXACTLY ONE terminal response
+(``ok | shed | rejected | deadline_expired | error``) and bumps exactly one
+``Serve/*`` counter — the invariant the chaos drill audits.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Deque, Dict, List, Optional
+
+from sheeprl_tpu.core.compile import pow2_bucket
+from sheeprl_tpu.serve.stats import ServeStats
+
+# terminal status -> Serve/* counter
+_STATUS_COUNTER = {
+    "ok": "ok",
+    "shed": "shed",
+    "rejected": "rejected",
+    "deadline_expired": "deadline_missed",
+    "error": "errors",
+}
+
+
+class PendingRequest:
+    __slots__ = ("rid", "obs", "future", "enqueued_at", "deadline_at")
+
+    def __init__(self, rid: Any, obs: Any, deadline_s: Optional[float]):
+        self.rid = rid
+        self.obs = obs
+        self.future: Future = Future()
+        self.enqueued_at = time.monotonic()
+        self.deadline_at = None if deadline_s is None else self.enqueued_at + deadline_s
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        compute_fn: Callable[[List[PendingRequest]], List[Dict[str, Any]]],
+        *,
+        max_batch: int,
+        max_wait_s: float,
+        max_depth: int,
+        admission: str = "reject",
+        retry_after_ms: float = 25.0,
+        default_deadline_s: Optional[float] = None,
+        stats: Optional[ServeStats] = None,
+    ):
+        if admission not in ("reject", "shed_oldest"):
+            raise ValueError(f"queue.admission must be 'reject' or 'shed_oldest', got {admission!r}")
+        self._compute = compute_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_s)
+        self.max_depth = int(max_depth)
+        self.admission = admission
+        self.retry_after_ms = float(retry_after_ms)
+        self.default_deadline_s = default_deadline_s
+        self.stats = stats or ServeStats()
+        self._queue: Deque[PendingRequest] = deque()
+        self._cond = threading.Condition()
+        self._in_flight = 0
+        self._draining = False
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ----- lifecycle ------------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        self._thread = threading.Thread(target=self._loop, name="sheeprl-serve-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Refuse new work, serve everything already admitted. True if the
+        queue and the in-flight batch emptied within ``timeout``."""
+        deadline = time.monotonic() + float(timeout)
+        with self._cond:
+            self._draining = True
+            self.stats.set_gauge("draining", 1)
+            self._cond.notify_all()
+            while self._queue or self._in_flight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(min(remaining, 0.1))
+        return True
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._draining = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ----- admission ------------------------------------------------------------
+    def submit(self, obs: Any, deadline_s: Optional[float] = None, rid: Any = None) -> Future:
+        """Admit one request; ALWAYS returns a future that resolves to a
+        terminal response dict — backpressure answers arrive through the same
+        channel as actions, so clients need exactly one code path."""
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = PendingRequest(rid, obs, deadline_s)
+        self.stats.inc("requests_total")
+        shed: Optional[PendingRequest] = None
+        with self._cond:
+            if self._draining or self._closed:
+                self._resolve_locked(req, "rejected", reason="draining")
+                return req.future
+            if len(self._queue) >= self.max_depth:
+                if self.admission == "reject":
+                    self._resolve_locked(req, "rejected", retry_after_ms=self.retry_after_ms)
+                    return req.future
+                shed = self._queue.popleft()
+            self._queue.append(req)
+            self.stats.observe_queue_depth(len(self._queue))
+            self._cond.notify_all()
+        if shed is not None:
+            self._finish(shed, "shed")
+        return req.future
+
+    # ----- worker ---------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._cond.wait(0.1)
+                if self._closed and not self._queue:
+                    return
+                # the admission window is anchored on the oldest request: close
+                # the batch at max_batch or when IT has waited max_wait
+                close_at = self._queue[0].enqueued_at + self.max_wait_s
+                while len(self._queue) < self.max_batch and not self._closed:
+                    remaining = close_at - time.monotonic()
+                    if remaining <= 0 or not self._queue:
+                        break
+                    self._cond.wait(remaining)
+                batch = [
+                    self._queue.popleft() for _ in range(min(self.max_batch, len(self._queue)))
+                ]
+                self._in_flight = len(batch)
+                self.stats.observe_queue_depth(len(self._queue))
+            try:
+                if batch:
+                    self._run_batch(batch)
+            finally:
+                with self._cond:
+                    self._in_flight = 0
+                    self._cond.notify_all()
+
+    def _run_batch(self, batch: List[PendingRequest]) -> None:
+        now = time.monotonic()
+        live: List[PendingRequest] = []
+        for r in batch:
+            if r.deadline_at is not None and now > r.deadline_at:
+                self._finish(r, "deadline_expired")
+            else:
+                live.append(r)
+        if not live:
+            return
+        self.stats.observe_batch(len(live), min(pow2_bucket(len(live)), self.max_batch))
+        try:
+            results = self._compute(live)
+        except Exception as e:  # device/engine failure: fail the batch, not the server
+            err = f"{type(e).__name__}: {e}"
+            for r in live:
+                self._finish(r, "error", error=err)
+            return
+        done = time.monotonic()
+        for r, res in zip(live, results):
+            self.stats.observe_latency(done - r.enqueued_at)
+            self._finish(r, "ok", **res)
+
+    # ----- terminal resolution ----------------------------------------------------
+    def _finish(self, req: PendingRequest, status: str, **extra: Any) -> None:
+        self.stats.inc(_STATUS_COUNTER[status])
+        payload = {"id": req.rid, "status": status}
+        payload.update(extra)
+        if not req.future.set_running_or_notify_cancel():
+            return
+        req.future.set_result(payload)
+
+    def _resolve_locked(self, req: PendingRequest, status: str, **extra: Any) -> None:
+        # same as _finish; named for call sites inside self._cond (the future
+        # callback runs synchronously — keep it cheap there)
+        self._finish(req, status, **extra)

@@ -1,0 +1,291 @@
+"""Policy engine: checkpoint -> warmed, generation-pinned batched inference.
+
+Rebuilds the agent exactly the way evaluation does (sidecar config + env
+spaces + ``build_agent``), then serves through the player's fused raw-obs act
+path: observation normalization, sampling/argmax and the env-facing concat all
+run inside ONE AOT-compiled dispatch per batch.
+
+Weight swaps are modelled as immutable :class:`Generation` objects held by a
+:class:`GenerationStore`. A batch reads the store ONCE and computes against
+that generation's params for its whole lifetime, so a concurrent hot-reload
+can never produce a torn read (half-old, half-new weights); swapping is a
+single reference assignment under a lock. Because every generation shares the
+agent's abstract signature, one AOT executable per bucket serves all of them —
+reloading never recompiles, let alone retraces.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.serve import ServeError, resolve
+from sheeprl_tpu.utils.env import make_env
+
+_logger = logging.getLogger(__name__)
+
+# Algorithms sharing the PPO agent/player act surface. Recurrent and
+# model-based players carry per-request latent state, which needs a session
+# protocol — out of scope for the stateless request/response frontend.
+SUPPORTED_ALGOS = ("ppo", "ppo_decoupled", "a2c")
+
+
+@dataclass(frozen=True)
+class Generation:
+    """One immutable serving artifact: params + provenance."""
+
+    gen_id: int
+    params: Any = field(repr=False)
+    source: str
+    step: Optional[int] = None
+    crc32: Optional[int] = None
+    loaded_at: float = 0.0
+
+
+class GenerationStore:
+    """Atomic holder of the CURRENT serving generation.
+
+    ``get`` returns one self-consistent Generation object; ``swap`` replaces
+    the reference and returns the previous generation (the reloader's rollback
+    target). Readers never block writers and vice versa beyond the reference
+    assignment itself.
+    """
+
+    def __init__(self, gen: Optional[Generation] = None):
+        self._lock = threading.Lock()
+        self._gen = gen
+
+    def get(self) -> Optional[Generation]:
+        with self._lock:
+            return self._gen
+
+    def swap(self, gen: Generation) -> Optional[Generation]:
+        with self._lock:
+            prev, self._gen = self._gen, gen
+            return prev
+
+    @property
+    def gen_id(self) -> int:
+        g = self.get()
+        return 0 if g is None else g.gen_id
+
+
+def spaces_from_config(cfg: Any) -> Tuple[gym.spaces.Dict, Tuple[int, ...], bool]:
+    """Instantiate one throwaway env (exactly like evaluate_ppo) to recover
+    ``(obs_space, actions_dim, is_continuous)`` for ``build_agent``."""
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = False
+    env = make_env(cfg, cfg.seed, 0, None, "serve", vector_env_idx=0)()
+    try:
+        obs_space = env.observation_space
+        if not isinstance(obs_space, gym.spaces.Dict):
+            raise ServeError(f"expected Dict observation space, got: {obs_space}")
+        is_continuous = isinstance(env.action_space, gym.spaces.Box)
+        is_multidiscrete = isinstance(env.action_space, gym.spaces.MultiDiscrete)
+        actions_dim = tuple(
+            env.action_space.shape
+            if is_continuous
+            else (env.action_space.nvec.tolist() if is_multidiscrete else [env.action_space.n])
+        )
+    finally:
+        env.close()
+    return obs_space, actions_dim, is_continuous
+
+
+def init_agent_state(cfg: Any) -> Dict[str, Any]:
+    """Freshly-initialised agent params in checkpoint-state form
+    (``{"agent": host_params}``) — the fixture path for smoke tests and the
+    serve benchmark, which need a servable checkpoint without training."""
+    obs_space, actions_dim, is_continuous = spaces_from_config(cfg)
+    runtime = Runtime(
+        accelerator=cfg.fabric.get("accelerator", "auto"), devices=1, precision=cfg.fabric.precision
+    )
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+
+    _, params, _ = build_agent(runtime, actions_dim, is_continuous, cfg, obs_space, None)
+    return {"agent": jax.device_get(params)}
+
+
+class PolicyEngine:
+    def __init__(
+        self,
+        cfg: Any,
+        state: Dict[str, Any],
+        *,
+        source: str = "boot",
+        boot_info: Optional[Dict[str, Any]] = None,
+    ):
+        if cfg.algo.name not in SUPPORTED_ALGOS:
+            raise ServeError(
+                f"serving is implemented for {SUPPORTED_ALGOS}, not '{cfg.algo.name}' "
+                "(recurrent/model-based players need per-session state)"
+            )
+        if "agent" not in state:
+            raise ServeError("checkpoint state carries no 'agent' params")
+        self.cfg = cfg
+        self.sv = resolve(cfg)
+        max_batch = int(self.sv.batch.max_size)
+        if jax_compile.pow2_bucket(max_batch) != max_batch:
+            raise ServeError(f"serve.batch.max_size must be a power of two, got {max_batch}")
+        self.max_batch = max_batch
+        self.buckets: List[int] = []
+        b = 1
+        while b <= max_batch:
+            self.buckets.append(b)
+            b *= 2
+        self.greedy = bool(self.sv.policy.greedy)
+
+        self.runtime = Runtime(
+            accelerator=cfg.fabric.get("accelerator", "auto"), devices=1, precision=cfg.fabric.precision
+        )
+        obs_space, actions_dim, is_continuous = spaces_from_config(cfg)
+
+        from sheeprl_tpu.algos.ppo.agent import build_agent
+
+        _, _, self.player = build_agent(
+            self.runtime, actions_dim, is_continuous, cfg, obs_space, state["agent"]
+        )
+        self.actions_dim = actions_dim
+        self.is_continuous = is_continuous
+        self.obs_shapes: Dict[str, Tuple[int, ...]] = {
+            k: tuple(obs_space[k].shape)
+            for k in list(cfg.algo.cnn_keys.encoder) + list(cfg.algo.mlp_keys.encoder)
+        }
+        self._gfn = self.player._greedy_raw if self.greedy else self.player._act_raw
+        self._key = jax.random.PRNGKey(cfg.seed)
+        self._key_lock = threading.Lock()
+        # boot_info is the certified sidecar of the boot checkpoint (when there
+        # is one): stamping its crc here lets the hot-reloader recognise the
+        # already-serving artifact instead of re-loading it as generation 2
+        boot_info = boot_info or {}
+        self.boot_generation = Generation(
+            gen_id=1,
+            params=self.player.params,
+            source=source,
+            step=boot_info.get("policy_step", boot_info.get("step")),
+            crc32=boot_info.get("crc32"),
+            loaded_at=time.time(),
+        )
+
+    # ----- generations ---------------------------------------------------------------
+    def make_generation(
+        self, state: Dict[str, Any], gen_id: int, source: str, info: Optional[Dict[str, Any]] = None
+    ) -> Generation:
+        """Place a checkpoint's agent params on the player device as a fresh
+        immutable generation (same placement as build_agent's player copy)."""
+        if "agent" not in state:
+            raise ServeError(f"checkpoint state from '{source}' carries no 'agent' params")
+        params = jax.tree_util.tree_map(jnp.asarray, state["agent"])
+        params = self.runtime.to_player(params)
+        info = info or {}
+        return Generation(
+            gen_id=gen_id,
+            params=params,
+            source=source,
+            step=info.get("policy_step", info.get("step")),
+            crc32=info.get("crc32"),
+            loaded_at=time.time(),
+        )
+
+    # ----- obs handling --------------------------------------------------------------
+    def coerce_obs(self, obs: Any) -> Dict[str, np.ndarray]:
+        """Validate + convert one request's obs payload to the canonical f32
+        layout. Raising HERE (pre-admission) keeps a malformed request from
+        poisoning the whole batch it would have ridden in."""
+        if not isinstance(obs, dict):
+            raise ValueError(f"obs must be a dict of per-key arrays, got {type(obs).__name__}")
+        out: Dict[str, np.ndarray] = {}
+        for k, shape in self.obs_shapes.items():
+            if k not in obs:
+                raise ValueError(f"obs is missing key '{k}'")
+            arr = np.asarray(obs[k], dtype=np.float32)
+            if arr.shape != shape:
+                try:
+                    arr = arr.reshape(shape)
+                except ValueError:
+                    raise ValueError(f"obs['{k}'] has shape {arr.shape}, expected {shape}") from None
+            out[k] = arr
+        return out
+
+    def _batch_specs(self, bucket: int) -> Tuple[Any, Dict[str, Any], Any]:
+        obs_spec = {
+            k: jax.ShapeDtypeStruct((bucket, *shape), np.float32) for k, shape in self.obs_shapes.items()
+        }
+        params_spec = jax_compile.specs_of(self.player.params)
+        key_spec = jax_compile.spec_like(jax.random.PRNGKey(0))
+        return params_spec, obs_spec, key_spec
+
+    # ----- warmup / readiness --------------------------------------------------------
+    def register_warmup(self, warmup: jax_compile.AOTWarmup) -> None:
+        """Queue one AOT compile per bucket (signature is generation-invariant,
+        so warming once at boot covers every future hot-reload)."""
+        for b in self.buckets:
+            warmup.add(self._gfn, *self._batch_specs(b))
+
+    def warm_boot(self, wait_s: float = 600.0) -> None:
+        """Foreground bucket warmup + steady-state watermark: after this, any
+        retrace is a bug the guard reports (``Compile/retraces``)."""
+        warmup = jax_compile.AOTWarmup(enabled=True)
+        self.register_warmup(warmup)
+        warmup.start()
+        if not warmup.wait(wait_s):
+            raise ServeError(f"AOT warmup did not finish within {wait_s}s")
+        if warmup.errors:
+            name, err = warmup.errors[0]
+            raise ServeError(f"AOT warmup of '{name}' failed: {type(err).__name__}: {err}")
+        jax_compile.mark_steady()
+
+    def warm_sync(self) -> None:
+        """Compile any bucket not yet AOT-ready (reload path; normally a no-op
+        because generations share one abstract signature)."""
+        for b in self.buckets:
+            specs = self._batch_specs(b)
+            if not self._gfn.aot_ready(*specs):
+                self._gfn.aot_compile(*specs)
+
+    def ready(self) -> bool:
+        return all(self._gfn.aot_ready(*self._batch_specs(b)) for b in self.buckets)
+
+    # ----- inference -----------------------------------------------------------------
+    def act(self, params: Any, obs_rows: List[Dict[str, np.ndarray]]) -> np.ndarray:
+        """Batched act: stack rows, pad to the pow-2 bucket, one fused dispatch,
+        slice the padding back off. Returns ``[n, act_dim]`` host actions."""
+        n = len(obs_rows)
+        if n == 0:
+            return np.zeros((0, len(self.actions_dim)), dtype=np.float32)
+        if n > self.max_batch:
+            raise ValueError(f"batch of {n} exceeds serve.batch.max_size={self.max_batch}")
+        bucket = jax_compile.pow2_bucket(n)
+        batch = {k: np.zeros((bucket, *shape), dtype=np.float32) for k, shape in self.obs_shapes.items()}
+        for i, row in enumerate(obs_rows):
+            for k in self.obs_shapes:
+                batch[k][i] = row[k]
+        with self._key_lock:
+            key, self._key = jax.random.split(self._key)
+        if self.greedy:
+            env_actions, _ = self._gfn(params, batch, key)
+        else:
+            _, env_actions, _, _, _ = self._gfn(params, batch, key)
+        return np.asarray(env_actions)[:n]
+
+    def canary(self, params: Any) -> Dict[str, Any]:
+        """One zero-obs batch through the REAL serving path: catches params
+        whose executable dispatch wedges or whose outputs are non-finite
+        before (or just after) they start answering traffic."""
+        zeros = [{k: np.zeros(shape, dtype=np.float32) for k, shape in self.obs_shapes.items()}]
+        actions = self.act(params, zeros)
+        if actions.shape[0] != 1:
+            raise ServeError(f"canary returned {actions.shape[0]} rows for 1 request")
+        if not np.all(np.isfinite(actions)):
+            raise ServeError(f"canary produced non-finite actions: {actions.tolist()}")
+        return {"action_dim": int(actions.shape[-1])}

@@ -1,0 +1,125 @@
+"""Certified hot-reload: watch -> load -> warm -> swap -> canary -> (rollback).
+
+The watcher polls :func:`~sheeprl_tpu.utils.checkpoint.latest_certified` over
+the run's checkpoint dir. Only CERTIFIED artifacts are ever considered — a
+half-written checkpoint, a sidecar whose checkpoint was deleted, or a
+same-size overwrite all fail certification and are invisible here, so the
+trainer can keep writing into the dir the server watches.
+
+A successful scan builds the next :class:`Generation` entirely OFF the serving
+path (load, device placement, AOT warm), swaps the store reference atomically
+(in-flight batches hold their own generation and finish on the old weights),
+then runs a post-swap canary through the real serving path. A canary failure
+swaps the PREVIOUS generation back (``Serve/reload_rollbacks``); a failure
+anywhere earlier leaves the current generation untouched
+(``Serve/reload_failures``). ``reload.degraded_after`` consecutive failures
+latch the degraded gauge: the server keeps answering from the last-known-good
+generation and says so in its health surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from sheeprl_tpu.serve.engine import PolicyEngine, GenerationStore
+from sheeprl_tpu.serve.stats import ServeStats
+from sheeprl_tpu.utils.checkpoint import certified_info, latest_certified, load_state
+
+_logger = logging.getLogger(__name__)
+
+
+class HotReloader(threading.Thread):
+    def __init__(
+        self,
+        engine: PolicyEngine,
+        store: GenerationStore,
+        ckpt_dir: str,
+        stats: ServeStats,
+        *,
+        poll_s: float = 1.0,
+        canary: bool = True,
+        degraded_after: int = 3,
+    ):
+        super().__init__(name="sheeprl-serve-reload", daemon=True)
+        self.engine = engine
+        self.store = store
+        self.ckpt_dir = ckpt_dir
+        self.stats = stats
+        self.poll_s = float(poll_s)
+        self.canary = bool(canary)
+        self.degraded_after = int(degraded_after)
+        self.consecutive_failures = 0
+        self._stop = threading.Event()
+        # identity of the artifact the CURRENT generation came from: path alone
+        # is not enough (the trainer may legitimately re-certify new bytes
+        # under the same filename), so track (path, crc) together
+        boot = store.get()
+        self._loaded: tuple = (boot.source if boot else None, boot.crc32 if boot else None)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.scan_once()
+            except Exception:  # scan_once accounts its own failures; belt and braces
+                _logger.exception("[serve] reload scan crashed")
+
+    def scan_once(self) -> Optional[int]:
+        """One watch tick. Returns the new generation id on swap, else None."""
+        path = latest_certified(self.ckpt_dir)
+        if path is None:
+            return None
+        # certified_info re-validates size+CRC: a sidecar appearing mid-scan
+        # for a checkpoint that has since been deleted or overwritten reads as
+        # not-certified and is skipped, not crashed on
+        info = certified_info(path)
+        if info is None:
+            return None
+        if (path, info.get("crc32")) == self._loaded:
+            return None
+        cur = self.store.get()
+        try:
+            state = load_state(path, fallback_to_older=False)
+            gen = self.engine.make_generation(state, (cur.gen_id if cur else 0) + 1, path, info)
+            self.engine.warm_sync()  # no-op unless a bucket lost its executable
+        except Exception as e:
+            self._record_failure(path, e)
+            return None
+        prev = self.store.swap(gen)
+        if self.canary:
+            try:
+                self.engine.canary(gen.params)
+            except Exception as e:
+                # post-swap canary failed: put the last-known-good generation
+                # back before anything beyond the canary touched the new one
+                self.store.swap(prev)
+                self.stats.inc("reload_rollbacks")
+                self._record_failure(path, e)
+                return None
+        self._loaded = (path, info.get("crc32"))
+        self.consecutive_failures = 0
+        self.stats.inc("reload_generations")
+        self.stats.set_gauge("generation", gen.gen_id)
+        self.stats.set_gauge("degraded", 0)
+        _logger.info(
+            "[serve] hot-reloaded generation %d from %s (step=%s)", gen.gen_id, path, gen.step
+        )
+        return gen.gen_id
+
+    def _record_failure(self, path: str, err: BaseException) -> None:
+        self.stats.inc("reload_failures")
+        self.consecutive_failures += 1
+        if self.consecutive_failures >= self.degraded_after:
+            # the swap path is wedged: keep serving last-known-good, say so
+            self.stats.set_gauge("degraded", 1)
+        _logger.warning(
+            "[serve] reload of %s failed (%s: %s); serving generation %s unchanged",
+            path,
+            type(err).__name__,
+            err,
+            self.store.gen_id,
+        )

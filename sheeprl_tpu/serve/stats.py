@@ -1,0 +1,87 @@
+"""``Serve/*`` observability: counters, gauges and a latency window.
+
+One lock-guarded object shared by the frontend, the batcher and the reloader.
+The snapshot is the single source of truth for the accounting invariant the
+chaos drill asserts: every admitted request resolves to exactly one of
+``ok | shed | rejected | deadline_missed | error``, so
+``requests_total == ok + shed + rejected + deadline_missed + errors`` must
+hold at any quiescent point.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Deque, Dict
+
+COUNTERS = (
+    "requests_total",
+    "ok",
+    "shed",
+    "rejected",
+    "deadline_missed",
+    "errors",
+    "batches",
+    "reload_generations",
+    "reload_failures",
+    "reload_rollbacks",
+)
+
+GAUGES = ("queue_depth", "queue_peak", "generation", "degraded", "ready", "draining")
+
+
+class ServeStats:
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {k: 0 for k in COUNTERS}
+        self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}
+        # windowed reservoir: p50/p99 over the LAST N served requests, not the
+        # lifetime mean — load tests care about current-tail behaviour
+        self._latencies: Deque[float] = deque(maxlen=int(latency_window))
+        self._occupancy_sum = 0.0
+        self._occupancy_n = 0
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counts[name] += int(n)
+
+    def set_gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._gauges["queue_depth"] = float(depth)
+            if depth > self._gauges["queue_peak"]:
+                self._gauges["queue_peak"] = float(depth)
+
+    def observe_batch(self, n_live: int, bucket: int) -> None:
+        with self._lock:
+            self._counts["batches"] += 1
+            self._occupancy_sum += n_live / max(bucket, 1)
+            self._occupancy_n += 1
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies.append(float(seconds))
+
+    @staticmethod
+    def _percentile(sorted_vals, q: float) -> float:
+        if not sorted_vals:
+            return 0.0
+        idx = min(int(q * (len(sorted_vals) - 1) + 0.5), len(sorted_vals) - 1)
+        return sorted_vals[idx]
+
+    def snapshot(self) -> Dict[str, Any]:
+        """``Serve/*``-keyed dict (counters, gauges, occupancy, p50/p99 ms)."""
+        with self._lock:
+            counts = dict(self._counts)
+            gauges = dict(self._gauges)
+            lat = sorted(self._latencies)
+            occ = self._occupancy_sum / self._occupancy_n if self._occupancy_n else 0.0
+        out: Dict[str, Any] = {f"Serve/{k}": v for k, v in counts.items()}
+        out.update({f"Serve/{k}": v for k, v in gauges.items()})
+        out["Serve/batch_occupancy"] = occ
+        out["Serve/latency_p50_ms"] = self._percentile(lat, 0.50) * 1000.0
+        out["Serve/latency_p99_ms"] = self._percentile(lat, 0.99) * 1000.0
+        return out

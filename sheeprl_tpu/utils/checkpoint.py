@@ -313,6 +313,24 @@ def is_certified(path: str) -> bool:
     return os.path.exists(path)
 
 
+def certified_info(path: str) -> Optional[Dict[str, Any]]:
+    """The parsed certification-sidecar payload for ``path``, but only when
+    :func:`is_certified` still vouches for the bytes on disk (size + footer CRC
+    agree); None otherwise. The serve hot-reloader stamps each weight
+    generation with this (step, crc32) so ``Serve/*`` stats and responses can
+    attribute an action to the exact certified artifact that produced it."""
+    import json
+
+    if not is_certified(path):
+        return None
+    try:
+        with open(certified_sidecar(path)) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
 def ckpt_sort_key(path: str) -> Tuple[float, int, str]:
     """Total order for sibling checkpoints: (mtime, step-parsed-from-name,
     basename). Filesystems with coarse mtime granularity (or a burst of

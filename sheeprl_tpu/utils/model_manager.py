@@ -314,6 +314,44 @@ class LocalModelManager:
         with open(os.path.join(versions[version], "model.pkl"), "rb") as f:
             return pickle.load(f)
 
+    def save_version_config(self, model_name: str, version: int, cfg: Any) -> str:
+        """Store the run config that produced a version next to its weights.
+
+        A registered pytree alone cannot be served: rebuilding the agent needs
+        the run's algo/env config (encoder keys, action space, network sizes).
+        The registration flow calls this so ``sheeprl-serve model_name=...``
+        can boot a version by name with no checkpoint dir in sight."""
+        import yaml
+
+        versions = self._versions(model_name)
+        if version not in versions:
+            raise ValueError(f"Model '{model_name}' has no version {version}")
+        path = os.path.join(versions[version], "config.yaml")
+        plain = cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)
+        with open(path, "w") as f:
+            yaml.safe_dump(plain, f)
+        return path
+
+    def load_version_config(self, model_name: str, version: Optional[int] = None) -> Any:
+        """The run config stored by :meth:`save_version_config` as a dotdict."""
+        import yaml
+
+        from sheeprl_tpu.utils.utils import dotdict
+
+        if version is None:
+            version = self.get_latest_version(model_name).version
+        versions = self._versions(model_name)
+        if version not in versions:
+            raise ValueError(f"Model '{model_name}' has no version {version}")
+        path = os.path.join(versions[version], "config.yaml")
+        if not os.path.isfile(path):
+            raise FileNotFoundError(
+                f"Version v{version} of '{model_name}' has no stored run config (registered "
+                "by an older build?); re-register the checkpoint or serve it by checkpoint_path"
+            )
+        with open(path) as f:
+            return dotdict(yaml.safe_load(f))
+
 
 class MlflowModelManager:
     """MLflow-registry backend with the same surface as :class:`LocalModelManager`
@@ -486,6 +524,8 @@ def register_model_from_checkpoint(
                 cfg_model.get("description"),
                 cfg_model.get("tags"),
             )
+            if hasattr(manager, "save_version_config"):  # local backend: serve-by-name
+                manager.save_version_config(registered[k].name, registered[k].version, cfg)
         return registered
     finally:
         runtime.log_dir = prev_log_dir

@@ -156,3 +156,98 @@ def test_register_best_models_real_run_layout(manager, tmp_path):
     out = manager.register_best_models(str(exp), {"agent"})
     assert set(out) == {"agent"}
     assert np.allclose(manager.load_model("agent")["w"], 7.0)
+
+
+def test_version_config_roundtrip(manager, tmp_path):
+    """Serving a registry version by name needs the run config stored next to
+    the weights (sheeprl-serve model_name=...)."""
+    from sheeprl_tpu.utils.utils import dotdict
+
+    art = _artifact(tmp_path)
+    v1 = manager.register_model(art, "m")
+    manager.register_model(art, "m")
+    cfg = dotdict({"algo": {"name": "ppo"}, "seed": 7})
+    path = manager.save_version_config("m", v1.version, cfg)
+    assert os.path.isfile(path)
+    loaded = manager.load_version_config("m", v1.version)
+    assert loaded.algo.name == "ppo"
+    assert loaded.seed == 7
+    # v2 was registered without a config: actionable failure, not a silent None
+    with pytest.raises(FileNotFoundError, match="checkpoint_path"):
+        manager.load_version_config("m", 2)
+    with pytest.raises(ValueError, match="no version 99"):
+        manager.save_version_config("m", 99, cfg)
+
+
+def test_registration_cli_stores_version_config(standard_args, tmp_path, monkeypatch):
+    """The registration flow must leave each version servable by name: weights
+    AND the producing run config."""
+    from sheeprl_tpu.cli import registration, run
+
+    monkeypatch.chdir(tmp_path)
+    run(
+        overrides=standard_args
+        + [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "fabric.devices=1",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=2",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.dense_units=8",
+            "algo.mlp_layers=1",
+            "buffer.memmap=False",
+            "env.num_envs=1",
+            "checkpoint.save_last=True",
+        ]
+    )
+    ckpts = []
+    for root, _, files in os.walk(tmp_path / "logs"):
+        ckpts += [os.path.join(root, f) for f in files if f.endswith(".ckpt")]
+    registry = tmp_path / "registry"
+    registration(overrides=[f"checkpoint_path={ckpts[0]}", f"model_manager.registry_dir={registry}"])
+    name = os.listdir(registry)[0]
+    assert (registry / name / "v1" / "config.yaml").is_file()
+    mgr = LocalModelManager(_FakeRuntime(), str(registry))
+    cfg = mgr.load_version_config(name)
+    assert cfg.algo.name == "ppo"
+    assert "state" in cfg.algo.mlp_keys.encoder
+
+
+def test_evaluation_prefers_certified_sibling(tmp_path, monkeypatch):
+    """evaluation() must evaluate the certified sibling when the requested
+    checkpoint is uncertified, and honor prefer_certified=False."""
+    import warnings
+
+    import yaml
+
+    from sheeprl_tpu import cli
+    from sheeprl_tpu.utils.checkpoint import certify, save_state
+
+    run_dir = tmp_path / "run"
+    ckpt_dir = run_dir / "checkpoint"
+    os.makedirs(ckpt_dir)
+    with open(run_dir / "config.yaml", "w") as f:
+        yaml.safe_dump({"env": {"num_envs": 4, "capture_video": False}, "fabric": {"devices": 2}}, f)
+    good = str(ckpt_dir / "ckpt_100_0.ckpt")
+    info = save_state(good, {"agent": {"w": 1.0}})
+    certify(good, crc32=info.get("crc32"), size=info.get("size"))
+    bad = str(ckpt_dir / "ckpt_200_0.ckpt")
+    save_state(bad, {"agent": {"w": 2.0}})  # newer but uncertified
+
+    seen = {}
+    monkeypatch.setattr(cli, "check_configs_evaluation", lambda cfg: None)
+    monkeypatch.setattr(cli, "eval_algorithm", lambda cfg: seen.update(ckpt=cfg.checkpoint_path))
+    with pytest.warns(UserWarning, match="not certified"):
+        cli.evaluation(overrides=[f"checkpoint_path={bad}"])
+    assert seen["ckpt"] == good
+    # the literal (certified) path needs no redirect and no warning
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        cli.evaluation(overrides=[f"checkpoint_path={good}"])
+    assert seen["ckpt"] == good
+    # opting out pins the literal uncertified path
+    cli.evaluation(overrides=[f"checkpoint_path={bad}", "prefer_certified=False"])
+    assert seen["ckpt"] == bad

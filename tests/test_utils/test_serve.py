@@ -1,0 +1,404 @@
+"""Unit tests for the policy-serving runtime (sheeprl_tpu/serve): micro-batcher
+admission/backpressure/drain semantics, generation-swap atomicity under
+concurrent load, and the hot-reloader's certified-sidecar edge cases (sidecar
+appearing mid-scan, sidecar whose checkpoint was deleted, canary-failure
+rollback). Everything here runs against fakes or tiny real checkpoints — the
+full server + subprocess chaos drill lives in test_serve_smoke.py."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from sheeprl_tpu.serve import resolve
+from sheeprl_tpu.serve.batcher import MicroBatcher
+from sheeprl_tpu.serve.engine import Generation, GenerationStore
+from sheeprl_tpu.serve.reload import HotReloader
+from sheeprl_tpu.serve.stats import ServeStats
+
+
+def _echo_compute(requests):
+    return [{"echo": r.obs} for r in requests]
+
+
+def _make_batcher(stats=None, **kw):
+    defaults = dict(max_batch=4, max_wait_s=0.005, max_depth=8, stats=stats or ServeStats())
+    defaults.update(kw)
+    return MicroBatcher(_echo_compute, **defaults)
+
+
+def _counter_sum(snap):
+    return (
+        snap["Serve/ok"]
+        + snap["Serve/shed"]
+        + snap["Serve/rejected"]
+        + snap["Serve/deadline_missed"]
+        + snap["Serve/errors"]
+    )
+
+
+# --------------------------------------------------------------------------- config
+def test_resolve_fills_defaults_for_absent_group():
+    # sidecar configs recorded before the serve subsystem existed still serve
+    sv = resolve({})
+    assert sv.batch.max_size == 16
+    assert sv.queue.admission == "reject"
+    assert sv.reload.enabled is True
+
+
+def test_resolve_keeps_partial_overrides():
+    sv = resolve({"serve": {"queue": {"admission": "shed_oldest"}}})
+    assert sv.queue.admission == "shed_oldest"
+    assert sv.queue.max_depth == 128  # sibling default still filled
+
+
+# --------------------------------------------------------------------------- batcher
+def test_batcher_serves_and_accounts():
+    stats = ServeStats()
+    b = _make_batcher(stats, max_depth=32).start()
+    try:
+        futs = [b.submit({"i": i}, rid=i) for i in range(10)]
+        results = [f.result(timeout=5) for f in futs]
+        assert all(r["status"] == "ok" for r in results)
+        assert [r["id"] for r in results] == list(range(10))
+    finally:
+        b.close()
+    snap = stats.snapshot()
+    assert snap["Serve/requests_total"] == 10
+    assert snap["Serve/ok"] == 10
+    assert _counter_sum(snap) == snap["Serve/requests_total"]
+
+
+def test_batcher_reject_admission_past_max_depth():
+    stats = ServeStats()
+    hold = threading.Event()
+
+    def slow_compute(requests):
+        hold.wait(5)
+        return [{} for _ in requests]
+
+    b = MicroBatcher(slow_compute, max_batch=1, max_wait_s=0.0, max_depth=2, stats=stats).start()
+    try:
+        futs = [b.submit({"i": i}, rid=i) for i in range(8)]
+        # with compute blocked, at most 1 in flight + 2 queued are admitted
+        rejected = [f.result(timeout=5) for f in futs if f.done() and f.result()["status"] == "rejected"]
+        assert rejected, "expected rejections past max_depth"
+        assert all(r["retry_after_ms"] > 0 for r in rejected)
+        hold.set()
+        statuses = {f.result(timeout=5)["status"] for f in futs}
+        assert statuses == {"ok", "rejected"}
+    finally:
+        hold.set()
+        b.close()
+    snap = stats.snapshot()
+    assert snap["Serve/rejected"] > 0
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 8
+
+
+def test_batcher_shed_oldest_admission():
+    stats = ServeStats()
+    hold = threading.Event()
+
+    def slow_compute(requests):
+        hold.wait(5)
+        return [{} for _ in requests]
+
+    b = MicroBatcher(
+        slow_compute, max_batch=1, max_wait_s=0.0, max_depth=2, admission="shed_oldest", stats=stats
+    ).start()
+    try:
+        futs = [b.submit({"i": i}, rid=i) for i in range(8)]
+        shed = [f.result(timeout=1) for f in futs if f.done() and f.result()["status"] == "shed"]
+        assert shed, "expected oldest-queued requests to be shed"
+        # freshest observations win: the shed ids are strictly older than the
+        # ids still waiting in the queue
+        hold.set()
+        final = [f.result(timeout=5) for f in futs]
+        ok_ids = [r["id"] for r in final if r["status"] == "ok"]
+        shed_ids = [r["id"] for r in final if r["status"] == "shed"]
+        assert max(shed_ids) < max(ok_ids)
+    finally:
+        hold.set()
+        b.close()
+    snap = stats.snapshot()
+    assert snap["Serve/shed"] > 0
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 8
+
+
+def test_batcher_expired_deadline_dropped_before_compute():
+    stats = ServeStats()
+    computed = []
+
+    def recording_compute(requests):
+        computed.extend(r.rid for r in requests)
+        return [{} for _ in requests]
+
+    b = MicroBatcher(recording_compute, max_batch=4, max_wait_s=0.05, max_depth=8, stats=stats)
+    fut_dead = b.submit({"x": 1}, deadline_s=0.001, rid="dead")
+    fut_live = b.submit({"x": 2}, deadline_s=30.0, rid="live")
+    time.sleep(0.02)  # let the deadline lapse BEFORE the worker starts
+    b.start()
+    try:
+        assert fut_dead.result(timeout=5)["status"] == "deadline_expired"
+        assert fut_live.result(timeout=5)["status"] == "ok"
+        assert computed == ["live"]  # no compute spent on dead work
+    finally:
+        b.close()
+    snap = stats.snapshot()
+    assert snap["Serve/deadline_missed"] == 1
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 2
+
+
+def test_batcher_compute_failure_fails_batch_not_server():
+    stats = ServeStats()
+
+    def broken_compute(requests):
+        raise RuntimeError("device wedged")
+
+    b = MicroBatcher(broken_compute, max_batch=4, max_wait_s=0.005, max_depth=8, stats=stats).start()
+    try:
+        r = b.submit({"x": 1}, rid="a").result(timeout=5)
+        assert r["status"] == "error"
+        assert "device wedged" in r["error"]
+        # the worker survived: a later batch still resolves
+        r2 = b.submit({"x": 2}, rid="b").result(timeout=5)
+        assert r2["status"] == "error"
+    finally:
+        b.close()
+    snap = stats.snapshot()
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 2
+
+
+def test_batcher_drain_serves_admitted_rejects_new():
+    stats = ServeStats()
+    b = _make_batcher(stats).start()
+    futs = [b.submit({"i": i}, rid=i) for i in range(4)]
+    assert b.drain(timeout=5) is True
+    late = b.submit({"i": 99}, rid=99).result(timeout=5)
+    assert late["status"] == "rejected"
+    assert late["reason"] == "draining"
+    assert all(f.result(timeout=5)["status"] == "ok" for f in futs)
+    b.close()
+    snap = stats.snapshot()
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 5
+
+
+def test_batcher_pow2_occupancy_observed():
+    stats = ServeStats()
+    b = _make_batcher(stats, max_wait_s=0.05).start()
+    try:
+        futs = [b.submit({"i": i}, rid=i) for i in range(3)]
+        [f.result(timeout=5) for f in futs]
+    finally:
+        b.close()
+    snap = stats.snapshot()
+    # 3 live requests pad onto the 4-bucket (or split across smaller buckets
+    # if the worker woke early); occupancy is live/bucket in (0, 1]
+    assert 0 < snap["Serve/batch_occupancy"] <= 1.0
+
+
+# --------------------------------------------------------------------------- generations
+def test_generation_store_swap_returns_previous():
+    g1 = Generation(gen_id=1, params="p1", source="a")
+    g2 = Generation(gen_id=2, params="p2", source="b")
+    store = GenerationStore(g1)
+    assert store.gen_id == 1
+    prev = store.swap(g2)
+    assert prev is g1
+    assert store.get() is g2
+    # rollback is just swapping the previous generation back
+    store.swap(prev)
+    assert store.gen_id == 1
+
+
+def test_generation_swap_never_tears_inflight_batches():
+    """A batch pins ONE generation for its whole lifetime: under a storm of
+    concurrent swaps, every response's (params tag, gen_id) pair must be
+    self-consistent — half-old/half-new reads would break the pairing."""
+    store = GenerationStore(Generation(gen_id=1, params="tag-1", source="boot"))
+    stop = threading.Event()
+
+    def swapper():
+        gid = 2
+        while not stop.is_set():
+            store.swap(Generation(gen_id=gid, params=f"tag-{gid}", source="swap"))
+            gid += 1
+            time.sleep(0.0005)
+
+    def pinned_compute(requests):
+        gen = store.get()  # ONE read pins the batch, exactly like PolicyServer._compute
+        time.sleep(0.002)  # hold the batch open across many swap opportunities
+        return [{"gen": gen.gen_id, "tag": gen.params} for _ in requests]
+
+    b = MicroBatcher(pinned_compute, max_batch=4, max_wait_s=0.001, max_depth=512).start()
+    t = threading.Thread(target=swapper, daemon=True)
+    t.start()
+    try:
+        futs = [b.submit({"i": i}, rid=i) for i in range(200)]
+        results = [f.result(timeout=30) for f in futs]
+    finally:
+        stop.set()
+        t.join(timeout=5)
+        b.close()
+    assert all(r["status"] == "ok" for r in results)
+    for r in results:
+        assert r["tag"] == f"tag-{r['gen']}", f"torn generation read: {r}"
+    assert len({r["gen"] for r in results}) > 1, "swaps never landed; the race was not exercised"
+
+
+# --------------------------------------------------------------------------- reloader
+class _FakeEngine:
+    """Just enough engine surface for HotReloader: records calls, optionally
+    fails warm-up or the canary."""
+
+    def __init__(self, fail_warm=False, fail_canary=False):
+        self.fail_warm = fail_warm
+        self.fail_canary = fail_canary
+        self.made = []
+        self.canaried = []
+
+    def make_generation(self, state, gen_id, source, info):
+        info = info or {}
+        gen = Generation(
+            gen_id=gen_id,
+            params=state["agent"],
+            source=source,
+            step=info.get("policy_step", info.get("step")),
+            crc32=info.get("crc32"),
+        )
+        self.made.append(gen)
+        return gen
+
+    def warm_sync(self):
+        if self.fail_warm:
+            raise RuntimeError("warmup wedged")
+
+    def canary(self, params):
+        self.canaried.append(params)
+        if self.fail_canary:
+            raise RuntimeError("non-finite canary")
+
+
+def _reloader(tmp_path, engine, store=None, **kw):
+    store = store or GenerationStore(Generation(gen_id=1, params="boot", source="boot"))
+    stats = ServeStats()
+    r = HotReloader(engine, store, str(tmp_path), stats, poll_s=60.0, **kw)
+    return r, store, stats
+
+
+def _write_certified(tmp_path, step, payload=None):
+    from sheeprl_tpu.utils.checkpoint import certify, save_state
+
+    path = os.path.join(str(tmp_path), f"ckpt_{step}_0.ckpt")
+    info = save_state(path, payload or {"agent": f"weights-{step}"})
+    certify(path, crc32=info.get("crc32"), size=info.get("size"), policy_step=step)
+    return path
+
+
+def test_reloader_swaps_newly_certified_checkpoint(tmp_path):
+    engine = _FakeEngine()
+    r, store, stats = _reloader(tmp_path, engine)
+    assert r.scan_once() is None  # empty dir: nothing to do
+    _write_certified(tmp_path, 100)
+    assert r.scan_once() == 2
+    assert store.gen_id == 2
+    assert store.get().step == 100  # policy_step from the sidecar rides along
+    assert stats.snapshot()["Serve/reload_generations"] == 1
+    # second scan of the SAME artifact is a no-op (identity = path + crc)
+    assert r.scan_once() is None
+    assert store.gen_id == 2
+
+
+def test_reloader_ignores_uncertified_and_midscan_sidecars(tmp_path):
+    """A sidecar appearing for a checkpoint that is half-written, deleted, or
+    overwritten must read as not-certified and be skipped, not crashed on."""
+    import json
+
+    from sheeprl_tpu.utils.checkpoint import certified_sidecar
+
+    engine = _FakeEngine()
+    r, store, _ = _reloader(tmp_path, engine)
+    # bare checkpoint without sidecar: invisible
+    from sheeprl_tpu.utils.checkpoint import save_state
+
+    bare = os.path.join(str(tmp_path), "ckpt_50_0.ckpt")
+    save_state(bare, {"agent": "uncertified"})
+    assert r.scan_once() is None
+    # sidecar whose checkpoint bytes were OVERWRITTEN after certification
+    # (mid-scan appearance): size/CRC mismatch -> skipped
+    path = _write_certified(tmp_path, 60)
+    with open(path, "wb") as f:
+        f.write(b"torn" * 100)
+    assert r.scan_once() is None
+    assert store.gen_id == 1
+    # sidecar whose checkpoint was DELETED: skipped, not crashed on
+    path2 = _write_certified(tmp_path, 70)
+    os.remove(path2)
+    assert r.scan_once() is None
+    assert store.gen_id == 1
+    # a fabricated sidecar pointing at nothing at all
+    ghost = certified_sidecar(os.path.join(str(tmp_path), "ckpt_80_0.ckpt"))
+    with open(ghost, "w") as f:
+        json.dump({"certified": True, "crc32": 1, "size": 1}, f)
+    assert r.scan_once() is None
+    assert store.gen_id == 1
+    assert engine.made == []  # nothing was ever loaded
+
+
+def test_reloader_warm_failure_keeps_current_generation(tmp_path):
+    engine = _FakeEngine(fail_warm=True)
+    r, store, stats = _reloader(tmp_path, engine, degraded_after=2)
+    _write_certified(tmp_path, 100)
+    assert r.scan_once() is None
+    assert store.gen_id == 1  # no swap on a warm failure
+    snap = stats.snapshot()
+    assert snap["Serve/reload_failures"] == 1
+    assert snap["Serve/degraded"] == 0.0  # below the latch threshold
+    assert r.scan_once() is None  # same artifact retried (identity never recorded)
+    assert stats.snapshot()["Serve/degraded"] == 1.0  # latched after 2 consecutive
+
+
+def test_reloader_canary_failure_rolls_back(tmp_path):
+    engine = _FakeEngine(fail_canary=True)
+    r, store, stats = _reloader(tmp_path, engine)
+    boot = store.get()
+    _write_certified(tmp_path, 100)
+    assert r.scan_once() is None
+    assert store.get() is boot  # the previous generation is back
+    snap = stats.snapshot()
+    assert snap["Serve/reload_rollbacks"] == 1
+    assert snap["Serve/reload_failures"] == 1
+    assert snap["Serve/reload_generations"] == 0
+
+
+def test_reloader_recovers_after_failures(tmp_path):
+    engine = _FakeEngine(fail_canary=True)
+    r, store, stats = _reloader(tmp_path, engine, degraded_after=1)
+    _write_certified(tmp_path, 100)
+    assert r.scan_once() is None
+    assert stats.snapshot()["Serve/degraded"] == 1.0
+    # the swap path un-wedges (e.g. the trainer certifies a healthy artifact)
+    engine.fail_canary = False
+    _write_certified(tmp_path, 200)
+    assert r.scan_once() == 2
+    snap = stats.snapshot()
+    assert snap["Serve/degraded"] == 0.0  # cleared on success
+    assert store.get().step == 200
+
+
+def test_reloader_skips_boot_artifact(tmp_path):
+    """The generation the server booted from must not be re-loaded as gen 2:
+    the boot sidecar's crc is stamped into the boot Generation."""
+    from sheeprl_tpu.utils.checkpoint import certified_info
+
+    path = _write_certified(tmp_path, 100)
+    info = certified_info(path)
+    store = GenerationStore(
+        Generation(gen_id=1, params="boot", source=path, crc32=info["crc32"])
+    )
+    engine = _FakeEngine()
+    r, store, _ = _reloader(tmp_path, engine, store=store)
+    assert r.scan_once() is None
+    assert store.gen_id == 1
+    assert engine.made == []
