@@ -419,6 +419,90 @@ def bench_orchestrate() -> dict:
     }
 
 
+def bench_transport(iters: int = 60, chunk_bytes: int = 8192) -> dict:
+    """Host control-plane drill: collective latency + chunk-stream throughput.
+
+    Runs a KVServer with two ControlPlane peers in-process (threads, real
+    sockets — the same path scripts/transport_smoke.py drills across
+    processes) and measures broadcast/barrier round-trips, the epoch-fenced
+    chunk stream clean, and the SAME stream again under a 10% deterministic
+    drop failpoint (``control.chunk_send:drop:prob=0.1;seed=7``) so the
+    retry/resend overhead is a number, not a hope. CPU-backend machinery
+    numbers — comparable across rounds, silent about the accelerator.
+    """
+    import threading
+
+    from sheeprl_tpu.core import failpoints
+    from sheeprl_tpu.parallel.control import ControlPlane, KVServer, SocketKV
+
+    server = KVServer()
+    server.start()
+    try:
+        p0 = ControlPlane(SocketKV(server.address), rank=0, world=2, scope="bench", timeout_ms=60_000)
+        p1 = ControlPlane(SocketKV(server.address), rank=1, world=2, scope="bench", timeout_ms=60_000)
+        payload = b"x" * chunk_bytes
+
+        def timed_pair(fn0, fn1, n):
+            samples = []
+
+            def side(fn):
+                fn()
+
+            for _ in range(n):
+                t0 = time.perf_counter()
+                t = threading.Thread(target=side, args=(fn1,))
+                t.start()
+                fn0()
+                t.join()
+                samples.append((time.perf_counter() - t0) * 1000.0)
+            samples.sort()
+            return samples[len(samples) // 2]
+
+        bcast_ms = timed_pair(
+            lambda: p0.broadcast_str("b", "v"), lambda: p1.broadcast_str("b"), iters
+        )
+        barrier_ms = timed_pair(lambda: p0.barrier("t"), lambda: p1.barrier("t"), iters)
+
+        def stream(channel, spec=None):
+            p0.begin_session(channel)
+            p1.adopt_epoch(channel)
+            resends0 = p0.counters["Resilience/chunk_resends"]
+
+            def send():
+                if spec:
+                    with failpoints.active(spec):
+                        for i in range(iters):
+                            p0.send_chunk(channel, i, payload)
+                else:
+                    for i in range(iters):
+                        p0.send_chunk(channel, i, payload)
+
+            t = threading.Thread(target=send)
+            t0 = time.perf_counter()
+            t.start()
+            for i in range(iters):
+                p1.recv_chunk(channel, i)
+            t.join()
+            wall = time.perf_counter() - t0
+            return wall, p0.counters["Resilience/chunk_resends"] - resends0
+
+        clean_wall, clean_resends = stream("clean")
+        drop_wall, drop_resends = stream("drop", "control.chunk_send:drop:prob=0.1;seed=7")
+        return {
+            "transport_broadcast_p50_ms": round(bcast_ms, 3),
+            "transport_barrier_p50_ms": round(barrier_ms, 3),
+            "transport_chunk_roundtrip_ms": round(clean_wall / iters * 1000.0, 3),
+            "transport_chunk_mb_per_s": round(iters * chunk_bytes / clean_wall / 1e6, 3),
+            "transport_clean_resends": clean_resends,
+            "transport_drop_resends": drop_resends,
+            "transport_drop_overhead_x": round(drop_wall / clean_wall, 3),
+            "transport_chunk_bytes": chunk_bytes,
+            "transport_iters": iters,
+        }
+    finally:
+        server.stop()
+
+
 def _serve_level(addr, obs: dict, qps: float, duration_s: float) -> dict:
     """One open-loop load level: send at the offered rate WITHOUT waiting for
     responses (a closed-loop client would never overrun the server, hiding the
@@ -561,6 +645,7 @@ def _target_metric(target: str) -> str:
         "health": "health_detection_latency_s",
         "orchestrate": "orchestrate_preempt_recovery_s",
         "serve": "serve_p99_ms",
+        "transport": "transport_chunk_roundtrip_ms",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -576,6 +661,7 @@ _METRIC_UNITS = {
     "health_detection_latency_s": "s",
     "orchestrate_preempt_recovery_s": "s",
     "serve_p99_ms": "ms",
+    "transport_chunk_roundtrip_ms": "ms",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
 
@@ -630,7 +716,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "all"),
+        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "transport", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -760,6 +846,15 @@ if __name__ == "__main__":
                 result.update(sv)
                 result.setdefault("metric", headline_metric)
                 result.setdefault("value", sv.get("serve_p99_ms"))
+                result.setdefault("unit", "ms")
+                result.setdefault("vs_baseline", None)
+            if cli_args.target == "transport":
+                # opt-in only: host control-plane latency/throughput drill
+                # (sockets + failpoints; no accelerator involved at all)
+                tr = bench_transport()
+                result.update(tr)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", tr.get("transport_chunk_roundtrip_ms"))
                 result.setdefault("unit", "ms")
                 result.setdefault("vs_baseline", None)
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):

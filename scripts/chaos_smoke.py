@@ -1,18 +1,19 @@
 #!/usr/bin/env python
-"""Chaos smoke: SIGTERM a ``bench.py --smoke`` run mid-iteration and prove the
+"""Chaos smoke: preempt a ``bench.py --smoke`` run mid-iteration and prove the
 fault-tolerant runtime end-to-end:
 
-1. launch ``python bench.py --smoke`` with ``SHEEPRL_PREEMPTION_READY_FILE``
-   set, and wait for the PreemptionGuard to touch that file (its signal
-   handlers are live from that point, so the SIGTERM below lands mid-iteration
-   instead of racing interpreter startup);
-2. deliver SIGTERM and assert the process still exits 0 (the guard converts the
-   signal into an end-of-iteration stop + emergency checkpoint; bench's
-   remaining pass runs normally and its one-JSON-line stdout contract holds);
+1. launch ``python bench.py --smoke`` with the deterministic failpoint
+   ``preempt.iteration:signal:SIGTERM:hit=N`` (core/failpoints.py): the child
+   delivers SIGTERM to ITSELF at the end of training iteration N, exactly
+   between iterations — no parent-side ready-file polling race, identical
+   injection point on every run and every machine;
+2. assert the process still exits 0 (the PreemptionGuard converts the signal
+   into an end-of-iteration stop + emergency checkpoint; bench's remaining
+   pass runs normally and its one-JSON-line stdout contract holds);
 3. assert the emergency checkpoint exists — bench smoke sets
    ``checkpoint.every=999999999`` and ``save_last=False``, so the ONLY ``.ckpt``
    on disk is the guard's emergency save;
-4. resume from it in a fresh process and assert exit 0.
+4. resume from it in a fresh process (failpoint NOT set) and assert exit 0.
 
 Run directly (``python scripts/chaos_smoke.py``) or through the registered
 tier-1 test (tests/test_utils/test_chaos_smoke.py). The companion rollback
@@ -26,11 +27,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import signal
 import subprocess
 import sys
 import tempfile
-import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -42,7 +41,7 @@ def _find_ckpts(root: str) -> list:
     return sorted(found)
 
 
-def main(workdir: str | None = None, timeout: float = 540.0) -> dict:
+def main(workdir: str | None = None, timeout: float = 540.0, preempt_at_iter: int = 2) -> dict:
     workdir = workdir or tempfile.mkdtemp(prefix="chaos_smoke_")
     os.makedirs(workdir, exist_ok=True)
     ready_file = os.path.join(workdir, "guard_ready")
@@ -50,6 +49,9 @@ def main(workdir: str | None = None, timeout: float = 540.0) -> dict:
         os.environ,
         JAX_PLATFORMS="cpu",
         SHEEPRL_PREEMPTION_READY_FILE=ready_file,
+        # self-preemption at a deterministic iteration boundary (the old
+        # parent-side SIGTERM raced process startup and iteration timing)
+        SHEEPRL_TPU_FAILPOINTS=f"preempt.iteration:signal:SIGTERM:hit={preempt_at_iter}",
     )
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--smoke"],
@@ -59,27 +61,20 @@ def main(workdir: str | None = None, timeout: float = 540.0) -> dict:
         stderr=subprocess.PIPE,
         text=True,
     )
-    deadline = time.time() + timeout
     try:
-        while not os.path.exists(ready_file):
-            if proc.poll() is not None:
-                _, err = proc.communicate()
-                raise SystemExit(
-                    f"bench exited (rc={proc.returncode}) before the preemption guard "
-                    f"armed; stderr tail:\n{err[-2000:]}"
-                )
-            if time.time() > deadline:
-                raise SystemExit("timed out waiting for the preemption guard to arm")
-            time.sleep(0.05)
-        proc.send_signal(signal.SIGTERM)
-        out, err = proc.communicate(timeout=max(deadline - time.time(), 1.0))
+        out, err = proc.communicate(timeout=timeout)
     except BaseException:
         proc.kill()
         raise
     if proc.returncode != 0:
         raise SystemExit(
-            f"SIGTERM'd bench run exited rc={proc.returncode} (expected a clean 0); "
+            f"self-preempted bench run exited rc={proc.returncode} (expected a clean 0); "
             f"stderr tail:\n{err[-2000:]}"
+        )
+    if not os.path.exists(ready_file):
+        raise SystemExit(
+            "the preemption guard never armed (ready file missing) — the failpoint "
+            "SIGTERM would have killed the process, yet it exited 0: injection did not run"
         )
     # bench's stdout contract: the LAST line is the one JSON result record
     last_line = out.strip().splitlines()[-1] if out.strip() else ""
@@ -87,8 +82,10 @@ def main(workdir: str | None = None, timeout: float = 540.0) -> dict:
 
     ckpts = _find_ckpts(os.path.join(workdir, "logs"))
     if not ckpts:
-        raise SystemExit("no emergency checkpoint found after SIGTERM")
+        raise SystemExit("no emergency checkpoint found after the injected preemption")
 
+    resume_env = dict(os.environ, JAX_PLATFORMS="cpu")
+    resume_env.pop("SHEEPRL_TPU_FAILPOINTS", None)  # resume runs fault-free
     resume = subprocess.run(
         [
             sys.executable,
@@ -106,7 +103,7 @@ def main(workdir: str | None = None, timeout: float = 540.0) -> dict:
             "checkpoint.every=999999999",
         ],
         cwd=workdir,
-        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        env=resume_env,
         capture_output=True,
         text=True,
         timeout=timeout,
@@ -123,5 +120,9 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument("--workdir", default=None, help="run directory (default: fresh tempdir)")
     parser.add_argument("--timeout", type=float, default=540.0, help="per-phase timeout in seconds")
-    result = main(parser.parse_args().workdir, parser.parse_args().timeout)
-    print(f"chaos smoke OK: SIGTERM -> clean exit -> resumable checkpoint {result['checkpoint']}")
+    parser.add_argument(
+        "--preempt-at-iter", type=int, default=2, help="iteration boundary for the injected SIGTERM"
+    )
+    args = parser.parse_args()
+    result = main(args.workdir, args.timeout, args.preempt_at_iter)
+    print(f"chaos smoke OK: injected preempt -> clean exit -> resumable checkpoint {result['checkpoint']}")

@@ -11,8 +11,11 @@ elastic orchestration end-to-end:
    requeued, and a SECOND controller incarnation resumes from the journal with
    no duplicated or lost trials;
 2. **slot preemptions** — the restarted controller injects >= 2 SIGTERM
-   preemptions into running trials; each victim checkpoints, requeues with
-   jittered backoff, and resumes from its own newest checkpoint;
+   preemptions into running trials on a deterministic tick schedule (the
+   ``orchestrate.inject`` fire-failpoint, ``every=10`` poll ticks — see
+   core/failpoints.py — replacing the old wall-clock spacing race); each
+   victim checkpoints, requeues with jittered backoff, and resumes from its
+   own newest checkpoint;
 3. **divergence -> resow** — the chaos trial's HealthSentinel records a
    divergence verdict in ``health/events.jsonl``; the controller kills the
    trial and resows it from the clean peer's newest *certified* checkpoint
@@ -124,6 +127,14 @@ _SPEC = {
 
 
 def _controller(spec_path: str, state_dir: str, inject: int, spacing: float) -> subprocess.Popen:
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)
+    if inject > 0:
+        # Deterministic injection clock: the controller's `orchestrate.inject`
+        # fire-failpoint triggers on every 10th eligible poll tick (2s of ticks
+        # at poll_interval_s=0.2) instead of racing wall-clock spacing against
+        # trial startup — same injection schedule on every run and machine.
+        env["SHEEPRL_TPU_FAILPOINTS"] = "orchestrate.inject:fire:every=10"
     return subprocess.Popen(
         [
             sys.executable,
@@ -139,7 +150,7 @@ def _controller(spec_path: str, state_dir: str, inject: int, spacing: float) -> 
             str(spacing),
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu")),
+        env=env,
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,

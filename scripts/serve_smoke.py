@@ -7,7 +7,11 @@
    concurrent closed-loop clients (unique request ids, retry on backpressure
    and connection loss);
 3. mid-load, certify a SECOND checkpoint generation and wait for responses
-   stamped with the new generation id — a hot-reload under traffic;
+   stamped with the new generation id — a hot-reload under traffic. Server A
+   carries a one-shot ``reload.canary:raise`` failpoint (core/failpoints.py),
+   so the first reload attempt deterministically fails its post-swap canary
+   and must roll back to generation 1 before the retry succeeds
+   (``Serve/reload_rollbacks >= 1`` is asserted at shutdown);
 4. SIGTERM the server under load: it must stop admitting (``rejected /
    draining`` — still a response), drain everything admitted, write a final
    stats snapshot, and exit 0;
@@ -122,7 +126,9 @@ def build_fixture(workdir: str) -> dict:
 
 
 # --------------------------------------------------------------------------- server
-def launch_server(fixture: dict, ready_file: str, stats_file: str, log_file: str, extra=()) -> subprocess.Popen:
+def launch_server(
+    fixture: dict, ready_file: str, stats_file: str, log_file: str, extra=(), env_extra=None
+) -> subprocess.Popen:
     cmd = [
         sys.executable,
         os.path.join(REPO_ROOT, "sheeprl_serve.py"),
@@ -133,10 +139,13 @@ def launch_server(fixture: dict, ready_file: str, stats_file: str, log_file: str
         *extra,
     ]
     log = open(log_file, "a")
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)  # drills opt in per server via env_extra
+    env.update(env_extra or {})
     return subprocess.Popen(
         cmd,
         cwd=os.path.dirname(fixture["run_dir"]),
-        env=dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu")),
+        env=env,
         stdout=log,
         stderr=subprocess.STDOUT,
     )
@@ -287,7 +296,18 @@ def main(workdir: str | None = None, timeout: float = 420.0) -> dict:
     rf1 = os.path.join(workdir, "ready1.json")
     sf1 = os.path.join(workdir, "stats1.json")
     log1 = os.path.join(workdir, "server1.log")
-    proc1 = launch_server(fixture, rf1, sf1, log1)
+    # Server A runs with a one-shot canary failpoint: the FIRST hot-reload
+    # attempt (the mid-load gen-2 certify below — boot is not a canary
+    # evaluation, its artifact is pre-marked loaded) must fail its canary,
+    # roll back to gen 1, then succeed on the next poll. Proves the full
+    # swap -> canary-fail -> rollback -> retry path under real traffic.
+    proc1 = launch_server(
+        fixture,
+        rf1,
+        sf1,
+        log1,
+        env_extra={"SHEEPRL_TPU_FAILPOINTS": "reload.canary:raise:injected-canary-drill:hit=1"},
+    )
     holder = {"addr": None}
     try:
         info = wait_ready(rf1, proc1, log1, timeout=min(240.0, timeout))
@@ -322,6 +342,11 @@ def main(workdir: str | None = None, timeout: float = 420.0) -> dict:
         if not stats1.get("drained"):
             raise SystemExit(f"server A did not report a clean drain: {stats1}")
         _audit_stats(stats1, "server A shutdown stats")
+        if stats1.get("Serve/reload_rollbacks", 0) < 1:
+            raise SystemExit(
+                "server A never rolled back: the injected canary failpoint did not fire "
+                f"(Serve/reload_rollbacks={stats1.get('Serve/reload_rollbacks')})"
+            )
 
         # phase 4: restart on the same checkpoint dir; the reloader must catch
         # the step-200 generation back up and traffic must resume

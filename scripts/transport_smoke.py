@@ -1,0 +1,272 @@
+#!/usr/bin/env python
+"""Transport smoke: two-process epoch-fenced chunk-transport drill.
+
+Proves the host control plane (sheeprl_tpu/parallel/control.py) delivers a
+chunk stream with **zero lost and zero duplicated chunks** while the failpoint
+registry (core/failpoints.py) injects every transport fault class:
+
+1. a parent process runs a :class:`KVServer` (the drill's stand-in for the jax
+   coordinator KV store) and spawns a **consumer** and a **player** child that
+   talk through :class:`SocketKV` — both children are jax-free;
+2. the phase-1 player sends chunks with ``control.chunk_send:drop:every=3``
+   (silently lost writes → ack-poll timeout → resend) and is then KILLED by a
+   ``transport.player_crash:kill`` failpoint mid-stream — a preemption with no
+   cleanup;
+3. the parent restarts the player. The new incarnation bumps the fenced
+   session epoch, reads the durable reader cursor, and resumes at exactly
+   ``cursor + 1``. Its sends run under ``control.chunk_send:corrupt`` (torn
+   payloads → CRC nack → resend) while the consumer delays its acks with a
+   ``control.kv_set:sleep`` failpoint;
+4. after the epoch bump, the parent forges a **zombie write** — a
+   well-formed, CRC-valid chunk stamped with the dead incarnation's epoch —
+   onto the next sequence number. The consumer must reject it against the
+   authoritative epoch key (``Resilience/stale_epoch_rejects >= 1``) and nack
+   ``stale``; the live writer must shrug off the foreign stale and resend;
+5. audit: the consumer's per-chunk CRCs equal the expected stream exactly
+   (order, count, content), the cursor ends at the last seq, the restarted
+   player resumed at the right offset in epoch 2 with at least one resend,
+   and the player's heartbeats made it visible to ``peer_liveness``.
+
+Run directly (``python scripts/transport_smoke.py``) or through the registered
+tier-1 test (tests/test_utils/test_transport_smoke.py). ``bench.py --target
+transport`` reuses the same KVServer/SocketKV pair for latency numbers.
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import subprocess
+import sys
+import time
+import zlib
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.parallel.control import ControlPlane, SocketKV  # noqa: E402
+
+CHANNEL = "roll"
+SCOPE = "transport_smoke"
+ROLE = "player"
+ZOMBIE_PAYLOAD = b"ZOMBIE-PAYLOAD-FROM-A-DEAD-INCARNATION"
+
+
+def _chunk_data(seq: int) -> bytes:
+    """Deterministic per-seq payload, big enough that corruption lands in the
+    b64 body and distinctive enough that a swap/dup is unmistakable."""
+    return (f"chunk-{seq:04d}|".encode() * 8) + bytes([seq % 256]) * 64
+
+
+def _expected_crcs(total: int) -> list:
+    return [zlib.crc32(_chunk_data(i)) & 0xFFFFFFFF for i in range(total)]
+
+
+# --------------------------------------------------------------------------- children
+def run_player(addr: str, total: int, resume: bool, hold_s: float) -> None:
+    from sheeprl_tpu.core import failpoints
+
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=0, world=2, scope=SCOPE, timeout_ms=30_000)
+    epoch = plane.begin_session(ROLE)
+    start = plane.chunk_cursor(CHANNEL) + 1 if resume else 0
+    if hold_s > 0:
+        # leave the parent a window to forge the zombie write AFTER our epoch
+        # bump but BEFORE our first envelope — the hardest fencing case
+        time.sleep(hold_s)
+    for seq in range(start, total):
+        plane.send_chunk(CHANNEL, seq, _chunk_data(seq), timeout_ms=30_000)
+        plane.heartbeat({"seq": seq})
+        # phase 1 dies here mid-stream via transport.player_crash:kill:...
+        failpoints.failpoint("transport.player_crash", seq=seq)
+    print(json.dumps({"role": "player", "epoch": epoch, "start": start, "counters": plane.counters}))
+
+
+def run_consumer(addr: str, total: int) -> None:
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=1, world=2, scope=SCOPE, timeout_ms=30_000)
+    plane.adopt_epoch(ROLE)
+    crcs = []
+    for seq in range(total):
+        data = plane.recv_chunk(CHANNEL, seq, timeout_ms=120_000)
+        crcs.append(zlib.crc32(data) & 0xFFFFFFFF)
+    liveness = plane.peer_liveness(max_age_s=60.0)
+    print(
+        json.dumps(
+            {
+                "role": "consumer",
+                "crcs": crcs,
+                "cursor": plane.chunk_cursor(CHANNEL),
+                "counters": plane.counters,
+                "player_alive": liveness.get(0, {}).get("alive"),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------- parent
+def _spawn(args: list, failpoints_spec: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)
+    if failpoints_spec:
+        env["SHEEPRL_TPU_FAILPOINTS"] = failpoints_spec
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _result(proc: subprocess.Popen, label: str, timeout: float) -> dict:
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        raise SystemExit(f"{label} hung; stdout:\n{out[-2000:]}\nstderr:\n{err[-2000:]}")
+    if proc.returncode != 0:
+        raise SystemExit(f"{label} exited rc={proc.returncode}; stderr tail:\n{err[-2000:]}")
+    last = out.strip().splitlines()[-1] if out.strip() else ""
+    try:
+        return json.loads(last)
+    except ValueError:
+        raise SystemExit(f"{label} printed no JSON result; stdout tail:\n{out[-2000:]}")
+
+
+def _poll(pred, timeout_s: float, what: str):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        got = pred()
+        if got is not None:
+            return got
+        time.sleep(0.01)
+    raise SystemExit(f"timed out after {timeout_s}s waiting for {what}")
+
+
+def main(total: int = 12, crash_after: int = 4, timeout: float = 300.0) -> dict:
+    from sheeprl_tpu.parallel.control import KVServer
+
+    if not 0 < crash_after < total:
+        raise SystemExit(f"crash_after must be in (0, {total}), got {crash_after}")
+    server = KVServer()
+    server.start()
+    kv = SocketKV(server.address)
+    plane = ControlPlane(kv, rank=99, world=2, scope=SCOPE)  # parent's key helper only
+    started = time.monotonic()
+    try:
+        consumer = _spawn(
+            ["--role", "consumer", "--addr", server.address, "--total", str(total)],
+            # delayed acks: the writer's ack-poll must tolerate a slow reader
+            "control.kv_set:sleep:0.05:every=5",
+        )
+
+        # phase 1: drops + a mid-stream kill after `crash_after` sent chunks
+        player1 = _spawn(
+            ["--role", "player", "--addr", server.address, "--total", str(total)],
+            f"control.chunk_send:drop:every=3,transport.player_crash:kill:9:hit={crash_after}",
+        )
+        p1_out, p1_err = player1.communicate(timeout=timeout)
+        if player1.returncode != 9:
+            raise SystemExit(
+                f"phase-1 player should die by its kill failpoint (rc 9), got rc="
+                f"{player1.returncode}; stderr tail:\n{p1_err[-2000:]}\nstdout:\n{p1_out[-500:]}"
+            )
+        cursor = _poll(
+            lambda: (lambda c: c if c >= crash_after - 1 else None)(plane.chunk_cursor(CHANNEL)),
+            30.0,
+            f"reader cursor to reach {crash_after - 1} after the player crash",
+        )
+
+        # phase 2: restart; new epoch, resume at cursor+1, torn payloads
+        player2 = _spawn(
+            [
+                "--role", "player", "--addr", server.address, "--total", str(total),
+                "--resume", "--hold-s", "1.2",
+            ],
+            "control.chunk_send:corrupt:2:every=4",
+        )
+        # zombie forge: wait for the successor's epoch bump, then write a
+        # CRC-valid envelope stamped with the DEAD epoch onto the next seq
+        epoch2 = _poll(
+            lambda: (lambda e: e if e is not None and int(e) >= 2 else None)(
+                kv.try_get(plane._epoch_key(ROLE), timeout_ms=50)
+            ),
+            30.0,
+            "the restarted player to bump the session epoch",
+        )
+        forged_seq = cursor + 1
+        data_key, _ = plane._chunk_keys(CHANNEL, forged_seq)
+        forged = (
+            f"1:{forged_seq}:{zlib.crc32(ZOMBIE_PAYLOAD) & 0xFFFFFFFF}:"
+            + base64.b64encode(ZOMBIE_PAYLOAD).decode()
+        )
+        kv.set(data_key, forged)
+
+        p2 = _result(player2, "phase-2 player", timeout)
+        cons = _result(consumer, "consumer", timeout)
+    finally:
+        server.stop()
+
+    # ---- audit ---------------------------------------------------------------
+    expected = _expected_crcs(total)
+    if cons["crcs"] != expected:
+        raise SystemExit(
+            f"chunk stream damaged: expected {total} chunks with CRCs {expected}, "
+            f"got {cons['crcs']} (zombie CRC is {zlib.crc32(ZOMBIE_PAYLOAD) & 0xFFFFFFFF})"
+        )
+    if cons["cursor"] != total - 1:
+        raise SystemExit(f"reader cursor ended at {cons['cursor']}, want {total - 1}")
+    stale_rejects = cons["counters"]["Resilience/stale_epoch_rejects"]
+    if stale_rejects < 1:
+        raise SystemExit("the forged zombie write was never rejected (stale_epoch_rejects=0)")
+    if p2["epoch"] != int(epoch2) or p2["start"] != crash_after:
+        raise SystemExit(
+            f"restart did not resume correctly: epoch={p2['epoch']} (want {epoch2}), "
+            f"start={p2['start']} (want {crash_after})"
+        )
+    if p2["counters"]["Resilience/chunk_resends"] < 1:
+        raise SystemExit("torn payloads never forced a resend — the corrupt failpoint did not bite")
+    if p2["counters"]["Resilience/heartbeats_sent"] < 1 or cons.get("player_alive") is not True:
+        raise SystemExit(f"player heartbeats not visible to peer_liveness: {cons.get('player_alive')}")
+
+    return {
+        "total_chunks": total,
+        "crash_after": crash_after,
+        "resumed_at": p2["start"],
+        "epochs": [1, p2["epoch"]],
+        "stale_epoch_rejects": stale_rejects,
+        "writer_resends": p2["counters"]["Resilience/chunk_resends"],
+        "consumer_counters": cons["counters"],
+        "wall_s": round(time.monotonic() - started, 2),
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--role", choices=["parent", "player", "consumer"], default="parent")
+    parser.add_argument("--addr", default=None, help="KV server address (child roles)")
+    parser.add_argument("--total", type=int, default=12, help="chunks in the stream")
+    parser.add_argument("--crash-after", type=int, default=4, help="sent chunks before the injected kill")
+    parser.add_argument("--resume", action="store_true", help="player: resume from the durable cursor")
+    parser.add_argument("--hold-s", type=float, default=0.0, help="player: pause between epoch bump and first send")
+    parser.add_argument("--timeout", type=float, default=300.0, help="parent: per-child budget in seconds")
+    cli = parser.parse_args()
+    if cli.role == "player":
+        run_player(cli.addr, cli.total, cli.resume, cli.hold_s)
+    elif cli.role == "consumer":
+        run_consumer(cli.addr, cli.total)
+    else:
+        result = main(cli.total, cli.crash_after, cli.timeout)
+        print(
+            "transport smoke OK: "
+            f"{result['total_chunks']} chunks across a mid-stream kill/restart "
+            f"(resumed at #{result['resumed_at']}, epochs {result['epochs']}), "
+            f"{result['stale_epoch_rejects']} zombie write(s) fenced, "
+            f"{result['writer_resends']} resend(s) under drops/torn payloads "
+            f"({result['wall_s']}s)"
+        )

@@ -481,6 +481,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
             env_deltas = resilience.drain_env_counters(envs, aggregator)
             jax_compile.drain_compile_counters(aggregator)
+            if transport is not None:  # KV retries / stale-epoch rejects / heartbeats into the same stream
+                env_deltas.update(resilience.drain_env_counters(transport, aggregator))
 
             if is_player:
                 # ----- health sentinel (warn-only in the decoupled split)

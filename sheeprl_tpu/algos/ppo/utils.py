@@ -30,6 +30,10 @@ AGGREGATOR_KEYS |= {
     "Compile/cache_misses",
     "Time/compile_seconds",
 }
+# Host control-plane counters (parallel/control.py), drained by the decoupled loop.
+from sheeprl_tpu.parallel.control import COUNTER_KEYS as _CONTROL_COUNTER_KEYS  # noqa: E402
+
+AGGREGATOR_KEYS |= set(_CONTROL_COUNTER_KEYS)
 MODELS_TO_REGISTER = {"agent"}
 
 

@@ -358,6 +358,8 @@ def main(runtime, cfg: Dict[str, Any]):
             if is_player:
                 # ----- health sentinel: warn -> backoff (grant above) -> rollback
                 env_deltas = resilience.drain_env_counters(envs, aggregator)
+                if transport is not None:
+                    env_deltas.update(resilience.drain_env_counters(transport, aggregator))
                 action = sentinel.observe(
                     policy_step,
                     train_metrics=host_metrics if "host_metrics" in dir() else None,

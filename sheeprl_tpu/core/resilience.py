@@ -40,6 +40,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import gymnasium as gym
 import numpy as np
 
+from sheeprl_tpu.core import failpoints
+
 # Env var naming a file the guard touches once its handlers are LIVE; the chaos
 # harness (scripts/chaos_smoke.py) polls it so its SIGTERM lands mid-iteration
 # instead of racing process startup.
@@ -250,6 +252,10 @@ class PreemptionGuard:
 
     def completed_iteration(self) -> None:
         self._completed += 1
+        # Drill site: `preempt.iteration:signal:SIGTERM:hit=N` delivers a real
+        # preemption signal at a DETERMINISTIC iteration (the chaos smoke's
+        # wall-clock SIGTERM races the loop; this lands between iterations).
+        failpoints.failpoint("preempt.iteration", iteration=self._completed)
         if self._stop_after is not None and self._completed >= self._stop_after:
             self._triggered = True
 
@@ -335,6 +341,10 @@ class WorkerSupervisor(gym.Wrapper):
 
     def step(self, action):
         try:
+            # Drill site: `env.step:raise::every=N` makes a worker "crash" on a
+            # deterministic schedule (inside the worker under AsyncVectorEnv),
+            # exercising rebuild/backoff/restart accounting without a flaky env.
+            failpoints.failpoint("env.step")
             return self.env.step(action)
         except Exception as err:
             self._rebuild(err)

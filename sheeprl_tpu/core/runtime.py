@@ -50,6 +50,23 @@ def _distributed_initialized() -> bool:
         return False
 
 
+def enable_cpu_collectives() -> None:
+    """Switch the CPU backend's cross-process collectives to gloo.
+
+    The default CPU client refuses multi-process computations outright
+    ("Multiprocess computations aren't implemented on the CPU backend"), which
+    kept every multihost code path untestable off-pod. Must run BEFORE the
+    backend initializes; a no-op on TPU/GPU platforms and on jax builds without
+    the option."""
+    plat = os.environ.get("JAX_PLATFORMS") or str(getattr(jax.config, "jax_platforms", "") or "")
+    if "cpu" not in plat.split(","):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - option absent on this jax build
+        pass
+
+
 def seed_everything(seed: int) -> int:
     """Seed python/numpy; JAX randomness is explicit via PRNG keys derived from the seed.
 
@@ -118,6 +135,7 @@ class Runtime:
             # backend, after which jax.distributed.initialize() can no longer run.
             # Fail loudly: silently proceeding single-host after a botched pod config
             # wastes the whole allocation (reference Fabric raises on bad cluster env too).
+            enable_cpu_collectives()
             kwargs: Dict[str, Any] = {}
             if self.coordinator_address is not None:
                 kwargs.update(
@@ -347,9 +365,15 @@ class Runtime:
                 fn(runtime=self, **kwargs)
 
     def barrier(self):
-        # Single-controller: nothing to synchronize on host. Multi-controller: a true
-        # cross-process barrier (a local pmap-psum would only fence local devices).
+        # Single-controller: nothing to synchronize on host. Multi-controller: a
+        # HOST barrier over the coordinator's native barrier service (portable —
+        # works wherever the world booted, including the CPU backend), falling
+        # back to a device collective only when the KV client is unavailable.
         if jax.process_count() > 1:  # pragma: no cover - exercised by test_multihost children
+            from sheeprl_tpu.parallel import control
+
+            if control.host_barrier():
+                return
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("sheeprl_tpu_barrier")

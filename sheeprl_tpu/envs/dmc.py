@@ -95,6 +95,17 @@ class DMCWrapper(OldGymEnvAdapter):
             )
         if action_repeat <= 0:
             raise ValueError("`action_repeat` should be a positive integer")
+        if from_pixels:
+            # fail at construction with the real cause, not an AttributeError
+            # from inside mujoco's renderer at the first reset()
+            from sheeprl_tpu.utils.imports import dmc_render_unusable_reason
+
+            reason = dmc_render_unusable_reason()
+            if reason is not None:
+                raise RuntimeError(
+                    f"DMCWrapper(from_pixels=True) needs a working offscreen GL stack: {reason}. "
+                    "Set MUJOCO_GL=osmesa for software rendering, or use from_vectors=True only."
+                )
         # In-adapter action repeat (vs the generic ActionRepeat wrapper): pixels are
         # rendered ONCE per repeated step instead of once per physics sub-step —
         # rendering dominates dm_control stepping on CPU-rendering hosts (~25 ms vs

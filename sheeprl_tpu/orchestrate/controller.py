@@ -37,6 +37,7 @@ import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core.health import DIVERGENCE_EVENT_KINDS, EVENTS_FILENAME, read_events
 from sheeprl_tpu.core.resilience import FLAG_FILE_ENV_VAR, READY_FILE_ENV_VAR, PreemptionGuard
 from sheeprl_tpu.orchestrate import resolve
@@ -154,6 +155,10 @@ class PopulationController:
         return next(t for t in self.trials if t.key == key)
 
     def _save(self) -> None:
+        # Drill site: journal durability — a kill here must leave either the
+        # old or the new journal under the final name (Journal writes via
+        # tmp+rename), never a torn file.
+        failpoints.failpoint("orchestrate.journal", path=self.journal.path)
         self.journal.save(self.trials, self.counters)
 
     def _log(self, msg: str) -> None:
@@ -162,6 +167,10 @@ class PopulationController:
     # -- spawning --------------------------------------------------------------- #
 
     def _spawn(self, trial: Trial, now: float) -> None:
+        # Drill site: `orchestrate.spawn:kill:9:hit=N` dies between the journal
+        # state change and the Popen — the restart-reconciliation path must
+        # requeue the trial the journal thought was starting.
+        failpoints.failpoint("orchestrate.spawn", key=trial.key)
         seq = self.counters["spawn_seq"]
         self.counters["spawn_seq"] = seq + 1
         run_name = f"inc{seq:04d}_{trial.key}"
@@ -423,7 +432,15 @@ class PopulationController:
     # -- chaos injection (drill knob) ----------------------------------------------- #
 
     def _maybe_inject(self, now: float) -> None:
-        if self._inject_remaining <= 0 or now - self._last_inject < self._inject_spacing_s:
+        if self._inject_remaining <= 0:
+            return
+        if failpoints.has("orchestrate.inject"):
+            # Deterministic drill clock: `orchestrate.inject:fire::every=N`
+            # injects on every Nth eligible controller tick, independent of
+            # wall-clock spacing (which races trial startup on loaded hosts).
+            if failpoints.failpoint("orchestrate.inject", remaining=self._inject_remaining) is not True:
+                return
+        elif now - self._last_inject < self._inject_spacing_s:
             return
         candidates = [
             t

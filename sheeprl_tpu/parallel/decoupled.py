@@ -30,31 +30,19 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.parallel import control as _control
 
 
 def _kv_client():
     """The coordinator's key-value store client (None if unavailable).
 
-    jax 0.9 only exposes the client at the private path; probe a public
-    location first so that a future jax that promotes it keeps working even
-    if the private module moves (graceful degradation instead of a dead
-    feature on upgrade — advisor r4 finding).
+    The probe itself lives in :mod:`sheeprl_tpu.parallel.control` (the control
+    plane is its canonical consumer); this indirection point stays so existing
+    callers and tests keep one seam to fake the store through.
     """
-    try:
-        import jax.distributed as jd
-
-        client = getattr(getattr(jd, "global_state", None), "client", None)
-        if client is not None:
-            return client
-    except Exception:  # pragma: no cover - future-API probe only
-        pass
-    try:
-        from jax._src import distributed
-
-        return getattr(distributed.global_state, "client", None)
-    except (ImportError, AttributeError):  # pragma: no cover - private-API drift
-        return None
+    return _control.coordinator_client()
 
 
 def _ckpt_digest(path: str, chunk: int = 1 << 20) -> str:
@@ -162,6 +150,45 @@ class CrossHostTransport:
         self._specs: Dict[str, Dict[str, Tuple[Tuple[int, ...], str]]] = {}
         self._zero_payloads: Dict[str, Dict[str, np.ndarray]] = {}
         self._scope = ""
+        self.counters: Dict[str, int] = dict.fromkeys(_control.COUNTER_KEYS, 0)
+        self._drained: Dict[str, int] = dict.fromkeys(self.counters, 0)
+
+    def _count(self, key: str, n: int = 1) -> None:
+        # tolerate partially-constructed instances (unit tests build the
+        # transport via __new__ around a fake KV store)
+        counters = self.__dict__.setdefault("counters", dict.fromkeys(_control.COUNTER_KEYS, 0))
+        self.__dict__.setdefault("_drained", dict.fromkeys(counters, 0))
+        counters[key] = counters.get(key, 0) + n
+
+    def drain_counters(self) -> Dict[str, int]:
+        """Counter DELTAS since the previous drain (aggregator-update friendly,
+        mirroring SupervisedVectorEnv): the decoupled loops fold these into the
+        run's ``Resilience/*`` metrics, where the HealthSentinel reads them."""
+        counters = self.__dict__.get("counters") or {}
+        drained = self.__dict__.setdefault("_drained", dict.fromkeys(counters, 0))
+        out = {}
+        for k, v in counters.items():
+            out[k] = v - drained.get(k, 0)
+            drained[k] = v
+        return out
+
+    def _require_kv(self, what: str):
+        """The coordinator KV client, or an ACTIONABLE failure: the None client
+        is warned once, counted (``Resilience/kv_unavailable``), and surfaced
+        as a diagnosis instead of the bare ``AttributeError`` its first method
+        call used to produce."""
+        client = _kv_client()
+        if client is None:
+            self._count(_control.KV_UNAVAILABLE_COUNTER)
+            try:
+                _control.require_coordinator_client(what)
+            except _control.KVUnavailableError as e:
+                raise _control.KVUnavailableError(
+                    f"{e} (cross-host decoupled mode cannot run without it; "
+                    "single-process worlds use split_runtime instead)"
+                ) from None
+            raise _control.KVUnavailableError(f"{what}: coordinator KV store unavailable")
+        return client
 
     def configure_faults(
         self,
@@ -207,18 +234,25 @@ class CrossHostTransport:
         ) from last
 
     def _kv_set(self, key: str, value: str) -> None:
-        client = _kv_client()
+        client = self._require_kv(f"CrossHostTransport KV set of '{key}'")
+        fp = failpoints.failpoint("transport.kv_set", key=key, value=value)
+        if fp is failpoints.DROPPED:
+            return  # a silently lost publish: the peer's deadline surfaces it
+        if isinstance(fp, str):
+            value = fp
         self._kv_retry(
             lambda: client.key_value_set(key, value, allow_overwrite=True),
             describe=f"KV set of '{key}'",
         )
 
     def _kv_get(self, key: str, timeout_ms: int) -> str:
-        client = _kv_client()
-        return self._kv_retry(
+        client = self._require_kv(f"CrossHostTransport KV get of '{key}'")
+        out = self._kv_retry(
             lambda: client.blocking_key_value_get(key, timeout_ms),
             describe=f"KV get of '{key}' (deadline {timeout_ms} ms/attempt)",
         )
+        fp = failpoints.failpoint("transport.kv_get", key=key, value=out)
+        return fp if isinstance(fp, str) else out
 
     def set_scope(self, scope: str) -> None:
         """Namespace the KV exchange to this run.
@@ -329,13 +363,7 @@ class CrossHostTransport:
         """
         if tag in self._specs:
             return self._specs[tag]
-        client = _kv_client()
-        if client is None:
-            raise RuntimeError(
-                "cross-host decoupled mode needs the jax coordinator KV store "
-                "(jax.distributed.initialize must have run in every process); "
-                "this jax version does not expose it"
-            )
+        self._require_kv(f"sync_payload_spec('{tag}')")
         # The scope string is the run's log_dir, which ends in a fresh
         # ``version_N`` minted per process incarnation (get_log_dir bumps it
         # even on resume) — it doubles as the run nonce that keeps a still-live
@@ -368,14 +396,50 @@ class CrossHostTransport:
             self._zero_payloads[tag] = {n: np.zeros(s, d) for n, (s, d) in self._specs[tag].items()}
         return dict(self._zero_payloads[tag])
 
+    def control_plane(self) -> "_control.ControlPlane":
+        """Lazily-built host control plane sharing this transport's counters
+        (heartbeats, liveness, epoch fencing for host-side chunk handoffs)."""
+        plane = self.__dict__.get("_control_plane")
+        if plane is None:
+            client = self._require_kv("CrossHostTransport control plane")
+            plane = _control.ControlPlane(
+                _control.CoordinatorKV(client),
+                rank=jax.process_index(),
+                world=jax.process_count(),
+                scope=self._scope or "decoupled",
+                counters=self.__dict__.setdefault("counters", dict.fromkeys(_control.COUNTER_KEYS, 0)),
+            )
+            self._control_plane = plane
+        return plane
+
+    def heartbeat(self, payload: Optional[Dict[str, Any]] = None) -> None:
+        """Best-effort liveness beat (never fails the training round)."""
+        try:
+            self.control_plane().heartbeat(payload)
+        except Exception:
+            pass
+
+    def peer_liveness(self, max_age_s: float = 60.0) -> Dict[int, Dict[str, Any]]:
+        try:
+            return self.control_plane().peer_liveness(max_age_s)
+        except Exception:
+            return {}
+
     def rollout_to_trainers(self, host_tree: Any) -> Any:
         """Player process's host rollout -> replicated on the trainer mesh.
 
         Every process must call this each round (it contains a collective); on
         non-player processes ``host_tree`` is only a shape/dtype template.
+
+        The BULK payload stays on the device collective — ICI/DCN is the fast
+        path and the control plane carries control-sized strings only — but
+        each round also drops a heartbeat on the KV store, so a wedged or dead
+        peer is visible host-side (``peer_liveness``) even while the collective
+        below is stuck waiting for it.
         """
         from jax.experimental import multihost_utils
 
+        self.heartbeat()
         synced = multihost_utils.broadcast_one_to_all(host_tree)
         return multihost_utils.host_local_array_to_global_array(synced, self.trainer_mesh, P())
 

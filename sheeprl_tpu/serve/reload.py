@@ -23,6 +23,7 @@ import logging
 import threading
 from typing import Optional
 
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.serve.engine import PolicyEngine, GenerationStore
 from sheeprl_tpu.serve.stats import ServeStats
 from sheeprl_tpu.utils.checkpoint import certified_info, latest_certified, load_state
@@ -92,6 +93,9 @@ class HotReloader(threading.Thread):
         prev = self.store.swap(gen)
         if self.canary:
             try:
+                # Drill site: `reload.canary:raise` exercises the full
+                # swap -> canary-fail -> rollback path on a healthy artifact.
+                failpoints.failpoint("reload.canary", path=path, gen_id=gen.gen_id)
                 self.engine.canary(gen.params)
             except Exception as e:
                 # post-swap canary failed: put the last-known-good generation

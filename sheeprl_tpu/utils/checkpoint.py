@@ -18,6 +18,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 import numpy as np
 
+from sheeprl_tpu.core import failpoints
+
 # Versioned container format. v1 wraps the legacy bare-pickle state with a
 # manifest (leaf path -> shape/dtype) and a CRC of the serialized state, so a
 # truncated write, bit rot, or a state-dict refactor fails LOUDLY at resume
@@ -122,10 +124,16 @@ def save_state(path: str, state: Dict[str, Any]) -> Dict[str, Any]:
         pickle.dump(host_state, writer, protocol=pickle.HIGHEST_PROTOCOL)
         pickle.dump({"crc32": writer.crc}, f, protocol=pickle.HIGHEST_PROTOCOL)
         f.flush()
+        # Drill site: a truncate/kill here is a write torn BEFORE durability —
+        # the final name still holds the old checkpoint (os.replace not reached).
+        failpoints.failpoint("ckpt.pre_fsync", path=tmp, file=f)
         os.fsync(f.fileno())
         size = f.tell()
     _fsync_dir(parent)
     os.replace(tmp, path)
+    # Drill site: corrupt/truncate the FINAL file (mtime preserved) — models
+    # bit rot / a torn in-place overwrite that the CRC fallback must survive.
+    failpoints.failpoint("ckpt.finalize", path=path)
     _fsync_dir(parent)
     return {"crc32": writer.crc, "size": size}
 
@@ -396,6 +404,9 @@ class CheckpointCorruptionError(RuntimeError):
 
 
 def _load_state_file(path: str) -> Dict[str, Any]:
+    # Drill site: corrupt (in place) or raise here to force the certified-first
+    # older-sibling fallback in load_state without hand-rolled byte flippers.
+    failpoints.failpoint("ckpt.load", path=path)
     try:
         with open(path, "rb") as f:
             obj = pickle.load(f)

@@ -3,6 +3,8 @@
 from __future__ import annotations
 
 import importlib.util
+import os
+from typing import Optional
 
 
 def _module_available(name: str) -> bool:
@@ -21,3 +23,39 @@ _IS_DIAMBRA_ARENA_AVAILABLE = _module_available("diambra.arena")
 _IS_MINEDOJO_AVAILABLE = _module_available("minedojo")
 _IS_MINERL_AVAILABLE = _module_available("minerl")
 _IS_SUPER_MARIO_AVAILABLE = _module_available("gym_super_mario_bros")
+
+_UNPROBED = "unprobed"
+_dmc_render_reason: Optional[str] = _UNPROBED
+
+
+def dmc_render_unusable_reason() -> Optional[str]:
+    """None when dm_control can render headlessly here, else the reason.
+
+    ``find_spec("dm_control")`` succeeding does not mean pixels work: a broken
+    EGL stack (driver/libEGL mismatch, no GPU device nodes) only explodes at
+    the FIRST ``mujoco.GLContext`` — deep inside env construction, long after
+    import gating passed. Probe a 16x16 context once per process so callers
+    (test collection, env factories) can skip or fail fast with the actual
+    cause instead of an AttributeError from inside the renderer."""
+    global _dmc_render_reason
+    if _dmc_render_reason != _UNPROBED:
+        return _dmc_render_reason
+    if not _IS_DMC_AVAILABLE:
+        _dmc_render_reason = "dm_control is not installed"
+        return _dmc_render_reason
+    backend = os.environ.setdefault("MUJOCO_GL", "egl")
+    try:
+        import mujoco
+
+        ctx = mujoco.GLContext(16, 16)
+        try:
+            ctx.make_current()
+        finally:
+            ctx.free()
+        _dmc_render_reason = None
+    except Exception as e:  # noqa: BLE001 - any failure here means "cannot render"
+        _dmc_render_reason = (
+            f"mujoco cannot create a MUJOCO_GL={backend} context on this host: "
+            f"{type(e).__name__}: {e}"
+        )
+    return _dmc_render_reason

@@ -216,8 +216,19 @@ _LOG_DIR_WIRE_BYTES = 1024
 
 
 def _broadcast_str(value: Optional[str]) -> str:
-    """Share rank-0's string with every process (fixed-size uint8 wire format:
-    ``broadcast_one_to_all`` moves arrays, not Python objects)."""
+    """Share rank-0's string with every process.
+
+    Host coordination rides the control plane (coordinator KV store): a string
+    broadcast has no business on the accelerator interconnect, and the device
+    collective it used to ride cannot run multi-process on the CPU backend at
+    all. The fixed-size uint8 device broadcast remains only as the fallback for
+    worlds whose jax build exposes no KV client."""
+    from sheeprl_tpu.parallel import control
+
+    shared = control.host_broadcast_str(value, name="log_dir")
+    if shared is not None:
+        return shared
+
     import numpy as np
     from jax.experimental import multihost_utils
 

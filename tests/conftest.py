@@ -42,6 +42,11 @@ _ALGO_TEST_DEFAULT_TIMEOUT = 600
 
 def pytest_configure(config):
     config.addinivalue_line("markers", "timeout(seconds): per-test wall-clock limit (SIGALRM)")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection drills (failpoint registry, chaos/transport smokes); "
+        "select with `-m faults`, e.g. before touching checkpoint or transport code",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

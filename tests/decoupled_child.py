@@ -37,6 +37,10 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from sheeprl_tpu.core.runtime import enable_cpu_collectives  # noqa: E402
+
+enable_cpu_collectives()  # gloo: CPU cross-process collectives (before backend init)
+
 
 def main() -> None:
     port, pid, nproc = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
@@ -122,7 +126,14 @@ def main() -> None:
         )
         device_data, dev_next_values, train_key, clip_coef, ent_coef = payload
         params, opt_state, _flat, _metrics = train_fn(
-            params, opt_state, device_data, dev_next_values, train_key.astype(jnp.uint32), clip_coef, ent_coef
+            params,
+            opt_state,
+            device_data,
+            dev_next_values,
+            train_key.astype(jnp.uint32),
+            clip_coef,
+            ent_coef,
+            jnp.float32(1.0),  # lr_scale: no sentinel backoff in this drill
         )
 
     player_params = transport.params_to_player(params)

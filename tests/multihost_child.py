@@ -37,6 +37,10 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from sheeprl_tpu.core.runtime import enable_cpu_collectives  # noqa: E402
+
+enable_cpu_collectives()  # gloo: CPU cross-process collectives (before backend init)
+
 
 def _mode_timeout(port: int, pid: int, nproc: int) -> None:
     from sheeprl_tpu.core.runtime import Runtime

@@ -286,6 +286,9 @@ def test_minedojo_actor_dv2_masked_sampling_and_exploration():
 @pytest.mark.skipif(not imports_mod._IS_DMC_AVAILABLE, reason="dm_control not installed")
 def test_dmc_wrapper_real_env(monkeypatch):
     """dm_control is present in the image: exercise the real adapter (headless EGL)."""
+    reason = imports_mod.dmc_render_unusable_reason()
+    if reason is not None:
+        pytest.skip(reason)
     monkeypatch.setenv("MUJOCO_GL", "egl")
     sys.modules.pop("sheeprl_tpu.envs.dmc", None)
     dmc = importlib.import_module("sheeprl_tpu.envs.dmc")

@@ -12,6 +12,7 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+@pytest.mark.faults
 @pytest.mark.timeout(600)
 def test_chaos_smoke_sigterm_roundtrip(tmp_path):
     out = subprocess.run(

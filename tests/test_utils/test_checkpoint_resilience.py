@@ -1,12 +1,15 @@
 """Durability half of the checkpoint layer: corruption fallback to an older
 sibling checkpoint and the CheckpointCallback keep_last garbage collection
-(in-flight ``.tmp`` writes must never count against the retention budget)."""
+(in-flight ``.tmp`` writes must never count against the retention budget).
+Faults are injected through the core/failpoints.py registry — the same drill
+sites (ckpt.pre_fsync / ckpt.finalize / ckpt.load) operators use in prod."""
 
 import os
 
 import numpy as np
 import pytest
 
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.utils.checkpoint import (
     CheckpointCallback,
     CheckpointCorruptionError,
@@ -26,13 +29,15 @@ def _write_ckpt(path, iter_num, mtime):
 
 
 def _corrupt(path):
-    st = path.stat()
-    raw = bytearray(path.read_bytes())
-    raw[len(raw) // 2] ^= 0xFF  # flip a byte inside the CRC-covered state pickle
-    path.write_bytes(bytes(raw))
-    os.utime(path, (st.st_atime, st.st_mtime))  # keep the sibling mtime ordering
+    """Registry-driven file corruption — the `corrupt` failpoint action flips
+    bytes inside the CRC-covered state pickle and preserves the mtime (so the
+    sibling ordering survives), exactly what `ckpt.finalize:corrupt` does to a
+    live run. No hand-rolled byte flipper."""
+    with failpoints.active("drill.corrupt_file:corrupt"):
+        assert failpoints.failpoint("drill.corrupt_file", path=str(path)) is True
 
 
+@pytest.mark.faults
 def test_fallback_to_newest_older_sibling(tmp_path):
     _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
     _write_ckpt(tmp_path / "ckpt_20_0.ckpt", 20, 2000)
@@ -45,6 +50,7 @@ def test_fallback_to_newest_older_sibling(tmp_path):
     assert state["iter_num"] == 20
 
 
+@pytest.mark.faults
 def test_fallback_skips_corrupt_siblings(tmp_path):
     _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
     mid = tmp_path / "ckpt_20_0.ckpt"
@@ -58,6 +64,7 @@ def test_fallback_skips_corrupt_siblings(tmp_path):
     assert state["iter_num"] == 10
 
 
+@pytest.mark.faults
 def test_fallback_ignores_newer_siblings_and_non_ckpt_files(tmp_path):
     corrupt = tmp_path / "ckpt_10_0.ckpt"
     _write_ckpt(corrupt, 10, 1000)
@@ -70,6 +77,7 @@ def test_fallback_ignores_newer_siblings_and_non_ckpt_files(tmp_path):
         load_state(str(corrupt))
 
 
+@pytest.mark.faults
 def test_fallback_can_be_disabled(tmp_path):
     _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
     newest = tmp_path / "ckpt_20_0.ckpt"
@@ -77,6 +85,47 @@ def test_fallback_can_be_disabled(tmp_path):
     _corrupt(newest)
     with pytest.raises(CheckpointCorruptionError, match="integrity|unreadable|corrupt"):
         load_state(str(newest), fallback_to_older=False)
+
+
+@pytest.mark.faults
+def test_torn_write_before_fsync_is_detected_and_falls_back(tmp_path):
+    """A write torn between flush and fsync (power loss mid-durability): the
+    truncated file reaches the final name, the CRC footer is gone, and resume
+    must fall back to the intact older sibling."""
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    newest = tmp_path / "ckpt_20_0.ckpt"
+    with failpoints.active("ckpt.pre_fsync:truncate:0.5"):
+        save_state(str(newest), {"iter_num": 20, "agent": np.full((3,), 20, np.float32)})
+    os.utime(newest, (2000, 2000))
+    with pytest.warns(UserWarning, match="older sibling"):
+        state = load_state(str(newest))
+    assert state["iter_num"] == 10
+
+
+@pytest.mark.faults
+def test_crash_before_fsync_leaves_previous_checkpoint_intact(tmp_path):
+    """A crash BEFORE durability (raise at the pre-fsync drill site): the
+    atomic-rename protocol must leave the previous checkpoint untouched under
+    the final name — the failed overwrite never reaches os.replace."""
+    path = tmp_path / "ckpt_10_0.ckpt"
+    _write_ckpt(path, 10, 1000)
+    with failpoints.active("ckpt.pre_fsync:raise:power-cut"):
+        with pytest.raises(failpoints.FailpointError, match="power-cut"):
+            save_state(str(path), {"iter_num": 99, "agent": np.full((3,), 99, np.float32)})
+    assert load_state(str(path))["iter_num"] == 10
+
+
+@pytest.mark.faults
+def test_load_failpoint_corrupts_newest_once_and_spares_the_sibling(tmp_path):
+    """`ckpt.load:corrupt:hit=1` bit-rots exactly the FIRST checkpoint the
+    loader opens; the fallback re-entry (hit 2) must find its sibling intact."""
+    _write_ckpt(tmp_path / "ckpt_10_0.ckpt", 10, 1000)
+    newest = tmp_path / "ckpt_20_0.ckpt"
+    _write_ckpt(newest, 20, 2000)
+    with failpoints.active("ckpt.load:corrupt:hit=1"):
+        with pytest.warns(UserWarning, match="older sibling"):
+            state = load_state(str(newest))
+    assert state["iter_num"] == 10
 
 
 def test_gc_keep_last_prunes_oldest_and_never_counts_tmp(tmp_path):

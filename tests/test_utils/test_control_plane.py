@@ -1,0 +1,217 @@
+"""parallel/control.py: host control plane over a real socket KV pair —
+collectives, epoch fencing, fault-injected chunk transport, liveness, and the
+actionable-unavailability path (satellite of the multihost rewiring)."""
+
+import threading
+import zlib
+
+import pytest
+
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.parallel import control
+from sheeprl_tpu.parallel.control import (
+    ControlPlane,
+    KVServer,
+    KVUnavailableError,
+    SocketKV,
+    StaleEpochError,
+)
+
+
+@pytest.fixture()
+def kv_pair():
+    server = KVServer()
+    server.start()
+    try:
+        yield SocketKV(server.address), SocketKV(server.address)
+    finally:
+        server.stop()
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _planes(kv_pair, scope, **kw):
+    a, b = kv_pair
+    return (
+        ControlPlane(a, rank=0, world=2, scope=scope, timeout_ms=20_000, **kw),
+        ControlPlane(b, rank=1, world=2, scope=scope, timeout_ms=20_000, **kw),
+    )
+
+
+def _join(*threads):
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "control-plane thread wedged"
+
+
+# --------------------------------------------------------------------------- #
+# collectives
+# --------------------------------------------------------------------------- #
+
+
+def test_broadcast_barrier_and_gather_across_two_ranks(kv_pair):
+    p0, p1 = _planes(kv_pair, "collectives")
+    got = {}
+
+    def rank0():
+        assert p0.broadcast_str("log_dir", "logs/run-1") == "logs/run-1"
+        p0.barrier("setup")
+        got[0] = p0.all_gather_meta("caps", {"rank": 0, "envs": 4})
+
+    def rank1():
+        got["bcast"] = p1.broadcast_str("log_dir")
+        p1.barrier("setup")
+        got[1] = p1.all_gather_meta("caps", {"rank": 1, "envs": 2})
+
+    _join(threading.Thread(target=rank0), threading.Thread(target=rank1))
+    assert got["bcast"] == "logs/run-1"
+    assert got[0] == got[1] == {0: {"rank": 0, "envs": 4}, 1: {"rank": 1, "envs": 2}}
+
+
+def test_broadcast_repeats_under_one_name_stay_matched(kv_pair):
+    p0, p1 = _planes(kv_pair, "bcast_seq")
+    seen = []
+
+    def rank0():
+        for v in ("first", "second"):
+            p0.broadcast_str("v", v)
+
+    def rank1():
+        seen.extend(p1.broadcast_str("v") for _ in range(2))
+
+    _join(threading.Thread(target=rank0), threading.Thread(target=rank1))
+    assert seen == ["first", "second"]
+
+
+# --------------------------------------------------------------------------- #
+# chunk transport under injected faults
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_chunk_stream_survives_drops_and_torn_payloads(kv_pair):
+    writer, reader = _planes(kv_pair, "chunks")
+    writer.begin_session("w")
+    reader.adopt_epoch("w")
+    chunks = [f"payload-{i}".encode() * 20 for i in range(6)]
+    out = []
+
+    def send():
+        # every 2nd attempt silently dropped, every 3rd torn mid-payload:
+        # the ack/CRC protocol must still deliver the exact stream
+        with failpoints.active("control.chunk_send:drop:every=2"):
+            for i in (0, 1, 2):
+                writer.send_chunk("c", i, chunks[i], timeout_ms=20_000)
+        with failpoints.active("control.chunk_send:corrupt:3:every=3"):
+            for i in (3, 4, 5):
+                writer.send_chunk("c", i, chunks[i], timeout_ms=20_000)
+
+    def recv():
+        out.extend(reader.recv_chunk("c", i, timeout_ms=30_000) for i in range(6))
+
+    _join(threading.Thread(target=send), threading.Thread(target=recv))
+    assert [zlib.crc32(d) for d in out] == [zlib.crc32(d) for d in chunks]
+    assert writer.counters["Resilience/chunk_resends"] >= 2
+    assert reader.chunk_cursor("c") == 5
+
+
+@pytest.mark.faults
+def test_zombie_writer_is_fenced_and_told_to_stop(kv_pair):
+    zombie, reader = _planes(kv_pair, "fence")
+    successor = ControlPlane(kv_pair[0], rank=0, world=2, scope="fence", timeout_ms=20_000)
+    zombie.begin_session("w")  # epoch 1
+    successor.begin_session("w")  # epoch 2 — supersedes the zombie
+    reader.adopt_epoch("w")
+    out, errors = [], []
+
+    def dead_then_live():
+        try:
+            zombie.send_chunk("c", 0, b"from-the-dead", timeout_ms=20_000)
+        except StaleEpochError as e:
+            errors.append(e)
+        successor.send_chunk("c", 0, b"authoritative", timeout_ms=20_000)
+
+    def recv():
+        out.append(reader.recv_chunk("c", 0, timeout_ms=30_000))
+
+    _join(threading.Thread(target=dead_then_live), threading.Thread(target=recv))
+    assert out == [b"authoritative"], "reader accepted a zombie epoch's payload"
+    assert len(errors) == 1, "the zombie writer was not told to stop"
+    assert reader.counters["Resilience/stale_epoch_rejects"] >= 1
+
+
+def test_reader_refetches_authoritative_epoch_to_fence_racing_zombie(kv_pair):
+    # A zombie whose forged envelope CLAIMS the current epoch must still be
+    # rejected: the reader re-reads the epoch key before accepting anything
+    # at-or-above its last seen epoch.
+    zombie, reader = _planes(kv_pair, "race")
+    zombie.begin_session("w")  # epoch 1
+    reader.adopt_epoch("w")  # reader has only seen epoch 1
+    successor = ControlPlane(kv_pair[0], rank=0, world=2, scope="race", timeout_ms=20_000)
+    successor.begin_session("w")  # epoch 2, but no envelope from it yet
+    out = []
+
+    def send():
+        try:
+            zombie.send_chunk("c", 0, b"zombie-races-ahead", timeout_ms=5_000)
+        except (StaleEpochError, control.ControlPlaneTimeoutError):
+            pass
+        successor.send_chunk("c", 0, b"real", timeout_ms=20_000)
+
+    def recv():
+        out.append(reader.recv_chunk("c", 0, timeout_ms=30_000))
+
+    _join(threading.Thread(target=send), threading.Thread(target=recv))
+    assert out == [b"real"]
+    assert reader.counters["Resilience/stale_epoch_rejects"] >= 1
+
+
+# --------------------------------------------------------------------------- #
+# heartbeat / liveness
+# --------------------------------------------------------------------------- #
+
+
+def test_heartbeat_and_peer_liveness(kv_pair):
+    p0, p1 = _planes(kv_pair, "hb")
+    p0.begin_session("w")
+    p0.heartbeat({"iteration": 7})
+    view = p1.peer_liveness(max_age_s=30.0)
+    assert view[0]["alive"] is True and view[0]["seq"] == 1 and view[0]["epoch"] == 1
+    assert view[1]["alive"] is False  # rank 1 never beat
+    assert p0.counters["Resilience/heartbeats_sent"] == 1
+    # an old beat ages out and is counted as stale
+    stale = p1.peer_liveness(max_age_s=0.0)
+    assert stale[0]["alive"] is False
+    assert p1.counters["Resilience/peer_stale_heartbeats"] >= 1
+
+
+# --------------------------------------------------------------------------- #
+# unavailability diagnosis (satellite: the old silent-None _kv_client)
+# --------------------------------------------------------------------------- #
+
+
+def test_require_coordinator_client_diagnoses_and_counts(monkeypatch):
+    monkeypatch.setattr(control, "coordinator_client", lambda: None)
+    counters = {}
+    with pytest.raises(KVUnavailableError, match="jax.distributed.initialize"):
+        control.require_coordinator_client("the payload-spec exchange", counters)
+    assert counters[control.KV_UNAVAILABLE_COUNTER] == 1
+
+
+def test_decoupled_kv_probe_is_quietly_none_outside_a_jax_world():
+    from sheeprl_tpu.parallel import decoupled
+
+    assert decoupled._kv_client() is None  # no jax.distributed.initialize() here
+
+
+def test_timeout_error_names_the_key_and_scope(kv_pair):
+    plane = ControlPlane(kv_pair[0], rank=1, world=2, scope="diag", timeout_ms=300, retries=0)
+    with pytest.raises(control.ControlPlaneTimeoutError, match="broadcast of 'never'.*rank 1.*'diag'"):
+        plane.broadcast_str("never", timeout_ms=300)

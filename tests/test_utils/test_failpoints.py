@@ -1,0 +1,145 @@
+"""core/failpoints.py: spec grammar, deterministic triggers, actions, and the
+zero-cost-when-disabled guarantee the production hot paths rely on."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.core import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+# --------------------------------------------------------------------------- #
+# the production guarantee: disabled means ONE None-check, nothing else
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_disabled_failpoint_never_touches_the_registry(monkeypatch):
+    def boom(*a, **k):  # any registry work while disabled is a perf regression
+        raise AssertionError("failpoint() reached _fire() while disabled")
+
+    monkeypatch.setattr(failpoints, "_fire", boom)
+    assert failpoints.failpoint("ckpt.finalize", path="/nowhere") is None
+    assert not failpoints.enabled()
+
+
+@pytest.mark.faults
+def test_unmatched_name_is_a_noop_even_when_enabled():
+    failpoints.configure("other.name:raise")
+    assert failpoints.failpoint("ckpt.finalize") is None
+    assert failpoints.counts()["other.name"] == {"hits": 0, "fires": 0}
+
+
+# --------------------------------------------------------------------------- #
+# grammar + triggers
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_spec_grammar_arg_and_trigger_fields_are_order_free():
+    failpoints.configure("a.b:sleep:0.0:every=2,c.d:raise:msg")
+    assert failpoints.has("a.b") and failpoints.has("c.d")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints._parse_entry("missing-action")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints._parse_entry("x.y:explode")
+    with pytest.raises(failpoints.FailpointSpecError):
+        failpoints._parse_entry("x.y:raise:bad=trigger")
+
+
+@pytest.mark.faults
+def test_hit_trigger_fires_exactly_once_on_the_nth_evaluation():
+    failpoints.configure("p:fire:hit=3")
+    assert [failpoints.failpoint("p") for _ in range(5)] == [None, None, True, None, None]
+    assert failpoints.counts()["p"] == {"hits": 5, "fires": 1}
+
+
+@pytest.mark.faults
+def test_every_trigger_fires_on_multiples():
+    failpoints.configure("p:fire:every=2")
+    assert [failpoints.failpoint("p") for _ in range(6)] == [None, True, None, True, None, True]
+
+
+@pytest.mark.faults
+def test_prob_trigger_is_deterministic_for_a_seed():
+    failpoints.configure("p:fire:prob=0.5;seed=3")
+    first = [failpoints.failpoint("p") for _ in range(16)]
+    failpoints.configure("p:fire:prob=0.5;seed=3")
+    assert [failpoints.failpoint("p") for _ in range(16)] == first
+    assert any(first) and not all(first)
+
+
+# --------------------------------------------------------------------------- #
+# actions
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_raise_action_raises_a_runtimeerror_subclass():
+    failpoints.configure("p:raise:boom")
+    with pytest.raises(failpoints.FailpointError, match="boom"):
+        failpoints.failpoint("p")
+
+
+@pytest.mark.faults
+def test_drop_action_returns_the_sentinel():
+    failpoints.configure("p:drop")
+    assert failpoints.failpoint("p") is failpoints.DROPPED
+
+
+@pytest.mark.faults
+def test_corrupt_action_on_str_and_bytes_values():
+    failpoints.configure("p:corrupt:2")
+    s = failpoints.failpoint("p", value="hello world!")
+    assert isinstance(s, str) and s != "hello world!"
+    b = failpoints.failpoint("p", value=b"hello world!")
+    assert isinstance(b, bytes) and b != b"hello world!" and len(b) == 12
+
+
+@pytest.mark.faults
+def test_corrupt_action_on_file_preserves_mtime(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"A" * 64)
+    before = os.stat(f)
+    failpoints.configure("p:corrupt")
+    assert failpoints.failpoint("p", path=str(f)) is True
+    assert f.read_bytes() != b"A" * 64 and len(f.read_bytes()) == 64
+    assert os.stat(f).st_mtime == before.st_mtime
+
+
+@pytest.mark.faults
+def test_truncate_action_tears_a_file(tmp_path):
+    f = tmp_path / "blob.bin"
+    f.write_bytes(b"A" * 100)
+    failpoints.configure("p:truncate:0.25")
+    failpoints.failpoint("p", path=str(f))
+    assert len(f.read_bytes()) == 25
+
+
+# --------------------------------------------------------------------------- #
+# configuration surfaces
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_env_configuration_and_reset():
+    failpoints.configure_from_env({failpoints.ENV_VAR: "p:fire"})
+    assert failpoints.enabled() and failpoints.failpoint("p") is True
+    failpoints.configure_from_env({})
+    assert not failpoints.enabled()
+
+
+@pytest.mark.faults
+def test_active_context_manager_restores_previous_registry():
+    failpoints.configure("outer:fire")
+    with failpoints.active("inner:drop"):
+        assert failpoints.has("inner") and not failpoints.has("outer")
+        assert failpoints.failpoint("inner") is failpoints.DROPPED
+    assert failpoints.has("outer") and not failpoints.has("inner")

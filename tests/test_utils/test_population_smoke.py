@@ -16,6 +16,7 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+@pytest.mark.faults
 @pytest.mark.timeout(780)
 def test_population_smoke_fleet_chaos_drill(tmp_path):
     out = subprocess.run(

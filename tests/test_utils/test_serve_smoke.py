@@ -15,6 +15,7 @@ import pytest
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+@pytest.mark.faults
 @pytest.mark.timeout(300)
 def test_serve_smoke_chaos_drill(tmp_path):
     out = subprocess.run(

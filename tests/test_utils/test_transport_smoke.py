@@ -1,0 +1,38 @@
+"""Satellite registration of scripts/transport_smoke.py as a tier-1 test: a
+two-process chunk stream over the host control plane must survive
+failpoint-injected drops, delayed acks, torn payloads, and a mid-stream
+player kill/restart — with the dead incarnation's forged zombie write fenced
+by the session epoch and zero chunks lost or duplicated (full harness, fresh
+interpreters, real kill delivery)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(240)
+def test_transport_smoke_kill_restart_roundtrip():
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "transport_smoke.py"),
+            "--total",
+            "12",
+            "--crash-after",
+            "4",
+            "--timeout",
+            "180",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=220,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-2000:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "transport smoke OK" in out.stdout
+    assert "zombie write(s) fenced" in out.stdout
