@@ -90,6 +90,156 @@ def bench_ppo(total_steps: int = 65536, passes: int = 3) -> dict:
     }
 
 
+_INGRAPH_COMMON = (
+    "exp=ppo",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.cnn_keys.encoder=[]",
+    "algo.run_test=False",
+    # timers must stay on (they carry the rollout-phase split) => log_level=1;
+    # the episode prints that come with it are swallowed by the devnull
+    # redirect in _instrumented_ppo_pass, and log_every is pushed out of reach
+    "metric.log_level=1",
+    "metric.log_every=1000000000",
+    "metric.disable_timer=False",
+    "env.capture_video=False",
+    "checkpoint.every=999999999",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+)
+
+
+def _instrumented_ppo_pass(overrides, total_steps: int) -> dict:
+    """One full PPO run returning wall-clock AND rollout-phase env-steps/s.
+
+    The rollout-phase number comes from the loop's own ``Time/env_interaction_time``
+    timer; the cli resets timers at every metric flush, so the reset is held
+    open for the duration of the pass and the accumulated sum read afterwards.
+    """
+    import os
+
+    from sheeprl_tpu.cli import run
+    from sheeprl_tpu.utils.timer import timer
+
+    saved_reset = timer.__dict__["reset"]
+    saved_timers = timer.timers
+    timer.reset = lambda: None  # accumulate across log flushes for this pass
+    timer.timers = {}
+    try:
+        t0 = time.perf_counter()
+        with open(os.devnull, "w") as devnull, contextlib.redirect_stdout(devnull):
+            run(overrides=list(overrides))
+        wall = time.perf_counter() - t0
+        phase = timer.compute()
+    finally:
+        setattr(timer, "reset", saved_reset)
+        timer.timers = saved_timers
+    env_s = float(phase.get("Time/env_interaction_time") or 0.0)
+    return {
+        "wall_sps": total_steps / wall,
+        "rollout_sps": (total_steps / env_s) if env_s > 0 else None,
+    }
+
+
+def _fused_collect_sps(num_envs: int, rollout_steps: int, iters: int = 8) -> float:
+    """Sustained env-steps/s of the fused ``lax.scan`` collector alone, fenced.
+
+    This exists because the train loop's ``Time/env_interaction_time`` timer
+    cannot measure the in-graph backend: ``collector.collect()`` is an async
+    dispatch, so the timer records microseconds of enqueue while the real work
+    overlaps the train phase. Here the collector is driven standalone and each
+    measurement is fenced with ``block_until_ready`` on the carry (every
+    iteration consumes the previous carry, so fencing the last one fences the
+    whole chain).
+    """
+    import jax
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.config import load_config
+    from sheeprl_tpu.core.runtime import build_runtime
+    from sheeprl_tpu.envs import ingraph as ig
+
+    cfg = load_config(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            f"env.num_envs={num_envs}",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+        ]
+    )
+    runtime = build_runtime(cfg.fabric)
+    venv = ig.make_vector_env(cfg, num_envs, 42, device=runtime.device)
+    _, _, player = build_agent(runtime, (2,), False, cfg, venv.single_observation_space, None)
+    player.params = jax.device_put(player.params, runtime.device)
+    venv.reset(seed=42)
+    collector = ig.InGraphRolloutCollector(
+        venv, player, rollout_steps=rollout_steps, gamma=float(cfg.algo.gamma), name="bench"
+    )
+    collector.collect()  # compile + first rollout
+    jax.block_until_ready(venv.carry.obs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        collector.collect()
+    jax.block_until_ready(venv.carry.obs)
+    return iters * rollout_steps * num_envs / (time.perf_counter() - t0)
+
+
+def bench_ingraph(
+    num_envs: int = 4096, rollout_steps: int = 128, iters: int = 8, host_steps: int = 16384
+) -> dict:
+    """In-graph vectorized backend (envs/ingraph/) vs the host gym path.
+
+    Headline: sustained fused-collect env-steps/s (``policy.act ∘ env.step``
+    under one ``lax.scan``, fenced — see :func:`_fused_collect_sps`), compared
+    against the repo's standing host-path PPO baseline (the exact bench_ppo
+    CartPole shape, full loop) as ``vs_baseline``. Context fields report the
+    host run's rollout-phase split and a full ingraph training run's wall-clock
+    env-steps/s; on the CPU fallback the latter is bounded by the shared train
+    phase, not the collector.
+    """
+    host_over = list(_INGRAPH_COMMON) + [
+        "algo.rollout_steps=128",
+        "algo.per_rank_batch_size=64",
+        "env.num_envs=8",
+        "env.sync_env=True",
+    ]
+    _instrumented_ppo_pass(host_over + ["algo.total_steps=2048"], 2048)  # compile warmup
+    host = _instrumented_ppo_pass(host_over + [f"algo.total_steps={host_steps}"], host_steps)
+
+    steps_per_iter = num_envs * rollout_steps
+    ingraph_over = list(_INGRAPH_COMMON) + [
+        "env=jax_cartpole",
+        f"env.num_envs={num_envs}",
+        f"algo.rollout_steps={rollout_steps}",
+        "algo.per_rank_batch_size=16384",
+        "algo.update_epochs=1",
+    ]
+    # warmup pass seeds the persistent compile cache, so the timed pass's first
+    # iteration replays executables instead of compiling them
+    _instrumented_ppo_pass(ingraph_over + [f"algo.total_steps={steps_per_iter}"], steps_per_iter)
+    total = steps_per_iter * iters
+    ing = _instrumented_ppo_pass(ingraph_over + [f"algo.total_steps={total}"], total)
+
+    collect_sps = _fused_collect_sps(num_envs, rollout_steps, iters=iters)
+    host_full = host["wall_sps"]
+    speedup = collect_sps / host_full
+    return {
+        "metric": "ingraph_env_steps_per_sec",
+        "value": round(collect_sps, 2),
+        "unit": "env-steps/s",
+        "vs_baseline": round(speedup, 2),
+        "ingraph_env_steps_per_sec": round(collect_sps, 2),
+        "ingraph_vs_host_x": round(speedup, 2),
+        "ingraph_host_full_loop_env_steps_per_sec": round(host_full, 2),
+        "ingraph_host_rollout_phase_env_steps_per_sec": (
+            round(host["rollout_sps"], 2) if host["rollout_sps"] else None
+        ),
+        "ingraph_train_loop_env_steps_per_sec": round(ing["wall_sps"], 2),
+        "ingraph_num_envs": num_envs,
+        "ingraph_rollout_steps": rollout_steps,
+    }
+
+
 def bench_dv3(
     batch: int = 128,
     seq: int = 64,
@@ -646,6 +796,7 @@ def _target_metric(target: str) -> str:
         "orchestrate": "orchestrate_preempt_recovery_s",
         "serve": "serve_p99_ms",
         "transport": "transport_chunk_roundtrip_ms",
+        "ingraph": "ingraph_env_steps_per_sec",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -662,6 +813,7 @@ _METRIC_UNITS = {
     "orchestrate_preempt_recovery_s": "s",
     "serve_p99_ms": "ms",
     "transport_chunk_roundtrip_ms": "ms",
+    "ingraph_env_steps_per_sec": "env-steps/s",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
 
@@ -716,7 +868,7 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "transport", "all"),
+        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "transport", "ingraph", "all"),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -762,7 +914,8 @@ if __name__ == "__main__":
                             "value": None,
                             "unit": _METRIC_UNITS.get(headline_metric, "s"),
                             "vs_baseline": None,
-                            "error": "backend discovery exceeded 180s even on the CPU "
+                            "status": "skipped",
+                            "skip_reason": "backend discovery exceeded 180s even on the CPU "
                             "fallback (broken jax install?)",
                         }
                     ),
@@ -848,6 +1001,16 @@ if __name__ == "__main__":
                 result.setdefault("value", sv.get("serve_p99_ms"))
                 result.setdefault("unit", "ms")
                 result.setdefault("vs_baseline", None)
+            if cli_args.target == "ingraph":
+                # opt-in only: head-to-head of the in-graph vectorized backend
+                # (envs/ingraph/) against the host gym path on the same algo
+                # settings; the headline is the rollout-phase env-steps/s
+                ig = bench_ingraph()
+                result.update(ig)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", ig.get("ingraph_env_steps_per_sec"))
+                result.setdefault("unit", "env-steps/s")
+                result.setdefault("vs_baseline", ig.get("ingraph_vs_host_x"))
             if cli_args.target == "transport":
                 # opt-in only: host control-plane latency/throughput drill
                 # (sockets + failpoints; no accelerator involved at all)
@@ -860,5 +1023,10 @@ if __name__ == "__main__":
     if os.environ.get("_SHEEPRL_BENCH_CPU_FALLBACK"):
         # numbers are real but from the CPU backend — flag them as incomparable
         result["cpu_fallback"] = True
+        result["status"] = "cpu_fallback"
         result["warning"] = "accelerator unreachable: results measured on the CPU fallback backend"
+    # every record now carries an explicit status: "ok" (measured on the chosen
+    # backend), "cpu_fallback" (measured, but on the fallback), or "skipped"
+    # (the watchdog's double-timeout record above — no measurement at all)
+    result.setdefault("status", "ok")
     print(json.dumps(result))

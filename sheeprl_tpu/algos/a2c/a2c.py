@@ -29,6 +29,7 @@ from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
+from sheeprl_tpu.envs import ingraph as ingraph_envs
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -120,6 +121,7 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
+    use_ingraph = ingraph_envs.env_backend(cfg) == "ingraph"
     if len(cfg.algo.cnn_keys.encoder) > 0:
         raise ValueError("A2C is vector-observation only: do not set `algo.cnn_keys.encoder`")
     world_size = runtime.world_size
@@ -142,14 +144,21 @@ def main(runtime, cfg: Dict[str, Any]):
         cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
     )
     n_envs = cfg.env.num_envs * world_size
-    envs = resilience.make_supervised_env(
-        [
-            make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
-            for i in range(n_envs)
-        ],
-        sync=cfg.env.sync_env,
-        ft=ft,
-    )
+    if use_ingraph:
+        # in-graph backend (envs/ingraph/): the env batch is one device-resident
+        # pytree stepped inside the fused rollout scan (see ppo.py for the
+        # full rationale — A2C shares the structure)
+        collect_device = runtime.device
+        envs = ingraph_envs.make_vector_env(cfg, n_envs, cfg.seed, device=collect_device)
+    else:
+        envs = resilience.make_supervised_env(
+            [
+                make_env(cfg, cfg.seed + i, 0, log_dir if runtime.is_global_zero else None, "train", vector_env_idx=i)
+                for i in range(n_envs)
+            ],
+            sync=cfg.env.sync_env,
+            ft=ft,
+        )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
@@ -166,6 +175,11 @@ def main(runtime, cfg: Dict[str, Any]):
     agent, params, player = build_agent(
         runtime, actions_dim, is_continuous, cfg, observation_space, state["agent"] if state else None
     )
+    if use_ingraph:
+        # policy forward runs inside the scan on the collect device, not on the
+        # (host) player device build_agent placed the params on
+        player.params = jax.device_put(player.params, collect_device)
+    player_sync_device = collect_device if use_ingraph else runtime.player_device
 
     tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
     opt_state = tx.init(params)
@@ -214,8 +228,21 @@ def main(runtime, cfg: Dict[str, Any]):
     # ----- software pipeline (core/pipeline.py): same structure as ppo.py — env
     # workers step while the host closes out the previous step; obs reach the
     # device as ONE packed put per step with the prior rewards/dones riding along
-    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg) and not use_ingraph)
     codec = PackedObsCodec(cnn_keys=(), device=runtime.player_device)
+    collector = None
+    if use_ingraph:
+        # A2C's loss recomputes logprobs, so the collector stores only
+        # obs/actions/values/rewards/dones
+        collector = ingraph_envs.InGraphRolloutCollector(
+            envs,
+            player,
+            rollout_steps=cfg.algo.rollout_steps,
+            gamma=cfg.algo.gamma,
+            clip_rewards=cfg.env.clip_rewards,
+            store_logprobs=False,
+            name="a2c",
+        )
     zero_extra = {
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
@@ -225,7 +252,34 @@ def main(runtime, cfg: Dict[str, Any]):
     # packed-act step, the accumulate-and-apply train step, and the metric-drain
     # kernels on a background thread while the first rollout collects.
     warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
-    if warmup.enabled:
+    if warmup.enabled and use_ingraph:
+        # ONE rollout entry point (the fused scan); its abstract outputs are the
+        # train step's input specs — both derive without touching the device
+        warmup.add(collector.collect_fn, *collector.warmup_specs())
+        data_specs, nv_spec = collector.output_specs()
+        warmup.add(
+            train_fn,
+            jax_compile.specs_of(params),
+            jax_compile.specs_of(opt_state),
+            data_specs,
+            jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+            jax_compile.spec_like(rng),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+        if aggregator is not None:
+            warmup.add_task(
+                lambda: aggregator.precompile_drain(
+                    (
+                        "Loss/policy_loss",
+                        "Loss/value_loss",
+                        "Resilience/nonfinite_skips",
+                        "Grads/global_norm",
+                    )
+                ),
+                name="metric.drain",
+            )
+        warmup.start()
+    elif warmup.enabled:
         packed0 = codec.encode(next_obs, extra=zero_extra)
         act_fn = player.packed_act_fn(codec)
         act_specs = (
@@ -326,54 +380,72 @@ def main(runtime, cfg: Dict[str, Any]):
     with guard:
         for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
-            for _ in range(cfg.algo.rollout_steps):
-                policy_step += n_envs
+            if use_ingraph:
+                # ----- fused in-graph rollout (envs/ingraph/rollout.py): ONE jitted
+                # call replaces the whole per-step host loop (see ppo.py)
+                with timer("Time/env_interaction_time", SumMetric()):
+                    policy_step += n_envs * cfg.algo.rollout_steps
+                    ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
+                # zero-cost unless an env.autoreset drill is armed
+                envs.fire_autoreset_failpoints(roll_metrics["dones"])
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(
+                        ingraph_envs.iter_finished_episodes(roll_metrics)
+                    ):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+            else:
+                for _ in range(cfg.algo.rollout_steps):
+                    policy_step += n_envs
+
+                    with timer("Time/env_interaction_time", SumMetric()):
+                        # ONE packed host->device transfer per step (A2C reuses the
+                        # PPO agent, vector obs only; see PPOPlayer.act_packed)
+                        packed = codec.encode(
+                            next_obs,
+                            extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                            if pending
+                            else zero_extra,
+                        )
+                        cat_actions, env_actions, _, values, player_rng = player.act_packed(
+                            codec, packed, player_rng
+                        )
+                        # the one unavoidable per-step device->host sync: env actions
+                        real_actions = np.asarray(env_actions)
+                        stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                        # ---- overlap window: env workers are stepping
+                        _process_pending(packed)
+                        if device_rollout:
+                            # in-graph scatter: actions/values stay in HBM (A2C's loss
+                            # recomputes logprobs, so only these two leaves are stored)
+                            rb.add_policy({"actions": cat_actions, "values": values})
+
+                        obs, rewards, terminated, truncated, info = stepper.step_wait()
+                        dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                        rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
+
+                        pending.update(
+                            packed=packed,
+                            rewards=rewards,
+                            dones=dones,
+                            info=info,
+                            values=values,
+                            cat_actions=cat_actions,
+                        )
+
+                        next_obs = {}
+                        for k in obs_keys:
+                            next_obs[k] = obs[k]
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # ONE packed host->device transfer per step (A2C reuses the
-                    # PPO agent, vector obs only; see PPOPlayer.act_packed)
-                    packed = codec.encode(
-                        next_obs,
-                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
-                        if pending
-                        else zero_extra,
-                    )
-                    cat_actions, env_actions, _, values, player_rng = player.act_packed(
-                        codec, packed, player_rng
-                    )
-                    # the one unavoidable per-step device->host sync: env actions
-                    real_actions = np.asarray(env_actions)
-                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
+                    # flush: the rollout's last row has no next act transfer to ride
+                    _process_pending(None)
 
-                    # ---- overlap window: env workers are stepping
-                    _process_pending(packed)
-                    if device_rollout:
-                        # in-graph scatter: actions/values stay in HBM (A2C's loss
-                        # recomputes logprobs, so only these two leaves are stored)
-                        rb.add_policy({"actions": cat_actions, "values": values})
-
-                    obs, rewards, terminated, truncated, info = stepper.step_wait()
-                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
-                    rewards = np.asarray(rewards, dtype=np.float32).reshape(n_envs, -1)
-
-                    pending.update(
-                        packed=packed,
-                        rewards=rewards,
-                        dones=dones,
-                        info=info,
-                        values=values,
-                        cat_actions=cat_actions,
-                    )
-
-                    next_obs = {}
-                    for k in obs_keys:
-                        next_obs[k] = obs[k]
-
-            with timer("Time/env_interaction_time", SumMetric()):
-                # flush: the rollout's last row has no next act transfer to ride
-                _process_pending(None)
-
-            if not device_rollout:
+            if not device_rollout and not use_ingraph:
                 local_data = rb.to_arrays(dtype=np.float32)
                 if cfg.buffer.size > cfg.algo.rollout_steps:
                     idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
@@ -383,15 +455,22 @@ def main(runtime, cfg: Dict[str, Any]):
                     # surface any residual warmup compile time here rather than
                     # inside the train call (the rollout overlapped the thread)
                     warmup.wait()
-                jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
                 rng, train_key = jax.random.split(rng)
-                if device_rollout:
+                if use_ingraph:
+                    # rollout and bootstrap values already on device in the
+                    # buffer layout; one collect-device -> trainer-mesh move
+                    device_data, next_values = runtime.replicate(
+                        (ingraph_data, ingraph_next_values)
+                    )
+                elif device_rollout:
                     # HBM rollout + bootstrap values: player-device -> trainer-mesh,
                     # no host round-trip
+                    jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
                     device_data, next_values = runtime.replicate(
                         (rb.rollout(), player.get_values(jax_obs))
                     )
                 else:
+                    jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
                     next_values = np.asarray(player.get_values(jax_obs))
                     device_data = {
                         k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
@@ -400,7 +479,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     params, opt_state, device_data, next_values, train_key,
                     jnp.float32(sentinel.lr_scale),
                 )
-                player.params = params_sync.pull(flat_params, runtime.player_device)
+                player.params = params_sync.pull(flat_params, player_sync_device)
                 if not timer.disabled:
                     jax.block_until_ready(params)
             train_step += world_size
@@ -463,7 +542,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         player_rng = jax.device_put(
                             jnp.asarray(rb_state["player_rng"]), runtime.player_device
                         )
-                    player.params = params_sync.pull(params_sync.ravel(params), runtime.player_device)
+                    player.params = params_sync.pull(params_sync.ravel(params), player_sync_device)
                     if sentinel.reseed_envs:
                         pending.clear()
                         reset_obs = envs.reset(seed=cfg.seed + iter_num)[0]
@@ -511,6 +590,9 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
-        test(player, runtime, cfg, log_dir)
+        if use_ingraph:
+            ingraph_envs.test(player, runtime, cfg, log_dir)
+        else:
+            test(player, runtime, cfg, log_dir)
     if logger:
         logger.finalize()

@@ -273,6 +273,8 @@ class PPOPlayer:
         self._greedy_raw = jax_compile.guarded_jit(_greedy_raw, name="ppo.greedy_raw")
         self._values = jax_compile.guarded_jit(_values, name="ppo.values")
         self._act_impl = _act  # unjitted: fused into the packed-act trace
+        self._values_impl = _values  # unjitted: fused into the in-graph rollout scan
+        self._greedy_impl = _greedy
         self._packed_act_fns: Dict[Any, Any] = {}
 
     def __call__(self, obs: Dict[str, jax.Array], key: jax.Array):

@@ -35,6 +35,7 @@ from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
+from sheeprl_tpu.envs import ingraph as ingraph_envs
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -145,7 +146,8 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
 
 @register_algorithm()
 def main(runtime, cfg: Dict[str, Any]):
-    if "minedojo" in cfg.env.wrapper._target_.lower():
+    use_ingraph = ingraph_envs.env_backend(cfg) == "ingraph"
+    if not use_ingraph and "minedojo" in cfg.env.wrapper._target_.lower():
         raise ValueError(
             "MineDojo is not currently supported by PPO agent, since it does not take "
             "into consideration the action masks provided by the environment, but needed "
@@ -176,21 +178,29 @@ def main(runtime, cfg: Dict[str, Any]):
         cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=world_size
     )
     n_envs = cfg.env.num_envs * world_size
-    envs = resilience.make_supervised_env(
-        [
-            make_env(
-                cfg,
-                cfg.seed + i,
-                0,
-                log_dir if runtime.is_global_zero else None,
-                "train",
-                vector_env_idx=i,
-            )
-            for i in range(n_envs)
-        ],
-        sync=cfg.env.sync_env,
-        ft=ft,
-    )
+    if use_ingraph:
+        # in-graph backend: no worker pool, no supervision layer — the whole
+        # batch of envs is one device-resident pytree stepped inside the fused
+        # rollout (envs/ingraph/). Collection runs on the accelerator even when
+        # the player would normally sit on host.
+        collect_device = runtime.device
+        envs = ingraph_envs.make_vector_env(cfg, n_envs, cfg.seed, device=collect_device)
+    else:
+        envs = resilience.make_supervised_env(
+            [
+                make_env(
+                    cfg,
+                    cfg.seed + i,
+                    0,
+                    log_dir if runtime.is_global_zero else None,
+                    "train",
+                    vector_env_idx=i,
+                )
+                for i in range(n_envs)
+            ],
+            sync=cfg.env.sync_env,
+            ft=ft,
+        )
     observation_space = envs.single_observation_space
     if not isinstance(observation_space, gym.spaces.Dict):
         raise RuntimeError(f"Unexpected observation type, should be of type Dict, got: {observation_space}")
@@ -222,6 +232,11 @@ def main(runtime, cfg: Dict[str, Any]):
         observation_space,
         state["agent"] if state else None,
     )
+    if use_ingraph:
+        # policy forward happens inside the scan on the collect device, not on
+        # the (host) player device build_agent placed the params on
+        player.params = jax.device_put(player.params, collect_device)
+    player_sync_device = collect_device if use_ingraph else runtime.player_device
 
     # Optimizer: optax chain (clipping + optional linear lr decay = PolynomialLR(power=1))
     policy_steps_per_iter = int(n_envs * cfg.algo.rollout_steps)
@@ -306,8 +321,19 @@ def main(runtime, cfg: Dict[str, Any]):
     # host closes out the PREVIOUS step and dispatches this one's device work;
     # the obs reach the device as ONE packed put per step with the previous
     # step's rewards/dones riding along for the buffer's row-close write
-    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg))
+    stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg) and not use_ingraph)
     codec = PackedObsCodec(cnn_keys=cnn_keys, device=runtime.player_device)
+    collector = None
+    if use_ingraph:
+        collector = ingraph_envs.InGraphRolloutCollector(
+            envs,
+            player,
+            rollout_steps=cfg.algo.rollout_steps,
+            gamma=cfg.algo.gamma,
+            clip_rewards=cfg.env.clip_rewards,
+            store_logprobs=True,
+            name="ppo",
+        )
     zero_extra = {
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
@@ -318,7 +344,38 @@ def main(runtime, cfg: Dict[str, Any]):
     # first rollout collects; the first train call then executes a pre-built
     # executable (trace count 0 at call time, Compile/retraces stays 0).
     warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
-    if warmup.enabled:
+    if warmup.enabled and use_ingraph:
+        # the whole rollout is ONE entry point (the fused scan); its abstract
+        # outputs are exactly the train step's inputs, so both specs derive
+        # without touching the device
+        warmup.add(collector.collect_fn, *collector.warmup_specs())
+        data_specs, nv_spec = collector.output_specs()
+        warmup.add(
+            train_fn,
+            jax_compile.specs_of(params),
+            jax_compile.specs_of(opt_state),
+            data_specs,
+            jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+            jax_compile.spec_like(rng),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+        if aggregator is not None:
+            warmup.add_task(
+                lambda: aggregator.precompile_drain(
+                    (
+                        "Loss/policy_loss",
+                        "Loss/value_loss",
+                        "Loss/entropy_loss",
+                        "Resilience/nonfinite_skips",
+                        "Grads/global_norm",
+                    )
+                ),
+                name="metric.drain",
+            )
+        warmup.start()
+    elif warmup.enabled:
         packed0 = codec.encode(next_obs, extra=zero_extra)
         act_fn = player.packed_act_fn(codec)
         act_specs = (
@@ -434,98 +491,119 @@ def main(runtime, cfg: Dict[str, Any]):
     with guard:
         for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
-            for _ in range(cfg.algo.rollout_steps):
-                policy_step += n_envs
+            if use_ingraph:
+                # ----- fused in-graph rollout (envs/ingraph/rollout.py): ONE jitted
+                # call replaces the whole per-step host loop; obs/actions/rewards
+                # never leave the device and the buffer layout comes out ready
+                # for the train step below
+                with timer("Time/env_interaction_time", SumMetric()):
+                    policy_step += n_envs * cfg.algo.rollout_steps
+                    ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
+                # zero-cost unless an env.autoreset drill is armed (the has()
+                # probe short-circuits before any device pull)
+                envs.fire_autoreset_failpoints(roll_metrics["dones"])
+                if cfg.metric.log_level > 0:
+                    for i, (ep_rew, ep_len) in enumerate(
+                        ingraph_envs.iter_finished_episodes(roll_metrics)
+                    ):
+                        if aggregator and "Rewards/rew_avg" in aggregator:
+                            aggregator.update("Rewards/rew_avg", ep_rew)
+                        if aggregator and "Game/ep_len_avg" in aggregator:
+                            aggregator.update("Game/ep_len_avg", ep_len)
+                        runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+            else:
+                for _ in range(cfg.algo.rollout_steps):
+                    policy_step += n_envs
+
+                    with timer("Time/env_interaction_time", SumMetric()):
+                        # ONE packed host->device transfer per step: obs plus the
+                        # previous step's rewards/dones (decoded only by the buffer
+                        # write), normalization runs in-graph (PPOPlayer.act_packed)
+                        packed = codec.encode(
+                            next_obs,
+                            extra={"rewards": pending["rewards"], "dones": pending["dones"]}
+                            if pending
+                            else zero_extra,
+                        )
+                        cat_actions, env_actions, logprobs, values, player_rng = player.act_packed(
+                            codec, packed, player_rng
+                        )
+                        # the ONE unavoidable per-step device->host sync: the env needs
+                        # the actions on host to step
+                        real_actions = np.asarray(env_actions)
+                        stepper.step_async(real_actions.reshape(envs.action_space.shape))
+
+                        # ---- overlap window: env workers are stepping; close out the
+                        # previous step and dispatch this one's policy-row scatter
+                        _process_pending(packed)
+                        if device_rollout:
+                            # in-graph scatter straight from the player step's outputs:
+                            # values/logprobs/actions stay in HBM, no host pull
+                            rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
+
+                        obs, rewards, terminated, truncated, info = stepper.step_wait()
+                        truncated_envs = np.nonzero(truncated)[0]
+                        if len(truncated_envs) > 0 and "final_obs" in info:
+                            # bootstrap on truncation (reference ppo.py:292-309)
+                            final_obs_arr = np.asarray(info["final_obs"], dtype=object)
+                            real_next_obs = {k: [] for k in obs_keys}
+                            valid_idx = []
+                            for te in truncated_envs:
+                                fo = final_obs_arr[te]
+                                if fo is None:
+                                    continue
+                                valid_idx.append(te)
+                                for k in obs_keys:
+                                    v = np.asarray(fo[k], dtype=np.float32)
+                                    if k in cnn_keys:
+                                        v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
+                                    real_next_obs[k].append(v)
+                            if valid_idx:
+                                # canonical shape: pad to the FULL [n_envs, ...] batch and
+                                # gather the valid rows after, so the values forward keeps
+                                # ONE compiled shape no matter how many envs truncated
+                                # (1..n_envs distinct shapes would otherwise each compile)
+                                padded = {
+                                    k: np.zeros((n_envs, *np.asarray(v[0]).shape), np.float32)
+                                    for k, v in real_next_obs.items()
+                                }
+                                for j, te in enumerate(valid_idx):
+                                    for k in obs_keys:
+                                        padded[k][te] = real_next_obs[k][j]
+                                stacked = {
+                                    k: jax.device_put(v, runtime.player_device) for k, v in padded.items()
+                                }
+                                vals = np.asarray(player.get_values(stacked)).reshape(n_envs)
+                                rewards = np.asarray(rewards, dtype=np.float32)
+                                rewards[valid_idx] += cfg.algo.gamma * vals[valid_idx]
+                        dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
+                        rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
+
+                        # env products become the next step's pending work: the row
+                        # write and episode accounting run in the NEXT overlap window
+                        pending.update(
+                            packed=packed,
+                            rewards=rewards,
+                            dones=dones,
+                            info=info,
+                            values=values,
+                            cat_actions=cat_actions,
+                            logprobs=logprobs,
+                        )
+
+                        next_obs = {}
+                        for k in obs_keys:
+                            _obs = obs[k]
+                            if k in cnn_keys:
+                                _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
+                            next_obs[k] = _obs
 
                 with timer("Time/env_interaction_time", SumMetric()):
-                    # ONE packed host->device transfer per step: obs plus the
-                    # previous step's rewards/dones (decoded only by the buffer
-                    # write), normalization runs in-graph (PPOPlayer.act_packed)
-                    packed = codec.encode(
-                        next_obs,
-                        extra={"rewards": pending["rewards"], "dones": pending["dones"]}
-                        if pending
-                        else zero_extra,
-                    )
-                    cat_actions, env_actions, logprobs, values, player_rng = player.act_packed(
-                        codec, packed, player_rng
-                    )
-                    # the ONE unavoidable per-step device->host sync: the env needs
-                    # the actions on host to step
-                    real_actions = np.asarray(env_actions)
-                    stepper.step_async(real_actions.reshape(envs.action_space.shape))
-
-                    # ---- overlap window: env workers are stepping; close out the
-                    # previous step and dispatch this one's policy-row scatter
-                    _process_pending(packed)
-                    if device_rollout:
-                        # in-graph scatter straight from the player step's outputs:
-                        # values/logprobs/actions stay in HBM, no host pull
-                        rb.add_policy({"actions": cat_actions, "logprobs": logprobs, "values": values})
-
-                    obs, rewards, terminated, truncated, info = stepper.step_wait()
-                    truncated_envs = np.nonzero(truncated)[0]
-                    if len(truncated_envs) > 0 and "final_obs" in info:
-                        # bootstrap on truncation (reference ppo.py:292-309)
-                        final_obs_arr = np.asarray(info["final_obs"], dtype=object)
-                        real_next_obs = {k: [] for k in obs_keys}
-                        valid_idx = []
-                        for te in truncated_envs:
-                            fo = final_obs_arr[te]
-                            if fo is None:
-                                continue
-                            valid_idx.append(te)
-                            for k in obs_keys:
-                                v = np.asarray(fo[k], dtype=np.float32)
-                                if k in cnn_keys:
-                                    v = v.reshape(-1, *v.shape[-2:]) / 255.0 - 0.5
-                                real_next_obs[k].append(v)
-                        if valid_idx:
-                            # canonical shape: pad to the FULL [n_envs, ...] batch and
-                            # gather the valid rows after, so the values forward keeps
-                            # ONE compiled shape no matter how many envs truncated
-                            # (1..n_envs distinct shapes would otherwise each compile)
-                            padded = {
-                                k: np.zeros((n_envs, *np.asarray(v[0]).shape), np.float32)
-                                for k, v in real_next_obs.items()
-                            }
-                            for j, te in enumerate(valid_idx):
-                                for k in obs_keys:
-                                    padded[k][te] = real_next_obs[k][j]
-                            stacked = {
-                                k: jax.device_put(v, runtime.player_device) for k, v in padded.items()
-                            }
-                            vals = np.asarray(player.get_values(stacked)).reshape(n_envs)
-                            rewards = np.asarray(rewards, dtype=np.float32)
-                            rewards[valid_idx] += cfg.algo.gamma * vals[valid_idx]
-                    dones = np.logical_or(terminated, truncated).reshape(n_envs, -1).astype(np.uint8)
-                    rewards = clip_rewards_fn(np.asarray(rewards, dtype=np.float32)).reshape(n_envs, -1)
-
-                    # env products become the next step's pending work: the row
-                    # write and episode accounting run in the NEXT overlap window
-                    pending.update(
-                        packed=packed,
-                        rewards=rewards,
-                        dones=dones,
-                        info=info,
-                        values=values,
-                        cat_actions=cat_actions,
-                        logprobs=logprobs,
-                    )
-
-                    next_obs = {}
-                    for k in obs_keys:
-                        _obs = obs[k]
-                        if k in cnn_keys:
-                            _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
-                        next_obs[k] = _obs
-
-            with timer("Time/env_interaction_time", SumMetric()):
-                # flush: the rollout's last row has no next act transfer to ride
-                _process_pending(None)
+                    # flush: the rollout's last row has no next act transfer to ride
+                    _process_pending(None)
 
             # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
-            if not device_rollout:
+            if not device_rollout and not use_ingraph:
                 local_data = rb.to_arrays(dtype=np.float32)
                 if cfg.buffer.size > cfg.algo.rollout_steps:
                     # keep only the last rollout in chronological order (stale/zero rows
@@ -538,19 +616,26 @@ def main(runtime, cfg: Dict[str, Any]):
                     # train dispatch (usually already done: the whole first
                     # rollout overlapped the warmup thread)
                     warmup.wait()
-                jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                 rng, train_key = jax.random.split(rng)
-                if device_rollout:
+                if use_ingraph:
+                    # rollout and bootstrap values are already on device in the
+                    # buffer layout; one collect-device -> trainer-mesh move
+                    device_data, next_values = runtime.replicate(
+                        (ingraph_data, ingraph_next_values)
+                    )
+                elif device_rollout:
                     # zero bulk host->device transfer: the completed HBM rollout and
                     # the bootstrap values move player-device -> trainer-mesh directly
                     # (ownership transfers out of the buffer, so the train fn's view
                     # is never aliased by next iteration's donated writes)
+                    jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                     device_data, next_values = runtime.replicate(
                         (rb.rollout(), player.get_values(jax_obs))
                     )
                 else:
                     # bootstrap values come from the player device; re-enter the mesh
                     # uncommitted so the jitted train step can place them freely
+                    jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                     next_values = np.asarray(player.get_values(jax_obs))
                     device_data = {
                         k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
@@ -567,7 +652,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 )
                 # refresh the player's copy with ONE cross-backend transfer; the next
                 # rollout implicitly waits for (only) the params it needs
-                player.params = params_sync.pull(flat_params, runtime.player_device)
+                player.params = params_sync.pull(flat_params, player_sync_device)
                 if not timer.disabled:  # sync only when the train phase is being timed
                     jax.block_until_ready(params)
             train_step += world_size
@@ -646,7 +731,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         player_rng = jax.device_put(
                             jnp.asarray(rb_state["player_rng"]), runtime.player_device
                         )
-                    player.params = params_sync.pull(params_sync.ravel(params), runtime.player_device)
+                    player.params = params_sync.pull(params_sync.ravel(params), player_sync_device)
                     if sentinel.reseed_envs:
                         # drop the in-flight transition (it was produced by the
                         # poisoned policy) and restart the streams on a fresh seed
@@ -701,6 +786,9 @@ def main(runtime, cfg: Dict[str, Any]):
     profiler.close()
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
-        test(player, runtime, cfg, log_dir)
+        if use_ingraph:
+            ingraph_envs.test(player, runtime, cfg, log_dir)
+        else:
+            test(player, runtime, cfg, log_dir)
     if logger:
         logger.finalize()

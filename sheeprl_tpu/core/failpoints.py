@@ -1,8 +1,9 @@
 """Deterministic fault injection: named failpoints with triggers and actions.
 
 Every hardening seam in the runtime (checkpoint fsync, KV transport send/recv,
-hot-reload canary, orchestrator injection, env workers, preemption guard) hosts
-a named hook::
+hot-reload canary, orchestrator injection, env workers, the in-graph vector-env
+driver's ``env.reset``/``env.autoreset``, preemption guard) hosts a named
+hook::
 
     from sheeprl_tpu.core import failpoints
     failpoints.failpoint("ckpt.finalize", path=final_path)

@@ -60,6 +60,12 @@ def make_rollout_buffer(cfg, runtime, n_envs: int, obs_keys: Sequence[str], log_
       ``buffer.size > rollout_steps`` keeps extra history host-side only, which
       the device layout doesn't model — use the host backend for that.
     """
+    env_cfg = getattr(cfg, "env", None)
+    if env_cfg is not None and str(env_cfg.get("backend", "gym")).lower() == "ingraph":
+        # the fused in-graph collector (envs/ingraph/rollout.py) materializes
+        # the [T, B] rollout directly in the buffer layout as its scan output —
+        # there is no incremental store to manage
+        return None
     if buffer_backend(cfg) == "device":
         if cfg.buffer.get("memmap", False):
             # memmap defaults True for the host path; flipping backend=device

@@ -1,0 +1,143 @@
+"""In-graph vectorized environment backend.
+
+Pure-JAX functional envs (:mod:`base`) plus the host-side vector driver
+(:mod:`vector`) and the fused ``lax.scan`` rollout collector (:mod:`rollout`).
+Selected from config with one flag — ``env.backend=ingraph`` — via the
+``env/jax_*.yaml`` groups; everything else (buffer layout, train step, metric
+names) is unchanged, so the two backends are swappable per-run.
+
+See ``howto/ingraph_envs.md`` for the full tour and the parity/transfer
+guarantees the tests pin.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv, autoreset_step
+from sheeprl_tpu.envs.ingraph.cartpole import CartPole, CartPoleParams, CartPoleState
+from sheeprl_tpu.envs.ingraph.gridworld import GridWorld, GridWorldParams, GridWorldState
+from sheeprl_tpu.envs.ingraph.pendulum import Pendulum, PendulumParams, PendulumState
+from sheeprl_tpu.envs.ingraph.rollout import InGraphRolloutCollector, iter_finished_episodes
+from sheeprl_tpu.envs.ingraph.vector import Carry, InGraphVectorEnv
+
+__all__ = [
+    "EnvParams",
+    "FuncEnv",
+    "autoreset_step",
+    "CartPole",
+    "CartPoleParams",
+    "CartPoleState",
+    "Pendulum",
+    "PendulumParams",
+    "PendulumState",
+    "GridWorld",
+    "GridWorldParams",
+    "GridWorldState",
+    "Carry",
+    "InGraphVectorEnv",
+    "InGraphRolloutCollector",
+    "iter_finished_episodes",
+    "register",
+    "make",
+    "env_backend",
+    "make_vector_env",
+    "test",
+]
+
+# env id -> FuncEnv class. Ids deliberately shadow the Gymnasium ones so
+# ``env.backend=ingraph`` flips the backend without touching ``env.id``.
+_REGISTRY: Dict[str, Type[FuncEnv]] = {
+    "CartPole-v1": CartPole,
+    "Pendulum-v1": Pendulum,
+    "GridWorld-v0": GridWorld,
+}
+
+
+def register(env_id: str, env_cls: Type[FuncEnv]) -> None:
+    """Add a FuncEnv to the in-graph registry (downstream/test envs)."""
+    _REGISTRY[env_id] = env_cls
+
+
+def make(env_id: str, **param_overrides) -> Tuple[FuncEnv, EnvParams]:
+    """Instantiate a registered in-graph env and its (possibly overridden) params."""
+    if env_id not in _REGISTRY:
+        raise ValueError(
+            f"no in-graph port of '{env_id}' (have: {sorted(_REGISTRY)}); "
+            "use env.backend=gym or register() a FuncEnv port"
+        )
+    env = _REGISTRY[env_id]()
+    return env, env.default_params(**param_overrides)
+
+
+def env_backend(cfg) -> str:
+    """'gym' (host subprocess envs, the default) or 'ingraph'."""
+    return str(cfg.env.get("backend", "gym")).lower()
+
+
+def make_vector_env(
+    cfg, num_envs: int, seed: int, device: Optional[Any] = None
+) -> InGraphVectorEnv:
+    """Build the in-graph vector env the way the train loops expect it.
+
+    The single mlp encoder key becomes the obs-dict key (the in-graph ports are
+    all vector-observation envs — pixel keys are a config error, same contract
+    the A2C loop enforces for its encoder). ``env.ingraph.*`` entries override
+    EnvParams fields; ``env.max_episode_steps`` maps onto the in-graph TimeLimit.
+    """
+    if cfg.algo.cnn_keys.encoder:
+        raise ValueError(
+            "env.backend=ingraph supports vector observations only; "
+            f"remove cnn keys {list(cfg.algo.cnn_keys.encoder)} from algo.cnn_keys.encoder"
+        )
+    mlp_keys = list(cfg.algo.mlp_keys.encoder)
+    if len(mlp_keys) != 1:
+        raise ValueError(
+            f"env.backend=ingraph expects exactly one mlp encoder key, got {mlp_keys}"
+        )
+    overrides = dict(cfg.env.get("ingraph", None) or {})
+    if cfg.env.max_episode_steps is not None:
+        overrides.setdefault("max_episode_steps", int(cfg.env.max_episode_steps))
+    env, params = make(cfg.env.id, **overrides)
+    return InGraphVectorEnv(
+        env, params, num_envs, obs_key=mlp_keys[0], seed=seed, device=device
+    )
+
+
+def _env_actions_to_step(venv: InGraphVectorEnv, env_actions: np.ndarray) -> np.ndarray:
+    """Player env-actions ``[B, n_heads]`` -> what ``venv.step`` feeds the vmapped
+    env: a scalar per env for discrete actions, the action vector for continuous."""
+    import gymnasium as gym
+
+    if isinstance(venv.single_action_space, gym.spaces.Discrete):
+        return np.asarray(env_actions)[:, 0]
+    return np.asarray(env_actions)
+
+
+def test(player, runtime, cfg, log_dir: str) -> None:
+    """Greedy evaluation episode on the in-graph backend (the ingraph
+    counterpart of ``algos.ppo.utils.test``, which spins up a host gym env)."""
+    venv = make_vector_env(cfg, 1, int(cfg.seed))
+    obs, _ = venv.reset(seed=int(cfg.seed))
+    key = jax.random.PRNGKey(int(cfg.seed))
+    done = False
+    cumulative_rew = 0.0
+    while not done:
+        jax_obs = {k: jnp.asarray(v, jnp.float32) for k, v in obs.items()}
+        env_actions, key = player.get_actions(jax_obs, key, greedy=True)
+        obs, reward, terminated, truncated, _ = venv.step(
+            _env_actions_to_step(venv, np.asarray(env_actions))
+        )
+        done = bool(terminated[0] or truncated[0])
+        cumulative_rew += float(reward[0])
+        if cfg.dry_run:
+            done = True
+    if cfg.metric.log_level > 0:
+        runtime.print(f"Test - Reward: {cumulative_rew}")
+        if hasattr(runtime, "logger") and runtime.logger is not None:
+            runtime.logger.log_metrics({"Test/cumulative_reward": cumulative_rew}, 0)
+    venv.close()

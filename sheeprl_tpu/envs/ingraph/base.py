@@ -1,0 +1,122 @@
+"""The functional (jit-compatible) environment interface.
+
+An in-graph env is a pair of pure functions over an immutable :class:`EnvParams`:
+
+- ``reset(key, params) -> (state, obs)``
+- ``step(key, state, action, params) -> (state, obs, reward, done, info)``
+
+State is a NamedTuple of arrays (a pytree), so the whole env `vmap`s over a batch
+axis and `lax.scan`s over time with no host involvement — the Anakin/Podracer
+actor architecture (Hessel et al., 2021) that gymnax/PureJaxRL made standard.
+
+Auto-reset follows the gymnax convention: :func:`autoreset_step` wraps
+``env.step`` so that when an episode ends, the *returned* state/obs ARE the next
+episode's reset state/obs (the collector never sees a dead env), and the
+pre-reset observation is exposed as ``info["terminal_obs"]`` so trajectory-parity
+tests (and the truncation value-bootstrap) can still reach it.
+
+Dynamics run in ``params.dtype``: ``float32`` for production throughput,
+``float64`` in the parity tests where the Gymnasium reference envs keep f64
+internal state (observations are always emitted as the f32 the reference
+envs return — see howto/ingraph_envs.md for the exact parity contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+__all__ = ["EnvParams", "FuncEnv", "autoreset_step"]
+
+
+@dataclasses.dataclass(frozen=True)
+class EnvParams:
+    """Static env configuration closed over by the jitted step/reset.
+
+    Frozen: a changed parameterization is a new compile, never a silent
+    in-place mutation of an already-traced closure. ``max_episode_steps=None``
+    disables the in-graph TimeLimit (no truncation).
+    """
+
+    max_episode_steps: Optional[int] = None
+    dtype: Any = jnp.float32
+
+    def replace(self, **changes) -> "EnvParams":
+        return dataclasses.replace(self, **changes)
+
+
+class FuncEnv:
+    """Base class for pure-function environments (unbatched; `vmap` adds B).
+
+    Subclasses implement ``default_params``, ``reset``, ``step_dynamics`` and the
+    two space builders. ``step`` (provided here) layers the step counter and the
+    TimeLimit truncation on top of ``step_dynamics`` so every env shares one
+    episode-boundary contract: ``done = terminated | truncated`` with both flags
+    reported separately in ``info``.
+    """
+
+    def default_params(self, **overrides) -> EnvParams:
+        raise NotImplementedError
+
+    def reset(self, key: jax.Array, params: EnvParams) -> Tuple[Any, jax.Array]:
+        raise NotImplementedError
+
+    def step_dynamics(
+        self, key: jax.Array, state: Any, action: jax.Array, params: EnvParams
+    ) -> Tuple[Any, jax.Array, jax.Array, jax.Array]:
+        """One transition: ``(new_state, obs, reward_f32, terminated_bool)``.
+
+        ``new_state.t`` must already be incremented (the shared ``step`` checks
+        it against the TimeLimit).
+        """
+        raise NotImplementedError
+
+    def step(
+        self, key: jax.Array, state: Any, action: jax.Array, params: EnvParams
+    ) -> Tuple[Any, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+        new_state, obs, reward, terminated = self.step_dynamics(key, state, action, params)
+        if params.max_episode_steps:
+            truncated = jnp.logical_and(
+                new_state.t >= jnp.int32(params.max_episode_steps), jnp.logical_not(terminated)
+            )
+        else:
+            truncated = jnp.zeros_like(terminated)
+        done = jnp.logical_or(terminated, truncated)
+        info = {"terminated": terminated, "truncated": truncated}
+        return new_state, obs, reward, done, info
+
+    def observation_space(self, params: EnvParams) -> gym.spaces.Box:
+        raise NotImplementedError
+
+    def action_space(self, params: EnvParams) -> gym.Space:
+        raise NotImplementedError
+
+
+def autoreset_step(env: FuncEnv, params: EnvParams):
+    """Wrap ``env.step`` with gymnax-style auto-reset (unbatched; `vmap` ready).
+
+    On ``done`` the returned state/obs are a fresh episode's reset (drawn from a
+    key split off the step key, so the reset stream is deterministic given the
+    rollout key chain) and the pre-reset observation rides in
+    ``info["terminal_obs"]``. ``where``-selecting both branches costs one
+    always-computed reset per step — for in-graph envs that is a handful of
+    vector ops, the standard price of branchless device residency.
+    """
+
+    def step(key: jax.Array, state: Any, action: jax.Array):
+        key_step, key_reset = jax.random.split(key)
+        st, obs_st, reward, done, info = env.step(key_step, state, action, params)
+        reset_state, reset_obs = env.reset(key_reset, params)
+        new_state = jax.tree_util.tree_map(
+            lambda a, b: jnp.where(done, b, a), st, reset_state
+        )
+        obs = jnp.where(done, reset_obs, obs_st)
+        info = dict(info)
+        info["terminal_obs"] = obs_st
+        return new_state, obs, reward, done, info
+
+    return step

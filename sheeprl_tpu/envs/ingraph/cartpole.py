@@ -1,0 +1,102 @@
+"""Pure-JAX CartPole-v1: an exact port of the Gymnasium reference dynamics.
+
+Every arithmetic expression below mirrors ``gymnasium/envs/classic_control/
+cartpole.py`` term-for-term (same operand order, same ``np.square`` forms, Euler
+integrator), because the trajectory-parity tests assert *bit* equality against
+the reference: with ``dtype=float64`` the per-op f64 math matches numpy's
+bit-for-bit, and the f32 observation cast is the same rounding the reference
+applies when building its obs. Reordering an expression here (e.g. folding the
+``4/3`` constant) is a parity break even when algebraically neutral.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv
+
+__all__ = ["CartPole", "CartPoleParams", "CartPoleState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CartPoleParams(EnvParams):
+    gravity: float = 9.8
+    masscart: float = 1.0
+    masspole: float = 0.1
+    length: float = 0.5  # half the pole's length, as in the reference
+    force_mag: float = 10.0
+    tau: float = 0.02
+    reset_bound: float = 0.05
+    theta_threshold: float = 12 * 2 * math.pi / 360
+    x_threshold: float = 2.4
+    max_episode_steps: int = 500
+
+    @property
+    def total_mass(self) -> float:
+        return self.masspole + self.masscart
+
+    @property
+    def polemass_length(self) -> float:
+        return self.masspole * self.length
+
+
+class CartPoleState(NamedTuple):
+    y: jax.Array  # [4]: x, x_dot, theta, theta_dot (params.dtype)
+    t: jax.Array  # int32 step count within the episode
+
+
+class CartPole(FuncEnv):
+    def default_params(self, **overrides) -> CartPoleParams:
+        return CartPoleParams(**overrides)
+
+    def reset(self, key: jax.Array, params: CartPoleParams) -> Tuple[CartPoleState, jax.Array]:
+        y = jax.random.uniform(
+            key, (4,), minval=-params.reset_bound, maxval=params.reset_bound, dtype=params.dtype
+        )
+        return CartPoleState(y=y, t=jnp.int32(0)), y.astype(jnp.float32)
+
+    def step_dynamics(self, key, state, action, params):
+        x, x_dot, theta, theta_dot = state.y[0], state.y[1], state.y[2], state.y[3]
+        force = jnp.where(action == 1, params.force_mag, -params.force_mag).astype(params.dtype)
+        costheta = jnp.cos(theta)
+        sintheta = jnp.sin(theta)
+
+        temp = (force + params.polemass_length * jnp.square(theta_dot) * sintheta) / params.total_mass
+        thetaacc = (params.gravity * sintheta - costheta * temp) / (
+            params.length * (4.0 / 3.0 - params.masspole * jnp.square(costheta) / params.total_mass)
+        )
+        xacc = temp - params.polemass_length * thetaacc * costheta / params.total_mass
+
+        # Euler (the reference default integrator)
+        x = x + params.tau * x_dot
+        x_dot = x_dot + params.tau * xacc
+        theta = theta + params.tau * theta_dot
+        theta_dot = theta_dot + params.tau * thetaacc
+
+        y = jnp.stack([x, x_dot, theta, theta_dot]).astype(params.dtype)
+        terminated = (
+            (x < -params.x_threshold)
+            | (x > params.x_threshold)
+            | (theta < -params.theta_threshold)
+            | (theta > params.theta_threshold)
+        )
+        new_state = CartPoleState(y=y, t=state.t + 1)
+        # the reference pays 1.0 on every step including the terminating one
+        return new_state, y.astype(jnp.float32), jnp.float32(1.0), terminated
+
+    def observation_space(self, params: CartPoleParams) -> gym.spaces.Box:
+        high = np.array(
+            [params.x_threshold * 2, np.finfo(np.float32).max, params.theta_threshold * 2, np.finfo(np.float32).max],
+            dtype=np.float32,
+        )
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    def action_space(self, params: CartPoleParams) -> gym.spaces.Discrete:
+        return gym.spaces.Discrete(2)

@@ -1,0 +1,93 @@
+"""Procedurally-generated gridworld family, fully in-graph.
+
+Every episode draws a fresh scenario from its reset key — start cell, goal
+cell, and ``n_obstacles`` obstacle cells sampled as a prefix of one random
+permutation of the board (so they are distinct by construction). With thousands
+of vmapped envs each auto-resetting on its own key stream, a single rollout
+spans thousands of distinct layouts: the "as many scenarios as you can
+imagine" axis of the north star, at zero host cost.
+
+Observation is three flattened ``S x S`` planes (agent, goal, obstacles) —
+fixed shape, so one compile covers the whole family for a given ``size``.
+Moves into walls or obstacles leave the agent in place; reaching the goal
+terminates with ``goal_reward``, every other step pays ``step_penalty``.
+A layout with an unreachable goal is not resampled — the episode just runs to
+the TimeLimit (cheap, and the penalty signal still orders policies).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv
+
+__all__ = ["GridWorld", "GridWorldParams", "GridWorldState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GridWorldParams(EnvParams):
+    size: int = 8
+    n_obstacles: int = 8
+    goal_reward: float = 1.0
+    step_penalty: float = -0.01
+    max_episode_steps: int = 64
+
+
+class GridWorldState(NamedTuple):
+    pos: jax.Array  # [2] int32 agent cell (row, col)
+    goal: jax.Array  # [2] int32 goal cell
+    obstacles: jax.Array  # [S, S] bool
+    t: jax.Array  # int32 step count within the episode
+
+
+# row/col deltas for actions 0..3: up, down, left, right
+_MOVES = np.array([[-1, 0], [1, 0], [0, -1], [0, 1]], dtype=np.int32)
+
+
+class GridWorld(FuncEnv):
+    def default_params(self, **overrides) -> GridWorldParams:
+        return GridWorldParams(**overrides)
+
+    def reset(self, key: jax.Array, params: GridWorldParams) -> Tuple[GridWorldState, jax.Array]:
+        s = params.size
+        perm = jax.random.permutation(key, s * s)
+        pos = jnp.stack([perm[0] // s, perm[0] % s]).astype(jnp.int32)
+        goal = jnp.stack([perm[1] // s, perm[1] % s]).astype(jnp.int32)
+        obstacles = (
+            jnp.zeros((s * s,), dtype=bool).at[perm[2 : 2 + params.n_obstacles]].set(True).reshape(s, s)
+        )
+        state = GridWorldState(pos=pos, goal=goal, obstacles=obstacles, t=jnp.int32(0))
+        return state, self._obs(state, params)
+
+    @staticmethod
+    def _obs(state: GridWorldState, params: GridWorldParams) -> jax.Array:
+        s = params.size
+        agent = jnp.zeros((s, s), jnp.float32).at[state.pos[0], state.pos[1]].set(1.0)
+        goal = jnp.zeros((s, s), jnp.float32).at[state.goal[0], state.goal[1]].set(1.0)
+        return jnp.concatenate(
+            [agent.reshape(-1), goal.reshape(-1), state.obstacles.astype(jnp.float32).reshape(-1)]
+        )
+
+    def step_dynamics(self, key, state, action, params):
+        s = params.size
+        move = jnp.asarray(_MOVES)[action]
+        target = jnp.clip(state.pos + move, 0, s - 1)
+        blocked = state.obstacles[target[0], target[1]]
+        pos = jnp.where(blocked, state.pos, target)
+        reached = jnp.all(pos == state.goal)
+        reward = jnp.where(reached, params.goal_reward, params.step_penalty).astype(jnp.float32)
+        new_state = GridWorldState(pos=pos, goal=state.goal, obstacles=state.obstacles, t=state.t + 1)
+        return new_state, self._obs(new_state, params), reward, reached
+
+    def observation_space(self, params: GridWorldParams) -> gym.spaces.Box:
+        n = 3 * params.size * params.size
+        return gym.spaces.Box(0.0, 1.0, (n,), dtype=np.float32)
+
+    def action_space(self, params: GridWorldParams) -> gym.spaces.Discrete:
+        return gym.spaces.Discrete(4)

@@ -1,0 +1,82 @@
+"""Pure-JAX Pendulum-v1: an exact port of the Gymnasium reference dynamics.
+
+Same parity discipline as :mod:`cartpole`: expressions mirror
+``gymnasium/envs/classic_control/pendulum.py`` term-for-term (torque and speed
+clips, ``angle_normalize`` via the same mod form, the ``[cos, sin, thdot]`` f32
+observation). The reference env never terminates — episodes end only by the
+200-step TimeLimit, which :class:`~sheeprl_tpu.envs.ingraph.base.FuncEnv.step`
+applies in-graph from ``params.max_episode_steps``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import NamedTuple, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv
+
+__all__ = ["Pendulum", "PendulumParams", "PendulumState"]
+
+
+@dataclasses.dataclass(frozen=True)
+class PendulumParams(EnvParams):
+    g: float = 10.0
+    m: float = 1.0
+    l: float = 1.0
+    dt: float = 0.05
+    max_speed: float = 8.0
+    max_torque: float = 2.0
+    reset_high_theta: float = math.pi
+    reset_high_thdot: float = 1.0
+    max_episode_steps: int = 200
+
+
+class PendulumState(NamedTuple):
+    y: jax.Array  # [2]: theta, theta_dot (params.dtype)
+    t: jax.Array  # int32 step count within the episode
+
+
+def _angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class Pendulum(FuncEnv):
+    def default_params(self, **overrides) -> PendulumParams:
+        return PendulumParams(**overrides)
+
+    def reset(self, key: jax.Array, params: PendulumParams) -> Tuple[PendulumState, jax.Array]:
+        high = jnp.asarray([params.reset_high_theta, params.reset_high_thdot], dtype=params.dtype)
+        y = jax.random.uniform(key, (2,), minval=-high, maxval=high, dtype=params.dtype)
+        return PendulumState(y=y, t=jnp.int32(0)), self._obs(y)
+
+    @staticmethod
+    def _obs(y: jax.Array) -> jax.Array:
+        th, thdot = y[0], y[1]
+        return jnp.stack([jnp.cos(th), jnp.sin(th), thdot]).astype(jnp.float32)
+
+    def step_dynamics(self, key, state, action, params):
+        th, thdot = state.y[0], state.y[1]
+        u = jnp.clip(action, -params.max_torque, params.max_torque)[0].astype(params.dtype)
+        costs = _angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * (u**2)
+
+        newthdot = thdot + (3 * params.g / (2 * params.l) * jnp.sin(th) + 3.0 / (params.m * params.l**2) * u) * params.dt
+        newthdot = jnp.clip(newthdot, -params.max_speed, params.max_speed)
+        newth = th + newthdot * params.dt
+
+        y = jnp.stack([newth, newthdot]).astype(params.dtype)
+        new_state = PendulumState(y=y, t=state.t + 1)
+        terminated = jnp.zeros((), dtype=bool)
+        return new_state, self._obs(y), (-costs).astype(jnp.float32), terminated
+
+    def observation_space(self, params: PendulumParams) -> gym.spaces.Box:
+        high = np.array([1.0, 1.0, params.max_speed], dtype=np.float32)
+        return gym.spaces.Box(-high, high, dtype=np.float32)
+
+    def action_space(self, params: PendulumParams) -> gym.spaces.Box:
+        return gym.spaces.Box(-params.max_torque, params.max_torque, (1,), dtype=np.float32)

@@ -1,0 +1,164 @@
+"""Host-side driver for a batch of in-graph envs.
+
+:class:`InGraphVectorEnv` is the thin stand-in for the gym vector env in the
+train loops: it owns the device-resident carry (env states, current obs, the
+PRNG key chain, and per-env episode accumulators), exposes the gym spaces the
+agent builders read, and hosts the chaos-drill seams — ``env.reset`` fires on
+every (re)seed and ``env.autoreset`` once per episode boundary observed in a
+rollout, so failpoint drills cover the in-graph path exactly like the
+supervised worker path (core/failpoints.py).
+
+The per-step work happens elsewhere: the fused collector
+(:mod:`sheeprl_tpu.envs.ingraph.rollout`) reads/writes ``self.carry`` directly.
+The driver's own :meth:`step` is the debug/eval path (tests, greedy
+evaluation) — one jitted vmapped auto-reset step with host pulls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium.vector.utils import batch_space
+
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv, autoreset_step
+
+__all__ = ["Carry", "InGraphVectorEnv"]
+
+
+class Carry(NamedTuple):
+    """Everything the fused rollout needs between iterations, all on device."""
+
+    state: Any  # vmapped env state pytree, leading axis [B]
+    obs: jax.Array  # [B, obs_dim] f32 current observation
+    key: jax.Array  # PRNG key chain for act sampling + env steps
+    ep_ret: jax.Array  # [B] f32 running episode return (raw rewards)
+    ep_len: jax.Array  # [B] int32 running episode length
+
+
+class InGraphVectorEnv:
+    backend = "ingraph"
+
+    def __init__(
+        self,
+        env: FuncEnv,
+        params: EnvParams,
+        num_envs: int,
+        obs_key: str = "state",
+        seed: int = 0,
+        device: Optional[Any] = None,
+    ):
+        self.env = env
+        self.env_params = params
+        self.num_envs = int(num_envs)
+        self.obs_key = obs_key
+        self.device = device
+        self._seed = int(seed)
+        self.carry: Optional[Carry] = None
+
+        self.single_observation_space = gym.spaces.Dict({obs_key: env.observation_space(params)})
+        self.single_action_space = env.action_space(params)
+        self.observation_space = batch_space(self.single_observation_space, self.num_envs)
+        self.action_space = batch_space(self.single_action_space, self.num_envs)
+
+        auto = autoreset_step(env, params)
+        B = self.num_envs
+
+        def _reset_all(key):
+            keys = jax.random.split(key, B + 1)
+            state, obs = jax.vmap(lambda k: env.reset(k, params))(keys[1:])
+            return Carry(
+                state=state,
+                obs=obs,
+                key=keys[0],
+                ep_ret=jnp.zeros((B,), jnp.float32),
+                ep_len=jnp.zeros((B,), jnp.int32),
+            )
+
+        def _host_step(carry: Carry, actions):
+            key, sub = jax.random.split(carry.key)
+            step_keys = jax.random.split(sub, B)
+            state, obs, reward, done, info = jax.vmap(auto)(step_keys, carry.state, actions)
+            ep_ret = carry.ep_ret + reward
+            ep_len = carry.ep_len + 1
+            fin_ret = jnp.where(done, ep_ret, 0.0)
+            fin_len = jnp.where(done, ep_len, 0)
+            new_carry = Carry(
+                state=state,
+                obs=obs,
+                key=key,
+                ep_ret=jnp.where(done, 0.0, ep_ret),
+                ep_len=jnp.where(done, 0, ep_len),
+            )
+            return new_carry, obs, reward, info["terminated"], info["truncated"], {
+                "terminal_obs": info["terminal_obs"],
+                "episode_returns": fin_ret,
+                "episode_lengths": fin_len,
+            }
+
+        self._reset_fn = jax_compile.guarded_jit(_reset_all, name="ingraph.reset")
+        self._step_fn = jax_compile.guarded_jit(_host_step, name="ingraph.step")
+
+    # ------------------------------------------------------------------ gym API
+    def reset(self, *, seed: Optional[int] = None, options: Any = None) -> Tuple[Dict[str, np.ndarray], Dict]:
+        """(Re)build the carry; gym-compatible ``(obs_dict, info)`` return.
+
+        Chaos seam: ``env.reset`` fires before any device work, so a drill can
+        stall/raise/kill exactly where a supervised worker pool would block."""
+        failpoints.failpoint("env.reset", seed=seed, num_envs=self.num_envs)
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        elif self.carry is not None:
+            key = self.carry.key
+        else:
+            key = jax.random.PRNGKey(self._seed)
+        if self.device is not None:
+            key = jax.device_put(key, self.device)
+        self.carry = self._reset_fn(key)
+        return {self.obs_key: np.asarray(self.carry.obs)}, {}
+
+    def step(self, actions):
+        """Debug/eval host step (gym 5-tuple). The train loops never call this —
+        they go through the fused collector — but tests and greedy evaluation
+        drive single transitions through the identical auto-reset semantics."""
+        if self.carry is None:
+            raise RuntimeError("step() before reset()")
+        acts = jnp.asarray(np.asarray(actions))
+        if self.device is not None:
+            acts = jax.device_put(acts, self.device)
+        self.carry, obs, reward, terminated, truncated, info = self._step_fn(self.carry, acts)
+        done = np.asarray(jnp.logical_or(terminated, truncated))
+        self.fire_autoreset_failpoints(done)
+        host_info = {
+            "terminal_obs": np.asarray(info["terminal_obs"]),
+            "episode_returns": np.asarray(info["episode_returns"]),
+            "episode_lengths": np.asarray(info["episode_lengths"]),
+        }
+        return (
+            {self.obs_key: np.asarray(obs)},
+            np.asarray(reward),
+            np.asarray(terminated),
+            np.asarray(truncated),
+            host_info,
+        )
+
+    def close(self) -> None:
+        self.carry = None
+
+    # ------------------------------------------------------------- chaos seams
+    def fire_autoreset_failpoints(self, dones) -> None:
+        """Fire ``env.autoreset`` once per finished episode in ``dones``.
+
+        Zero-cost when no failpoint is armed: the ``has`` probe short-circuits
+        before any device->host pull, so the steady-state rollout stays
+        transfer-free."""
+        if not failpoints.has("env.autoreset"):
+            return
+        n = int(np.asarray(dones).astype(bool).sum())
+        for _ in range(n):
+            failpoints.failpoint("env.autoreset", num_envs=self.num_envs)

@@ -13,6 +13,11 @@ import sys
 # the env var is not enough — the config itself must be re-pointed at cpu before any
 # backend is initialized.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Cache even sub-second kernels (jax's default threshold is 1s): the suite's
+# many subprocess CLI drills recompile dozens of tiny CPU kernels each, and
+# serving them from the shared persistent cache keeps the suite inside its
+# wall-clock budget. setdefault so an explicit caller choice still wins.
+os.environ.setdefault("SHEEPRL_TPU_COMP_CACHE_MIN_SECS", "0")
 _flags = [
     f
     for f in os.environ.get("XLA_FLAGS", "").split()
@@ -46,6 +51,12 @@ def pytest_configure(config):
         "markers",
         "faults: fault-injection drills (failpoint registry, chaos/transport smokes); "
         "select with `-m faults`, e.g. before touching checkpoint or transport code",
+    )
+    config.addinivalue_line(
+        "markers",
+        "ingraph: in-graph vectorized env backend (envs/ingraph/) — dynamics parity "
+        "against Gymnasium, zero-transfer rollout guarantees, and the smoke drill; "
+        "select with `-m ingraph` before touching envs/ingraph or the fused collector",
     )
 
 
