@@ -240,6 +240,112 @@ def bench_ingraph(
     }
 
 
+def bench_ingraph_train(num_envs: int = 4096, rollout_steps: int = 128, iters: int = 4) -> dict:
+    """Whole-iteration fused training (envs/ingraph/fused.py): rollout scan +
+    GAE + update epochs in ONE donated-carry jitted program, driven standalone
+    and fenced.
+
+    Headline: aggregate env-steps/s of the fused iteration — env steps both
+    collected AND trained on per wall-clock second. ``vs_baseline`` is the
+    ratio against the same-session fused collect-only number (the PR-10
+    ``--target ingraph`` headline): on a TPU slice, where the collect scan is
+    dispatch/latency-bound, the update rides in the same program largely for
+    free and the ratio approaches 1; on a CPU host the collect scan is already
+    FLOP-bound, so the update's forward+backward over every collected row is
+    pure added compute and the ratio reports exactly what the host pays for it.
+    The update's wall-clock share per iteration is reported alongside. Design
+    target on a v5e slice (howto/ingraph_envs.md): >= 1M aggregate env-steps/s.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import make_update_impl
+    from sheeprl_tpu.config import instantiate, load_config
+    from sheeprl_tpu.core.runtime import build_runtime
+    from sheeprl_tpu.envs import ingraph as ig
+    from sheeprl_tpu.utils.optim import with_clipping
+    from sheeprl_tpu.utils.utils import PlayerParamsSync
+
+    n_data = num_envs * rollout_steps
+    cfg = load_config(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            f"env.num_envs={num_envs}",
+            f"algo.rollout_steps={rollout_steps}",
+            f"algo.per_rank_batch_size={n_data}",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+        ]
+    )
+    runtime = build_runtime(cfg.fabric)
+    venv = ig.make_vector_env(cfg, num_envs, 42, device=runtime.device)
+    agent, params, player = build_agent(runtime, (2,), False, cfg, venv.single_observation_space, None)
+    player.params = jax.device_put(player.params, runtime.device)
+    venv.reset(seed=42)
+    collector = ig.InGraphRolloutCollector(
+        venv, player, rollout_steps=rollout_steps, gamma=float(cfg.algo.gamma), name="bench"
+    )
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    params_sync = PlayerParamsSync(player.params)
+    update_impl = make_update_impl(
+        agent, tx, cfg, runtime, n_data, list(cfg.algo.mlp_keys.encoder), [], params_sync
+    )
+    trainer = ig.FusedInGraphTrainer(collector, update_impl, n_extras=3, name="bench")
+    key = jax.random.PRNGKey(0)
+    extras = (jnp.float32(cfg.algo.clip_coef), jnp.float32(cfg.algo.ent_coef), jnp.float32(1.0))
+
+    def fused_step():
+        nonlocal params, opt_state, key
+        key, sub = jax.random.split(key)
+        params, opt_state, _flat, _roll, _train = trainer.step(params, opt_state, sub, *extras)
+
+    # same-session collect-only reference: identical env batch, policy, and
+    # carry chain, minus the update — the difference IS the update's wall-clock.
+    # A SEPARATE collector instance: lax.scan's jaxpr cache is keyed on the
+    # scan-body function object, so tracing split ``collect`` and the fused
+    # ``iteration`` over one collector's shared ``one_step`` closure replays
+    # the first trace's captured param tracers into the second
+    # (UnexpectedTracerError). Production loops trace only one per process.
+    ref_collector = ig.InGraphRolloutCollector(
+        venv, player, rollout_steps=rollout_steps, gamma=float(cfg.algo.gamma), name="bench_ref"
+    )
+    ref_collector.collect()
+    jax.block_until_ready(venv.carry.obs)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        ref_collector.collect()
+    jax.block_until_ready(venv.carry.obs)
+    collect_iter_s = (time.perf_counter() - t0) / iters
+    collect_sps = n_data / collect_iter_s
+
+    fused_step()  # compile + first iteration
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fused_step()
+    jax.block_until_ready(params)
+    fused_iter_s = (time.perf_counter() - t0) / iters
+    fused_sps = n_data / fused_iter_s
+
+    return {
+        "metric": "ingraph_fused_train_env_steps_per_sec",
+        "value": round(fused_sps, 2),
+        "unit": "env-steps/s",
+        "vs_baseline": round(fused_sps / collect_sps, 3),
+        "ingraph_fused_train_env_steps_per_sec": round(fused_sps, 2),
+        "ingraph_fused_train_update_s_per_iter": round(max(fused_iter_s - collect_iter_s, 0.0), 4),
+        "ingraph_fused_train_iter_s": round(fused_iter_s, 4),
+        "ingraph_collect_only_env_steps_per_sec": round(collect_sps, 2),
+        "ingraph_fused_train_num_envs": num_envs,
+        "ingraph_fused_train_rollout_steps": rollout_steps,
+        "ingraph_fused_train_tpu_slice_target_env_steps_per_sec": 1_000_000,
+    }
+
+
 def bench_dv3(
     batch: int = 128,
     seq: int = 64,
@@ -797,6 +903,7 @@ def _target_metric(target: str) -> str:
         "serve": "serve_p99_ms",
         "transport": "transport_chunk_roundtrip_ms",
         "ingraph": "ingraph_env_steps_per_sec",
+        "ingraph_train": "ingraph_fused_train_env_steps_per_sec",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -814,6 +921,7 @@ _METRIC_UNITS = {
     "serve_p99_ms": "ms",
     "transport_chunk_roundtrip_ms": "ms",
     "ingraph_env_steps_per_sec": "env-steps/s",
+    "ingraph_fused_train_env_steps_per_sec": "env-steps/s",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
 
@@ -868,7 +976,18 @@ if __name__ == "__main__":
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
-        choices=("ppo", "dv3", "compile", "health", "orchestrate", "serve", "transport", "ingraph", "all"),
+        choices=(
+            "ppo",
+            "dv3",
+            "compile",
+            "health",
+            "orchestrate",
+            "serve",
+            "transport",
+            "ingraph",
+            "ingraph_train",
+            "all",
+        ),
         default="all",
         help="which workload(s) to run on the accelerator",
     )
@@ -1011,6 +1130,16 @@ if __name__ == "__main__":
                 result.setdefault("value", ig.get("ingraph_env_steps_per_sec"))
                 result.setdefault("unit", "env-steps/s")
                 result.setdefault("vs_baseline", ig.get("ingraph_vs_host_x"))
+            if cli_args.target == "ingraph_train":
+                # opt-in only: the whole-iteration fused trainer (collect + GAE
+                # + update in one program) vs the same-session collect-only
+                # number — the aggregate-throughput headline for the fused path
+                igt = bench_ingraph_train()
+                result.update(igt)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", igt.get("ingraph_fused_train_env_steps_per_sec"))
+                result.setdefault("unit", "env-steps/s")
+                result.setdefault("vs_baseline", igt.get("vs_baseline"))
             if cli_args.target == "transport":
                 # opt-in only: host control-plane latency/throughput drill
                 # (sockets + failpoints; no accelerator involved at all)

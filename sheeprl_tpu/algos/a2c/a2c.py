@@ -25,6 +25,7 @@ from sheeprl_tpu.algos.a2c.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
@@ -40,10 +41,26 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import PlayerParamsSync, gae, normalize_tensor, save_configs
 
 
-def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None):
+def make_update_impl(
+    agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None, *, axis_name=None, shards=1
+):
+    """Build the raw (unjitted) per-iteration optimization function.
+
+    Same two flavors as :func:`sheeprl_tpu.algos.ppo.ppo.make_update_impl`:
+    the default is the jitted split-path train step AND the single-device fused
+    iteration's update phase; ``axis_name="data"``/``shards=N`` is the
+    shard-local body for the fused ``shard_map`` variant — the accumulated
+    gradient (and the ``pg_sum``/``v_sum``/``gnorm`` scalars feeding the
+    nonfinite guard's decision, so every shard takes the identical
+    apply-or-skip branch) all-reduce via ``jax.lax.pmean`` before the single
+    optimizer step.
+    """
     global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
-    n_minibatches = max(n_data // global_bs, 1)
-    data_sharding = NamedSharding(runtime.mesh, P("data"))
+    shards = int(shards)
+    local_n = n_data // shards
+    local_bs = max(global_bs // shards, 1)
+    n_minibatches = max(local_n // local_bs, 1)
+    data_sharding = NamedSharding(runtime.mesh, P("data")) if axis_name is None else None
     nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch):
@@ -80,22 +97,45 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
         data["returns"] = returns
         data["advantages"] = advantages
         flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in data.items()}
-        n_keep = n_minibatches * global_bs
-        perm = jax.random.permutation(key, n_data)[:n_keep].reshape(n_minibatches, global_bs)
+        if n_minibatches == 1 and local_bs >= local_n:
+            # ONE minibatch covering every row: a permutation only reorders the
+            # batch mean, so skip the O(N log N) sort and the full-data gather
+            perm = None
+        else:
+            n_keep = n_minibatches * local_bs
+            perm = jax.random.permutation(key, local_n)[:n_keep].reshape(n_minibatches, local_bs)
 
         def accumulate(carry, idx):
             grads_acc, pg_acc, v_acc = carry
-            batch = jax.tree_util.tree_map(
-                lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
-            )
+            if idx is None:
+                batch = flat
+                if data_sharding is not None:
+                    batch = jax.tree_util.tree_map(
+                        lambda v: jax.lax.with_sharding_constraint(v, data_sharding), batch
+                    )
+            elif data_sharding is not None:
+                batch = jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
+                )
+            else:
+                # shard-local body: the rows are already this shard's block
+                batch = jax.tree_util.tree_map(lambda v: jnp.take(v, idx, axis=0), flat)
             (_, (pg, vl)), grads = grad_fn(params, batch)
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, pg_acc + pg, v_acc + vl), None
 
         zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
         (grads, pg_sum, v_sum), _ = jax.lax.scan(
-            accumulate, (zero_grads, jnp.float32(0), jnp.float32(0)), perm
+            accumulate, (zero_grads, jnp.float32(0), jnp.float32(0)), perm,
+            length=1 if perm is None else None,
         )
+        if axis_name is not None:
+            # data-parallel all-reduce of the ONE accumulated update; the loss
+            # sums reduce too so the finite_or_skip decision below is
+            # replicated (a shard-local skip would fork the param replicas)
+            grads = jax.lax.pmean(grads, axis_name)
+            pg_sum = jax.lax.pmean(pg_sum, axis_name)
+            v_sum = jax.lax.pmean(v_sum, axis_name)
         gnorm = optax.global_norm(grads)
         updates, new_opt_state = tx.update(grads, opt_state, params)
         # health-sentinel LR backoff: traced scalar operand; 1.0 is IEEE-exact
@@ -116,6 +156,12 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=No
             "Grads/global_norm": gnorm,
         }
 
+    return train
+
+
+def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None):
+    """The jitted split-path train step (see :func:`make_update_impl`)."""
+    train = make_update_impl(agent, tx, cfg, runtime, n_data, obs_keys, params_sync)
     return jax_compile.guarded_jit(train, name="a2c.train", donate_argnums=(0, 1))
 
 
@@ -231,6 +277,7 @@ def main(runtime, cfg: Dict[str, Any]):
     stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg) and not use_ingraph)
     codec = PackedObsCodec(cnn_keys=(), device=runtime.player_device)
     collector = None
+    fused_trainer = None
     if use_ingraph:
         # A2C's loss recomputes logprobs, so the collector stores only
         # obs/actions/values/rewards/dones
@@ -243,6 +290,30 @@ def main(runtime, cfg: Dict[str, Any]):
             store_logprobs=False,
             name="a2c",
         )
+        if ingraph_envs.fused_enabled(cfg):
+            # ----- whole-iteration fusion (envs/ingraph/fused.py): rollout scan
+            # + GAE + the accumulate-and-apply update compile into ONE program;
+            # on a multi-device mesh the env batch shards on the `data` axis and
+            # the accumulated gradient all-reduces in-graph
+            update_impl = make_update_impl(
+                agent,
+                tx,
+                cfg,
+                runtime,
+                n_data,
+                obs_keys,
+                params_sync,
+                axis_name="data" if world_size > 1 else None,
+                shards=world_size,
+            )
+            fused_trainer = ingraph_envs.FusedInGraphTrainer(
+                collector,
+                update_impl,
+                n_extras=1,
+                mesh=runtime.mesh if world_size > 1 else None,
+                name="a2c",
+            )
+            fused_trainer.shard_carry()
     zero_extra = {
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
@@ -253,19 +324,29 @@ def main(runtime, cfg: Dict[str, Any]):
     # kernels on a background thread while the first rollout collects.
     warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
     if warmup.enabled and use_ingraph:
-        # ONE rollout entry point (the fused scan); its abstract outputs are the
-        # train step's input specs — both derive without touching the device
-        warmup.add(collector.collect_fn, *collector.warmup_specs())
-        data_specs, nv_spec = collector.output_specs()
-        warmup.add(
-            train_fn,
-            jax_compile.specs_of(params),
-            jax_compile.specs_of(opt_state),
-            data_specs,
-            jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
-            jax_compile.spec_like(rng),
-            jax.ShapeDtypeStruct((), jnp.float32),
-        )
+        if fused_trainer is not None:
+            # ONE entry point for the whole iteration: collect + GAE + the
+            # accumulated update. Specs come from the live (mesh-sharded, for
+            # the shard_map variant) params/opt_state/carry.
+            warmup.add(
+                fused_trainer.step_fn,
+                *fused_trainer.warmup_specs(params, opt_state, rng, jnp.float32(1.0)),
+            )
+        else:
+            # ONE rollout entry point (the fused scan); its abstract outputs are
+            # the train step's input specs — both derive without touching the
+            # device
+            warmup.add(collector.collect_fn, *collector.warmup_specs())
+            data_specs, nv_spec = collector.output_specs()
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_state),
+                data_specs,
+                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+                jax_compile.spec_like(rng),
+                jax.ShapeDtypeStruct((), jnp.float32),
+            )
         if aggregator is not None:
             warmup.add_task(
                 lambda: aggregator.precompile_drain(
@@ -374,29 +455,61 @@ def main(runtime, cfg: Dict[str, Any]):
             "player_rng": jax.device_get(player_rng),
         }
 
+    def _drain_ingraph_episodes(roll_metrics):
+        """Pull and log the [T, B] episode-metric leaves from an ingraph rollout.
+
+        Skipped when nothing consumes them: aggregator disabled, or between
+        ``log_every`` drains (episodes are then sampled at drain iterations
+        rather than fetched every iteration) — see ppo.py."""
+        if cfg.metric.log_level <= 0 or aggregator is None or aggregator.disabled:
+            return
+        if policy_step - last_log < cfg.metric.log_every and iter_num != total_iters:
+            return
+        for ep_rew, ep_len in ingraph_envs.iter_finished_episodes(roll_metrics):
+            if "Rewards/rew_avg" in aggregator:
+                aggregator.update("Rewards/rew_avg", ep_rew)
+            if "Game/ep_len_avg" in aggregator:
+                aggregator.update("Game/ep_len_avg", ep_len)
+            runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+
     guard = resilience.PreemptionGuard(
         enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
     )
     with guard:
         for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
-            if use_ingraph:
-                # ----- fused in-graph rollout (envs/ingraph/rollout.py): ONE jitted
-                # call replaces the whole per-step host loop (see ppo.py)
+            if fused_trainer is not None:
+                # ----- whole-iteration fused step (envs/ingraph/fused.py): the
+                # rollout scan, GAE, and the accumulated update run as ONE
+                # compiled donated-carry program (see ppo.py)
+                failpoints.failpoint("train.fused_update", iter=iter_num)
+                with timer("Time/train_time", SumMetric()):
+                    if iter_num == start_iter:
+                        warmup.wait()
+                    policy_step += n_envs * cfg.algo.rollout_steps
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_state, flat_params, roll_metrics, train_metrics = fused_trainer.step(
+                        params,
+                        opt_state,
+                        fused_trainer.to_mesh(train_key),
+                        fused_trainer.to_mesh(jnp.float32(sentinel.lr_scale)),
+                    )
+                    player.params = params_sync.pull(flat_params, player_sync_device)
+                    if not timer.disabled:  # sync only when the phase is being timed
+                        jax.block_until_ready(params)
+                train_step += world_size
+                envs.fire_autoreset_failpoints(roll_metrics["dones"])
+                _drain_ingraph_episodes(roll_metrics)
+            elif use_ingraph:
+                # ----- split ingraph path (env.fused=False): the fused rollout
+                # scan followed by the separately jitted train step below — the
+                # fused path's parity reference
                 with timer("Time/env_interaction_time", SumMetric()):
                     policy_step += n_envs * cfg.algo.rollout_steps
                     ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
                 # zero-cost unless an env.autoreset drill is armed
                 envs.fire_autoreset_failpoints(roll_metrics["dones"])
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(
-                        ingraph_envs.iter_finished_episodes(roll_metrics)
-                    ):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+                _drain_ingraph_episodes(roll_metrics)
             else:
                 for _ in range(cfg.algo.rollout_steps):
                     policy_step += n_envs
@@ -445,44 +558,47 @@ def main(runtime, cfg: Dict[str, Any]):
                     # flush: the rollout's last row has no next act transfer to ride
                     _process_pending(None)
 
-            if not device_rollout and not use_ingraph:
-                local_data = rb.to_arrays(dtype=np.float32)
-                if cfg.buffer.size > cfg.algo.rollout_steps:
-                    idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-                    local_data = {k: v[idx] for k, v in local_data.items()}
-            with timer("Time/train_time", SumMetric()):
-                if iter_num == start_iter:
-                    # surface any residual warmup compile time here rather than
-                    # inside the train call (the rollout overlapped the thread)
-                    warmup.wait()
-                rng, train_key = jax.random.split(rng)
-                if use_ingraph:
-                    # rollout and bootstrap values already on device in the
-                    # buffer layout; one collect-device -> trainer-mesh move
-                    device_data, next_values = runtime.replicate(
-                        (ingraph_data, ingraph_next_values)
+            # ----- optimization phase: single jitted call. The fused path
+            # already ran its update inside the one program above.
+            if fused_trainer is None:
+                if not device_rollout and not use_ingraph:
+                    local_data = rb.to_arrays(dtype=np.float32)
+                    if cfg.buffer.size > cfg.algo.rollout_steps:
+                        idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                        local_data = {k: v[idx] for k, v in local_data.items()}
+                with timer("Time/train_time", SumMetric()):
+                    if iter_num == start_iter:
+                        # surface any residual warmup compile time here rather than
+                        # inside the train call (the rollout overlapped the thread)
+                        warmup.wait()
+                    rng, train_key = jax.random.split(rng)
+                    if use_ingraph:
+                        # rollout and bootstrap values already on device in the
+                        # buffer layout; one collect-device -> trainer-mesh move
+                        device_data, next_values = runtime.replicate(
+                            (ingraph_data, ingraph_next_values)
+                        )
+                    elif device_rollout:
+                        # HBM rollout + bootstrap values: player-device -> trainer-mesh,
+                        # no host round-trip
+                        jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
+                        device_data, next_values = runtime.replicate(
+                            (rb.rollout(), player.get_values(jax_obs))
+                        )
+                    else:
+                        jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
+                        next_values = np.asarray(player.get_values(jax_obs))
+                        device_data = {
+                            k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
+                        }
+                    params, opt_state, flat_params, train_metrics = train_fn(
+                        params, opt_state, device_data, next_values, train_key,
+                        jnp.float32(sentinel.lr_scale),
                     )
-                elif device_rollout:
-                    # HBM rollout + bootstrap values: player-device -> trainer-mesh,
-                    # no host round-trip
-                    jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-                    device_data, next_values = runtime.replicate(
-                        (rb.rollout(), player.get_values(jax_obs))
-                    )
-                else:
-                    jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-                    next_values = np.asarray(player.get_values(jax_obs))
-                    device_data = {
-                        k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                    }
-                params, opt_state, flat_params, train_metrics = train_fn(
-                    params, opt_state, device_data, next_values, train_key,
-                    jnp.float32(sentinel.lr_scale),
-                )
-                player.params = params_sync.pull(flat_params, player_sync_device)
-                if not timer.disabled:
-                    jax.block_until_ready(params)
-            train_step += world_size
+                    player.params = params_sync.pull(flat_params, player_sync_device)
+                    if not timer.disabled:
+                        jax.block_until_ready(params)
+                train_step += world_size
 
             if cfg.metric.log_level > 0:
                 if aggregator:
@@ -550,6 +666,10 @@ def main(runtime, cfg: Dict[str, Any]):
                         for k in obs_keys:
                             next_obs[k] = reset_obs[k]
                             step_data[k] = reset_obs[k][np.newaxis]
+                        # the fused sharded step expects its carry back in the
+                        # mesh layout after any reset
+                        if fused_trainer is not None:
+                            fused_trainer.shard_carry()
                     runtime.print(
                         f"Health rollback at policy_step={policy_step}: restored certified "
                         "checkpoint, training continues."

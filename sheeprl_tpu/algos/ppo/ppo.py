@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import normalize_obs, prepare_obs, test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
@@ -52,19 +53,33 @@ from sheeprl_tpu.utils.utils import (
 )
 
 
-def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None):
-    """Build the jitted per-iteration optimization function.
+def make_update_impl(
+    agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None, *, axis_name=None, shards=1
+):
+    """Build the raw (unjitted) per-iteration optimization function.
 
     Signature: (params, opt_state, data, next_values, key, coefs) ->
     (params, opt_state, flat_params, metrics). ``data`` is the whole rollout
     ``[T, B, ...]``; ``flat_params`` is the raveled post-update param vector for the
     one-transfer player refresh (None if no ``params_sync`` given).
+
+    Two flavors share the trace:
+    - default (``axis_name=None``): the jitted split-path train step AND the
+      single-device fused iteration's update phase (envs/ingraph/fused.py);
+    - ``axis_name="data"``/``shards=N``: the body runs shard-local inside
+      ``shard_map`` — permutations index the ``n_data/N`` local rows, minibatch
+      grads (and the nonfinite guard's decision scalars, so every shard takes
+      the identical apply-or-skip branch) all-reduce via ``jax.lax.pmean``.
+      Per-shard minibatches of ``global_bs/N`` keep the effective global batch
+      identical to the split path.
     """
     update_epochs = int(cfg.algo.update_epochs)
     global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
-    n_minibatches = max(n_data // global_bs, 1)
-    data_sharding = NamedSharding(runtime.mesh, P("data"))
-    actions_dim = None  # bound lazily from agent
+    shards = int(shards)
+    local_n = n_data // shards
+    local_bs = max(global_bs // shards, 1)
+    n_minibatches = max(local_n // local_bs, 1)
+    data_sharding = NamedSharding(runtime.mesh, P("data")) if axis_name is None else None
     nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch, clip_coef, ent_coef):
@@ -104,17 +119,38 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
         # flatten [T, B, *] -> [N, *]
         flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in data.items()}
 
-        n_keep = n_minibatches * global_bs
-        epoch_keys = jax.random.split(key, update_epochs)
-        perms = jnp.stack([jax.random.permutation(k, n_data)[:n_keep] for k in epoch_keys])
-        perms = perms.reshape(update_epochs * n_minibatches, global_bs)
+        if update_epochs == 1 and n_minibatches == 1 and local_bs >= local_n:
+            # ONE minibatch covering every row: a permutation only reorders the
+            # batch mean, so skip the O(N log N) sort and the full-data gather
+            perms = None
+        else:
+            n_keep = n_minibatches * local_bs
+            epoch_keys = jax.random.split(key, update_epochs)
+            perms = jnp.stack([jax.random.permutation(k, local_n)[:n_keep] for k in epoch_keys])
+            perms = perms.reshape(update_epochs * n_minibatches, local_bs)
 
         def minibatch_step(carry, idx):
             params, opt_state = carry
-            batch = jax.tree_util.tree_map(
-                lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
-            )
+            if idx is None:
+                batch = flat
+                if data_sharding is not None:
+                    batch = jax.tree_util.tree_map(
+                        lambda v: jax.lax.with_sharding_constraint(v, data_sharding), batch
+                    )
+            elif data_sharding is not None:
+                batch = jax.tree_util.tree_map(
+                    lambda v: jax.lax.with_sharding_constraint(jnp.take(v, idx, axis=0), data_sharding), flat
+                )
+            else:
+                # shard-local body: the rows are already this shard's block
+                batch = jax.tree_util.tree_map(lambda v: jnp.take(v, idx, axis=0), flat)
             (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
+            if axis_name is not None:
+                # data-parallel all-reduce; the loss scalars reduce too so the
+                # finite_or_skip decision below is replicated across shards
+                # (a shard-local skip would silently fork the param replicas)
+                grads = jax.lax.pmean(grads, axis_name)
+                loss, pg, vl, ent = (jax.lax.pmean(x, axis_name) for x in (loss, pg, vl, ent))
             gnorm = optax.global_norm(grads)
             updates, new_opt_state = tx.update(grads, opt_state, params)
             # health-sentinel LR backoff: a traced scalar operand (no retrace on
@@ -130,7 +166,9 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
                 params, opt_state, skipped = new_params, new_opt_state, jnp.float32(0.0)
             return (params, opt_state), jnp.stack([pg, vl, ent, skipped, gnorm])
 
-        (params, opt_state), losses = jax.lax.scan(minibatch_step, (params, opt_state), perms)
+        (params, opt_state), losses = jax.lax.scan(
+            minibatch_step, (params, opt_state), perms, length=1 if perms is None else None
+        )
         metrics = losses.mean(axis=0)
         flat = params_sync.ravel(params) if params_sync is not None else jnp.zeros(())
         return params, opt_state, flat, {
@@ -141,6 +179,12 @@ def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, para
             "Grads/global_norm": metrics[4],
         }
 
+    return train
+
+
+def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None):
+    """The jitted split-path train step (see :func:`make_update_impl`)."""
+    train = make_update_impl(agent, tx, cfg, runtime, n_data, obs_keys, cnn_keys, params_sync)
     return jax_compile.guarded_jit(train, name="ppo.train", donate_argnums=(0, 1))
 
 
@@ -324,6 +368,7 @@ def main(runtime, cfg: Dict[str, Any]):
     stepper = AsyncEnvStepper(envs, enabled=pipeline_enabled(cfg) and not use_ingraph)
     codec = PackedObsCodec(cnn_keys=cnn_keys, device=runtime.player_device)
     collector = None
+    fused_trainer = None
     if use_ingraph:
         collector = ingraph_envs.InGraphRolloutCollector(
             envs,
@@ -334,6 +379,31 @@ def main(runtime, cfg: Dict[str, Any]):
             store_logprobs=True,
             name="ppo",
         )
+        if ingraph_envs.fused_enabled(cfg):
+            # ----- whole-iteration fusion (envs/ingraph/fused.py): rollout scan
+            # + GAE + all update epochs compile into ONE program per iteration;
+            # on a multi-device mesh the env batch shards on the `data` axis and
+            # gradients all-reduce in-graph (pmean inside the update impl)
+            update_impl = make_update_impl(
+                agent,
+                tx,
+                cfg,
+                runtime,
+                n_data,
+                obs_keys,
+                cnn_keys,
+                params_sync,
+                axis_name="data" if world_size > 1 else None,
+                shards=world_size,
+            )
+            fused_trainer = ingraph_envs.FusedInGraphTrainer(
+                collector,
+                update_impl,
+                n_extras=3,
+                mesh=runtime.mesh if world_size > 1 else None,
+                name="ppo",
+            )
+            fused_trainer.shard_carry()
     zero_extra = {
         "rewards": np.zeros((n_envs, 1), np.float32),
         "dones": np.zeros((n_envs, 1), np.float32),
@@ -345,22 +415,39 @@ def main(runtime, cfg: Dict[str, Any]):
     # executable (trace count 0 at call time, Compile/retraces stays 0).
     warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
     if warmup.enabled and use_ingraph:
-        # the whole rollout is ONE entry point (the fused scan); its abstract
-        # outputs are exactly the train step's inputs, so both specs derive
-        # without touching the device
-        warmup.add(collector.collect_fn, *collector.warmup_specs())
-        data_specs, nv_spec = collector.output_specs()
-        warmup.add(
-            train_fn,
-            jax_compile.specs_of(params),
-            jax_compile.specs_of(opt_state),
-            data_specs,
-            jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
-            jax_compile.spec_like(rng),
-            jax.ShapeDtypeStruct((), jnp.float32),
-            jax.ShapeDtypeStruct((), jnp.float32),
-            jax.ShapeDtypeStruct((), jnp.float32),
-        )
+        if fused_trainer is not None:
+            # ONE entry point for the whole iteration: collect + GAE + update
+            # epochs. The specs come from the live (mesh-sharded, for the
+            # shard_map variant) params/opt_state/carry, so the background
+            # compile targets the exact steady-state placements.
+            warmup.add(
+                fused_trainer.step_fn,
+                *fused_trainer.warmup_specs(
+                    params,
+                    opt_state,
+                    rng,
+                    jnp.float32(cfg.algo.clip_coef),
+                    jnp.float32(cfg.algo.ent_coef),
+                    jnp.float32(1.0),
+                ),
+            )
+        else:
+            # the whole rollout is ONE entry point (the fused scan); its abstract
+            # outputs are exactly the train step's inputs, so both specs derive
+            # without touching the device
+            warmup.add(collector.collect_fn, *collector.warmup_specs())
+            data_specs, nv_spec = collector.output_specs()
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_state),
+                data_specs,
+                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+                jax_compile.spec_like(rng),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.ShapeDtypeStruct((), jnp.float32),
+            )
         if aggregator is not None:
             warmup.add_task(
                 lambda: aggregator.precompile_drain(
@@ -485,32 +572,67 @@ def main(runtime, cfg: Dict[str, Any]):
             "player_rng": jax.device_get(player_rng),
         }
 
+    def _drain_ingraph_episodes(roll_metrics):
+        """Pull and log the [T, B] episode-metric leaves from an ingraph rollout.
+
+        The pull is the ONLY bulk host traffic an ingraph iteration performs, so
+        it is skipped outright when nothing consumes it: aggregator disabled, or
+        between ``log_every`` drains (finished episodes are then sampled at the
+        drain iterations rather than fetched every iteration)."""
+        if cfg.metric.log_level <= 0 or aggregator is None or aggregator.disabled:
+            return
+        if policy_step - last_log < cfg.metric.log_every and iter_num != total_iters:
+            return
+        for ep_rew, ep_len in ingraph_envs.iter_finished_episodes(roll_metrics):
+            if "Rewards/rew_avg" in aggregator:
+                aggregator.update("Rewards/rew_avg", ep_rew)
+            if "Game/ep_len_avg" in aggregator:
+                aggregator.update("Game/ep_len_avg", ep_len)
+            runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+
     guard = resilience.PreemptionGuard(
         enabled=ft.preemption.enabled, stop_after_iters=ft.preemption.stop_after_iters
     )
     with guard:
         for iter_num in range(start_iter, total_iters + 1):
             profiler.step(policy_step)
-            if use_ingraph:
-                # ----- fused in-graph rollout (envs/ingraph/rollout.py): ONE jitted
-                # call replaces the whole per-step host loop; obs/actions/rewards
-                # never leave the device and the buffer layout comes out ready
-                # for the train step below
+            if fused_trainer is not None:
+                # ----- whole-iteration fused step (envs/ingraph/fused.py): the
+                # rollout scan, GAE, and every update epoch run as ONE compiled
+                # donated-carry program; only the raveled params and metric
+                # leaves return to the host. Chaos seam first, so drills and
+                # the sentinel's rollback ladder cover the fused path too.
+                failpoints.failpoint("train.fused_update", iter=iter_num)
+                with timer("Time/train_time", SumMetric()):
+                    if iter_num == start_iter:
+                        warmup.wait()
+                    policy_step += n_envs * cfg.algo.rollout_steps
+                    rng, train_key = jax.random.split(rng)
+                    params, opt_state, flat_params, roll_metrics, train_metrics = fused_trainer.step(
+                        params,
+                        opt_state,
+                        fused_trainer.to_mesh(train_key),
+                        fused_trainer.to_mesh(jnp.float32(cfg.algo.clip_coef)),
+                        fused_trainer.to_mesh(jnp.float32(cfg.algo.ent_coef)),
+                        fused_trainer.to_mesh(jnp.float32(sentinel.lr_scale)),
+                    )
+                    player.params = params_sync.pull(flat_params, player_sync_device)
+                    if not timer.disabled:  # sync only when the phase is being timed
+                        jax.block_until_ready(params)
+                train_step += world_size
+                envs.fire_autoreset_failpoints(roll_metrics["dones"])
+                _drain_ingraph_episodes(roll_metrics)
+            elif use_ingraph:
+                # ----- split ingraph path (env.fused=False): the fused rollout
+                # scan (envs/ingraph/rollout.py) followed by the separately
+                # jitted train step below — the fused path's parity reference
                 with timer("Time/env_interaction_time", SumMetric()):
                     policy_step += n_envs * cfg.algo.rollout_steps
                     ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
                 # zero-cost unless an env.autoreset drill is armed (the has()
                 # probe short-circuits before any device pull)
                 envs.fire_autoreset_failpoints(roll_metrics["dones"])
-                if cfg.metric.log_level > 0:
-                    for i, (ep_rew, ep_len) in enumerate(
-                        ingraph_envs.iter_finished_episodes(roll_metrics)
-                    ):
-                        if aggregator and "Rewards/rew_avg" in aggregator:
-                            aggregator.update("Rewards/rew_avg", ep_rew)
-                        if aggregator and "Game/ep_len_avg" in aggregator:
-                            aggregator.update("Game/ep_len_avg", ep_len)
-                        runtime.print(f"Rank-0: policy_step={policy_step}, episode_reward={ep_rew}")
+                _drain_ingraph_episodes(roll_metrics)
             else:
                 for _ in range(cfg.algo.rollout_steps):
                     policy_step += n_envs
@@ -602,60 +724,62 @@ def main(runtime, cfg: Dict[str, Any]):
                     # flush: the rollout's last row has no next act transfer to ride
                     _process_pending(None)
 
-            # ----- optimization phase: single jitted call (GAE + epochs x minibatches)
-            if not device_rollout and not use_ingraph:
-                local_data = rb.to_arrays(dtype=np.float32)
-                if cfg.buffer.size > cfg.algo.rollout_steps:
-                    # keep only the last rollout in chronological order (stale/zero rows
-                    # beyond the write head would corrupt GAE)
-                    idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
-                    local_data = {k: v[idx] for k, v in local_data.items()}
-            with timer("Time/train_time", SumMetric()):
-                if iter_num == start_iter:
-                    # every registered entry point compiled before the first
-                    # train dispatch (usually already done: the whole first
-                    # rollout overlapped the warmup thread)
-                    warmup.wait()
-                rng, train_key = jax.random.split(rng)
-                if use_ingraph:
-                    # rollout and bootstrap values are already on device in the
-                    # buffer layout; one collect-device -> trainer-mesh move
-                    device_data, next_values = runtime.replicate(
-                        (ingraph_data, ingraph_next_values)
+            # ----- optimization phase: single jitted call (GAE + epochs x minibatches).
+            # The fused path already ran its update inside the one program above.
+            if fused_trainer is None:
+                if not device_rollout and not use_ingraph:
+                    local_data = rb.to_arrays(dtype=np.float32)
+                    if cfg.buffer.size > cfg.algo.rollout_steps:
+                        # keep only the last rollout in chronological order (stale/zero rows
+                        # beyond the write head would corrupt GAE)
+                        idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
+                        local_data = {k: v[idx] for k, v in local_data.items()}
+                with timer("Time/train_time", SumMetric()):
+                    if iter_num == start_iter:
+                        # every registered entry point compiled before the first
+                        # train dispatch (usually already done: the whole first
+                        # rollout overlapped the warmup thread)
+                        warmup.wait()
+                    rng, train_key = jax.random.split(rng)
+                    if use_ingraph:
+                        # rollout and bootstrap values are already on device in the
+                        # buffer layout; one collect-device -> trainer-mesh move
+                        device_data, next_values = runtime.replicate(
+                            (ingraph_data, ingraph_next_values)
+                        )
+                    elif device_rollout:
+                        # zero bulk host->device transfer: the completed HBM rollout and
+                        # the bootstrap values move player-device -> trainer-mesh directly
+                        # (ownership transfers out of the buffer, so the train fn's view
+                        # is never aliased by next iteration's donated writes)
+                        jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                        device_data, next_values = runtime.replicate(
+                            (rb.rollout(), player.get_values(jax_obs))
+                        )
+                    else:
+                        # bootstrap values come from the player device; re-enter the mesh
+                        # uncommitted so the jitted train step can place them freely
+                        jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
+                        next_values = np.asarray(player.get_values(jax_obs))
+                        device_data = {
+                            k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
+                        }
+                    params, opt_state, flat_params, train_metrics = train_fn(
+                        params,
+                        opt_state,
+                        device_data,
+                        next_values,
+                        train_key,
+                        jnp.float32(cfg.algo.clip_coef),
+                        jnp.float32(cfg.algo.ent_coef),
+                        jnp.float32(sentinel.lr_scale),
                     )
-                elif device_rollout:
-                    # zero bulk host->device transfer: the completed HBM rollout and
-                    # the bootstrap values move player-device -> trainer-mesh directly
-                    # (ownership transfers out of the buffer, so the train fn's view
-                    # is never aliased by next iteration's donated writes)
-                    jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                    device_data, next_values = runtime.replicate(
-                        (rb.rollout(), player.get_values(jax_obs))
-                    )
-                else:
-                    # bootstrap values come from the player device; re-enter the mesh
-                    # uncommitted so the jitted train step can place them freely
-                    jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                    next_values = np.asarray(player.get_values(jax_obs))
-                    device_data = {
-                        k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                    }
-                params, opt_state, flat_params, train_metrics = train_fn(
-                    params,
-                    opt_state,
-                    device_data,
-                    next_values,
-                    train_key,
-                    jnp.float32(cfg.algo.clip_coef),
-                    jnp.float32(cfg.algo.ent_coef),
-                    jnp.float32(sentinel.lr_scale),
-                )
-                # refresh the player's copy with ONE cross-backend transfer; the next
-                # rollout implicitly waits for (only) the params it needs
-                player.params = params_sync.pull(flat_params, player_sync_device)
-                if not timer.disabled:  # sync only when the train phase is being timed
-                    jax.block_until_ready(params)
-            train_step += world_size
+                    # refresh the player's copy with ONE cross-backend transfer; the next
+                    # rollout implicitly waits for (only) the params it needs
+                    player.params = params_sync.pull(flat_params, player_sync_device)
+                    if not timer.disabled:  # sync only when the train phase is being timed
+                        jax.block_until_ready(params)
+                train_step += world_size
 
             if cfg.metric.log_level > 0:
                 if aggregator:
@@ -744,6 +868,10 @@ def main(runtime, cfg: Dict[str, Any]):
                                 _obs = _obs.reshape(n_envs, -1, *_obs.shape[-2:])
                             next_obs[k] = _obs
                             step_data[k] = _obs[np.newaxis]
+                        # the fused sharded step expects its carry back in the
+                        # mesh layout after any reset
+                        if fused_trainer is not None:
+                            fused_trainer.shard_carry()
                     runtime.print(
                         f"Health rollback at policy_step={policy_step}: restored certified "
                         "checkpoint, training continues."

@@ -27,7 +27,13 @@ from sheeprl_tpu.data.device_buffer import DeviceSequentialReplayBuffer, Sharded
 from sheeprl_tpu.data.prefetch import DevicePrefetcher, InlineSampler
 from sheeprl_tpu.data.rollout_buffer import DeviceRolloutBuffer
 
-__all__ = ["buffer_backend", "make_episode_replay", "make_rollout_buffer", "make_sequential_replay"]
+__all__ = [
+    "buffer_backend",
+    "make_episode_replay",
+    "make_replay_ring",
+    "make_rollout_buffer",
+    "make_sequential_replay",
+]
 
 
 def buffer_backend(cfg) -> str:
@@ -87,6 +93,31 @@ def make_rollout_buffer(cfg, runtime, n_envs: int, obs_keys: Sequence[str], log_
         memmap_dir=os.path.join(log_dir or ".", "memmap_buffer", f"rank_{runtime.global_rank}"),
         obs_keys=tuple(obs_keys),
     )
+
+
+def make_replay_ring(cfg, n_envs: int, leaf_specs):
+    """The HBM transition store for the fused off-policy in-graph path (SAC).
+
+    Keyed off ``env.backend`` the same way :func:`make_rollout_buffer` is for
+    the on-policy family: only the ingraph backend keeps transitions in-graph
+    (a :class:`~sheeprl_tpu.envs.ingraph.replay_ring.ReplayRing` written and
+    sampled inside the fused iteration); the gym backend keeps the host
+    ``ReplayBuffer``. Capacity follows the host convention — ``buffer.size``
+    transitions total, i.e. ``buffer.size // n_envs`` ring rows of ``n_envs``
+    transitions each. The ring is never memmapped or checkpointed (it is a
+    donated device pytree; resume re-warms it from the env).
+    """
+    env_cfg = getattr(cfg, "env", None)
+    backend = str(env_cfg.get("backend", "gym")).lower() if env_cfg is not None else "gym"
+    if backend != "ingraph":
+        raise ValueError(
+            "make_replay_ring builds the env.backend=ingraph transition store; "
+            f"the '{backend}' backend uses the host ReplayBuffer"
+        )
+    from sheeprl_tpu.envs.ingraph.replay_ring import ReplayRing
+
+    capacity = max(int(cfg.buffer.size) // int(n_envs), 1) if not cfg.dry_run else 2
+    return ReplayRing(capacity, int(n_envs), leaf_specs)
 
 
 def make_sequential_replay(

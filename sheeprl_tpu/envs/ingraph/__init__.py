@@ -22,6 +22,8 @@ from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv, autoreset_step
 from sheeprl_tpu.envs.ingraph.cartpole import CartPole, CartPoleParams, CartPoleState
 from sheeprl_tpu.envs.ingraph.gridworld import GridWorld, GridWorldParams, GridWorldState
 from sheeprl_tpu.envs.ingraph.pendulum import Pendulum, PendulumParams, PendulumState
+from sheeprl_tpu.envs.ingraph.fused import FusedInGraphTrainer, carry_partition_spec, shard_carry
+from sheeprl_tpu.envs.ingraph.replay_ring import ReplayRing, RingState
 from sheeprl_tpu.envs.ingraph.rollout import InGraphRolloutCollector, iter_finished_episodes
 from sheeprl_tpu.envs.ingraph.vector import Carry, InGraphVectorEnv
 
@@ -41,7 +43,13 @@ __all__ = [
     "Carry",
     "InGraphVectorEnv",
     "InGraphRolloutCollector",
+    "FusedInGraphTrainer",
+    "ReplayRing",
+    "RingState",
+    "carry_partition_spec",
+    "shard_carry",
     "iter_finished_episodes",
+    "fused_enabled",
     "register",
     "make",
     "env_backend",
@@ -77,6 +85,16 @@ def make(env_id: str, **param_overrides) -> Tuple[FuncEnv, EnvParams]:
 def env_backend(cfg) -> str:
     """'gym' (host subprocess envs, the default) or 'ingraph'."""
     return str(cfg.env.get("backend", "gym")).lower()
+
+
+def fused_enabled(cfg) -> bool:
+    """Whether the ingraph loops should run the whole-iteration fused step
+    (collect + update in one compiled program; envs/ingraph/fused.py).
+
+    Defaults to True on the ingraph backend; ``env.fused=False`` keeps the
+    split collect-then-train path (the parity reference and the debugging
+    escape hatch)."""
+    return env_backend(cfg) == "ingraph" and bool(cfg.env.get("fused", True))
 
 
 def make_vector_env(

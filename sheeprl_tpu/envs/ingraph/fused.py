@@ -1,0 +1,169 @@
+"""Whole-iteration fused in-graph training: collect + update in ONE program.
+
+PR 10's fused collector removed the per-step host loop but still returned to
+Python between collect and train every iteration — one dispatch gap, one
+donation boundary, one metrics pull per phase. This module closes that gap the
+PureJaxRL/Brax way: a single jitted (donated-carry) function per iteration
+that runs the ``lax.scan`` rollout (:mod:`sheeprl_tpu.envs.ingraph.rollout`),
+computes GAE, and executes every minibatched update epoch in-graph, returning
+only the post-update params, the raveled player refresh vector, and scalar/
+``[T, B]`` metric leaves to the host.
+
+The composition is literal: the trainer inlines the collector's *unjitted*
+``collect_impl`` and the algo's *unjitted* ``update_impl`` (built by the
+algo's ``make_update_impl``) into one trace — the same expressions the split
+path jits separately — so fused-vs-split param/trajectory bit-parity holds by
+construction (pinned in tests/test_envs/test_ingraph_fused.py).
+
+The ``mesh`` variant wraps the same body in the portable ``shard_map`` shim
+from :mod:`sheeprl_tpu.data.device_buffer`: the env-state batch shards on the
+``data`` axis, gradients all-reduce via ``jax.lax.pmean`` inside the update
+impl, and params/opt-state stay replicated. Per-shard rollout randomness
+derives from ONE replicated carry key — split into ``(base, next_base)``,
+``jax.lax.axis_index`` folded into ``base`` for the shard-local stream, and
+``next_base`` (still replicated) handed to the next iteration — so the carry's
+key leaf keeps a valid replicated out-spec without cross-shard key traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.data.device_buffer import _shard_map
+from sheeprl_tpu.envs.ingraph.vector import Carry
+
+__all__ = ["FusedInGraphTrainer", "carry_partition_spec", "shard_carry"]
+
+
+def carry_partition_spec() -> Carry:
+    """``shard_map`` prefix spec for the rollout carry: env-batch leaves on the
+    ``data`` axis, the PRNG key replicated (each shard re-derives its stream by
+    axis index; see the module docstring)."""
+    return Carry(state=P("data"), obs=P("data"), key=P(), ep_ret=P("data"), ep_len=P("data"))
+
+
+def shard_carry(carry: Carry, mesh: Mesh) -> Carry:
+    """Place a freshly-reset carry on the mesh in the fused sharded layout.
+
+    The fused step donates the carry and returns it identically placed, so one
+    ``shard_carry`` after ``venv.reset`` (initial seed or a sentinel reseed) is
+    the only resharding a run ever pays."""
+    spec = carry_partition_spec()
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.device_put(carry, shardings)
+
+
+class FusedInGraphTrainer:
+    """Owns the fused per-iteration entry point and the carry handoff.
+
+    ``update_impl`` is the algo's raw (unjitted) optimization phase::
+
+        (params, opt_state, data, next_values, key, *extras)
+            -> (params, opt_state, flat_params, train_metrics)
+
+    built by the algo's ``make_update_impl`` — the plain flavor for the
+    single-device trainer, the ``axis_name="data"``/``shards=N`` flavor (local
+    permutation sizes, per-minibatch ``pmean``) when ``mesh`` is given.
+    ``n_extras`` is the number of trailing scalar operands (PPO: clip/ent
+    coefs + lr_scale; A2C: lr_scale) — needed to size the shard_map specs.
+    """
+
+    def __init__(
+        self,
+        collector: Any,
+        update_impl: Callable,
+        *,
+        n_extras: int,
+        mesh: Optional[Mesh] = None,
+        name: str = "train",
+    ):
+        self.collector = collector
+        self.venv = collector.venv
+        self.mesh = mesh
+        collect_impl = collector.collect_impl
+
+        def iteration(params, opt_state, carry, key, *extras):
+            new_carry, data, roll_metrics, next_values = collect_impl(params, carry)
+            params, opt_state, flat, train_metrics = update_impl(
+                params, opt_state, data, next_values, key, *extras
+            )
+            return params, opt_state, new_carry, flat, roll_metrics, train_metrics
+
+        if mesh is None:
+            fused = iteration
+        else:
+            carry_spec = carry_partition_spec()
+
+            def sharded_iteration(params, opt_state, carry, key, *extras):
+                idx = jax.lax.axis_index("data")
+                base, next_base = jax.random.split(carry.key)
+                local = carry._replace(key=jax.random.fold_in(base, idx))
+                new_carry, data, roll_metrics, next_values = collect_impl(params, local)
+                # hand the next iteration a REPLICATED key (the chained one is
+                # shard-varying and would poison the P() out-spec)
+                new_carry = new_carry._replace(key=next_base)
+                params, opt_state, flat, train_metrics = update_impl(
+                    params, opt_state, data, next_values, key, *extras
+                )
+                return params, opt_state, new_carry, flat, roll_metrics, train_metrics
+
+            rep = P()
+            fused = _shard_map(
+                sharded_iteration,
+                mesh=mesh,
+                in_specs=(rep, rep, carry_spec, rep) + (rep,) * int(n_extras),
+                # [T, B_local] episode-metric blocks concatenate back to [T, B]
+                out_specs=(rep, rep, carry_spec, rep, P(None, "data"), rep),
+            )
+
+        self.step_fn = jax_compile.guarded_jit(
+            fused, name=f"{name}.ingraph_train", donate_argnums=(0, 1, 2)
+        )
+
+    # ------------------------------------------------------------------ driving
+    def step(self, params, opt_state, key, *extras):
+        """One fused iteration against ``venv.carry`` (read and written back, so
+        a driver ``reset(seed=...)`` — health-sentinel reseed, chaos drill —
+        transparently restarts the env streams for the next call). Returns
+        ``(params, opt_state, flat_params, roll_metrics, train_metrics)``."""
+        if self.venv.carry is None:
+            raise RuntimeError("fused step() before venv.reset()")
+        params, opt_state, carry, flat, roll_metrics, train_metrics = self.step_fn(
+            params, opt_state, self.venv.carry, key, *extras
+        )
+        self.venv.carry = carry
+        return params, opt_state, flat, roll_metrics, train_metrics
+
+    def to_mesh(self, x):
+        """Commit a small replicated operand (PRNG key, scalar coef) onto the
+        mesh. The AOT executable is compiled for mesh-replicated inputs; an
+        uncommitted host scalar would miss the routing and fall back to JIT
+        (one spurious retrace). No-op for the single-device trainer."""
+        if self.mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    def shard_carry(self) -> None:
+        """Re-place ``venv.carry`` in the fused sharded layout (after a reset)."""
+        if self.mesh is not None and self.venv.carry is not None:
+            self.venv.carry = shard_carry(self.venv.carry, self.mesh)
+
+    def warmup_specs(self, params, opt_state, key, *extras):
+        """Specs for ``AOTWarmup.add(step_fn, ...)`` from live example values.
+
+        The carry spec comes from ``venv.carry`` (already mesh-sharded for the
+        sharded trainer — multi-device shardings survive ``spec_like``), the
+        key/extras are committed via :meth:`to_mesh` first, so the background
+        compile targets the exact steady-state placements."""
+        return (
+            jax_compile.specs_of(params),
+            jax_compile.specs_of(opt_state),
+            jax_compile.specs_of(self.venv.carry),
+            jax_compile.spec_like(self.to_mesh(key)),
+        ) + tuple(jax_compile.spec_like(self.to_mesh(e)) for e in extras)

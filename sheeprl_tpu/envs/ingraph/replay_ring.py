@@ -1,0 +1,99 @@
+"""Device-resident replay ring for the fused off-policy (SAC) path.
+
+A fixed-capacity ``[cap, B, ...]`` ring of transition leaves that lives
+entirely in HBM: the fused collector writes its ``[T, B, ...]`` scan output
+straight into the ring (one in-graph scatter per iteration, no host copy), and
+the update scan samples uniform minibatches from it in-graph. State is an
+explicit pytree (:class:`RingState`) so the fused iteration can donate it —
+steady-state SAC then mutates the ring in place, buffer-write to gradient-step,
+without a single transition ever leaving the device.
+
+Sampling is uniform over the ``filled * B`` valid transitions. ``filled`` is a
+traced scalar, so growth from warm-up to full never retraces; the time index
+draws from ``[0, filled)`` relative to the oldest valid row (``pos`` once the
+ring has wrapped, 0 before), which keeps the distribution uniform across the
+wraparound seam. Callers must not sample an empty ring (the fused SAC loop
+prefill guarantees ``filled >= 1`` before the first update; the index bound is
+clamped to 1 so an empty-ring sample is deterministic garbage, not UB).
+
+Contrast with :class:`~sheeprl_tpu.data.device_buffer.DeviceSequentialReplayBuffer`:
+that class is a host-driven object (Python-side ``add``/``sample`` methods,
+jitted per-call) for the Dreamer family's sequence replay; this one is a pure
+functional core for use INSIDE a jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ReplayRing", "RingState"]
+
+
+class RingState(NamedTuple):
+    """The donated HBM ring: data leaves ``[cap, B, *feat]`` + write cursor."""
+
+    data: Dict[str, jax.Array]
+    pos: jax.Array  # i32 scalar: next row to write (oldest row once full)
+    filled: jax.Array  # i32 scalar: number of valid rows, saturates at capacity
+
+
+class ReplayRing:
+    """Static layout (capacity, env batch, leaf specs) + pure init/write/sample.
+
+    ``leaf_specs`` maps leaf name -> ``(feat_shape, dtype)`` where a stored row
+    is ``[B, *feat_shape]``.
+    """
+
+    def __init__(self, capacity: int, n_envs: int, leaf_specs: Dict[str, Tuple[Tuple[int, ...], Any]]):
+        if int(capacity) < 1:
+            raise ValueError(f"replay ring needs capacity >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.n_envs = int(n_envs)
+        self.leaf_specs = {k: (tuple(feat), jnp.dtype(dt)) for k, (feat, dt) in leaf_specs.items()}
+
+    def init_state(self, device: Optional[Any] = None) -> RingState:
+        """An empty ring (zeros; ``filled=0`` marks every row invalid)."""
+        data = {
+            k: jnp.zeros((self.capacity, self.n_envs) + feat, dt)
+            for k, (feat, dt) in self.leaf_specs.items()
+        }
+        state = RingState(data=data, pos=jnp.int32(0), filled=jnp.int32(0))
+        if device is not None:
+            state = jax.device_put(state, device)
+        return state
+
+    def write(self, state: RingState, rows: Dict[str, jax.Array]) -> RingState:
+        """Scatter a ``[T, B, ...]`` block of rows at the cursor (in-graph).
+
+        ``T`` is static (the collect scan length). Writing more than
+        ``capacity`` rows in one call keeps only the last ``capacity`` — the
+        same overwrite semantics as T sequential single-row writes."""
+        t = next(iter(rows.values())).shape[0]
+        idx = (state.pos + jnp.arange(t, dtype=jnp.int32)) % self.capacity
+        data = {
+            k: state.data[k].at[idx].set(rows[k].astype(state.data[k].dtype))
+            for k in state.data
+        }
+        return RingState(
+            data=data,
+            pos=(state.pos + t) % self.capacity,
+            filled=jnp.minimum(state.filled + t, self.capacity),
+        )
+
+    def sample(self, state: RingState, key: jax.Array, batch_size: int) -> Dict[str, jax.Array]:
+        """Uniform in-graph sample of ``batch_size`` transitions ``[batch, *feat]``.
+
+        Deterministic in ``(state, key)``; independent row/env index draws, so
+        transitions mix across envs exactly like the host ReplayBuffer's flat
+        uniform sampling."""
+        k_row, k_env = jax.random.split(key)
+        offset = jax.random.randint(
+            k_row, (batch_size,), 0, jnp.maximum(state.filled, 1), dtype=jnp.int32
+        )
+        oldest = jnp.where(state.filled == self.capacity, state.pos, 0)
+        rows = (oldest + offset) % self.capacity
+        envs = jax.random.randint(k_env, (batch_size,), 0, self.n_envs, dtype=jnp.int32)
+        return {k: v[rows, envs] for k, v in state.data.items()}

@@ -14,8 +14,9 @@ by the ``jax.transfer_guard`` test in tests/test_envs/test_ingraph.py).
 Truncation bootstrapping (the host loop's ``final_obs`` branch) happens
 in-graph too: the critic is evaluated on ``info["terminal_obs"]`` and
 ``gamma * V(terminal_obs)`` is added to the stored reward where the step
-truncated — one extra fused critic call per step instead of a padded host
-round-trip.
+truncated — one batched ``[T*B]`` critic call after the scan (thin per-step
+critic calls cost about as much as the whole act chain on CPU) instead of a
+padded host round-trip.
 
 Episode accounting never touches the host on the hot path either: running
 return/length accumulators ride in the carry and the per-step finished-episode
@@ -72,7 +73,6 @@ class InGraphRolloutCollector:
         self.rollout_steps = int(rollout_steps)
         env, params = venv.env, venv.env_params
         obs_key = venv.obs_key
-        B = venv.num_envs
         step_fn = autoreset_step(env, params)
         act_impl = player._act_impl  # unjitted: fused into this trace
         values_impl = player._values_impl
@@ -92,23 +92,20 @@ class InGraphRolloutCollector:
                 policy_params_ref[0], {obs_key: obs}, carry.key
             )
             key, sub = jax.random.split(key)
-            step_keys = jax.random.split(sub, B)
+            # batch size from the traced obs, NOT the closed-over venv.num_envs:
+            # under shard_map the same trace runs on the [B/n_shards] local block
+            step_keys = jax.random.split(sub, obs.shape[0])
             state, next_obs, reward, done, info = jax.vmap(step_fn)(
                 step_keys, carry.state, to_env_action(env_actions)
             )
             reward = reward.astype(jnp.float32)
             ep_ret = carry.ep_ret + reward
             ep_len = carry.ep_len + 1
-            # truncation bootstrap, in-graph (host path: ppo.py final_obs branch)
-            v_term = values_impl(policy_params_ref[0], {obs_key: info["terminal_obs"]})
-            stored = reward + info["truncated"].astype(jnp.float32) * (gamma * v_term[:, 0])
-            if clip_rewards:
-                stored = jnp.tanh(stored)
             out = {
                 obs_key: obs,
                 "actions": cat_actions,
                 "values": values,
-                "rewards": stored[:, None],
+                "rewards": reward[:, None],
                 "dones": done.astype(jnp.float32)[:, None],
             }
             if store_logprobs:
@@ -125,7 +122,8 @@ class InGraphRolloutCollector:
                 ep_ret=jnp.where(done, 0.0, ep_ret),
                 ep_len=jnp.where(done, 0, ep_len),
             )
-            return new_carry, (out, step_metrics)
+            aux = (info["terminal_obs"], info["truncated"].astype(jnp.float32))
+            return new_carry, (out, step_metrics, aux)
 
         # _act_impl closes over params positionally; a one-slot list lets the
         # scan body read the traced params without re-deriving the closure
@@ -133,10 +131,31 @@ class InGraphRolloutCollector:
 
         def collect(policy_params, carry: Carry):
             policy_params_ref[0] = policy_params
-            carry, (data, metrics) = jax.lax.scan(one_step, carry, None, length=self.rollout_steps)
+            carry, (data, metrics, aux) = jax.lax.scan(
+                one_step, carry, None, length=self.rollout_steps
+            )
+            # truncation bootstrap, in-graph (host path: ppo.py final_obs branch)
+            # — computed as ONE batched [T*B] critic call after the scan instead
+            # of T thin per-step calls, which costs about as much as the whole
+            # act chain on CPU (the per-row math is identical)
+            term_obs, truncated = aux
+            v_term = values_impl(
+                policy_params, {obs_key: term_obs.reshape((-1,) + term_obs.shape[2:])}
+            )
+            stored = data["rewards"][..., 0] + truncated * (
+                gamma * v_term[:, 0].reshape(truncated.shape)
+            )
+            if clip_rewards:
+                stored = jnp.tanh(stored)
+            data = dict(data)
+            data["rewards"] = stored[..., None]
             next_values = values_impl(policy_params, {obs_key: carry.obs})
             return carry, data, metrics, next_values
 
+        # the unjitted impl is what the fused trainer (envs/ingraph/fused.py)
+        # inlines into its whole-iteration trace — same expressions, so the
+        # fused path stays bit-identical to collect_fn + train_fn run apart
+        self.collect_impl = collect
         self.collect_fn = jax_compile.guarded_jit(collect, name=f"{name}.ingraph_collect")
 
     def collect(self):

@@ -1,0 +1,143 @@
+"""ReplayRing (envs/ingraph/replay_ring.py): the donated HBM transition store
+for the fused off-policy path.
+
+Pins the three contracts the fused SAC iteration leans on: block writes wrap
+the cursor with the same overwrite semantics as sequential single-row writes,
+sampling is uniform over exactly the ``filled * B`` valid transitions (seam
+included), and both write and sample behave identically eager and under jit —
+they run INSIDE the fused program, so any host-side divergence would silently
+fork training from what the unit tests check.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.ingraph.replay_ring import ReplayRing
+
+pytestmark = pytest.mark.ingraph
+
+N_ENVS = 3
+
+
+def _ring(capacity: int = 4) -> ReplayRing:
+    return ReplayRing(
+        capacity, N_ENVS, {"obs": ((2,), jnp.float32), "rew": ((1,), jnp.float32)}
+    )
+
+
+def _rows(first_val: int, t: int):
+    """A [t, B, ...] block whose every element equals its global write index,
+    so ring contents identify exactly which writes survived."""
+    vals = jnp.arange(first_val, first_val + t, dtype=jnp.float32)
+    return {
+        "obs": jnp.broadcast_to(vals[:, None, None], (t, N_ENVS, 2)),
+        "rew": jnp.broadcast_to(vals[:, None, None], (t, N_ENVS, 1)),
+    }
+
+
+def _row_vals(state) -> np.ndarray:
+    """One scalar per ring row (rows are constant blocks by construction)."""
+    return np.asarray(state.data["obs"])[:, 0, 0]
+
+
+def test_write_fills_then_wraps():
+    ring = _ring(capacity=4)
+    state = ring.init_state()
+    assert int(state.filled) == 0
+
+    state = ring.write(state, _rows(0, 3))
+    assert int(state.pos) == 3 and int(state.filled) == 3
+    np.testing.assert_array_equal(_row_vals(state), [0.0, 1.0, 2.0, 0.0])
+
+    state = ring.write(state, _rows(3, 3))
+    # rows 3,4,5 land at slots 3,0,1 — the two oldest rows are overwritten
+    assert int(state.pos) == 2 and int(state.filled) == 4
+    np.testing.assert_array_equal(_row_vals(state), [4.0, 5.0, 2.0, 3.0])
+
+
+def test_oversize_block_write_matches_sequential_writes():
+    ring = _ring(capacity=4)
+    blocked = ring.write(ring.init_state(), _rows(0, 6))
+    sequential = ring.init_state()
+    for i in range(6):
+        sequential = ring.write(sequential, _rows(i, 1))
+    assert int(blocked.pos) == int(sequential.pos)
+    assert int(blocked.filled) == int(sequential.filled)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        blocked.data,
+        sequential.data,
+    )
+
+
+def test_sample_draws_only_valid_rows_before_wrap():
+    ring = _ring(capacity=8)
+    state = ring.write(ring.init_state(), _rows(1, 2))  # rows 1,2 valid; 6 empty
+    batch = ring.sample(state, jax.random.PRNGKey(0), 256)
+    vals = np.asarray(batch["obs"])[:, 0]
+    assert set(np.unique(vals)) == {1.0, 2.0}, "sampled an unwritten (zero) row"
+    assert batch["obs"].shape == (256, 2) and batch["rew"].shape == (256, 1)
+
+
+def test_sample_uniform_across_wraparound_seam():
+    ring = _ring(capacity=4)
+    state = ring.write(ring.init_state(), _rows(0, 6))  # valid rows hold 2..5
+    vals = np.asarray(ring.sample(state, jax.random.PRNGKey(1), 4096)["obs"])[:, 0]
+    counts = {v: int((vals == v).sum()) for v in (2.0, 3.0, 4.0, 5.0)}
+    assert sum(counts.values()) == 4096, f"sampled overwritten rows: {np.unique(vals)}"
+    # uniform within tolerance: each valid row should get ~1024 of 4096 draws
+    assert min(counts.values()) > 700 and max(counts.values()) < 1400, counts
+
+
+def test_sample_determinism_and_jit_parity():
+    ring = _ring(capacity=4)
+    state = ring.write(ring.init_state(), _rows(0, 4))
+    key = jax.random.PRNGKey(7)
+    eager_a = ring.sample(state, key, 32)
+    eager_b = ring.sample(state, key, 32)
+    jitted = jax.jit(partial(ring.sample, batch_size=32))(state, key)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        eager_a,
+        eager_b,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        eager_a,
+        jitted,
+    )
+    other = ring.sample(state, jax.random.PRNGKey(8), 32)
+    assert not np.array_equal(np.asarray(eager_a["obs"]), np.asarray(other["obs"]))
+
+
+def test_in_graph_write_then_sample_roundtrip():
+    """The fused-iteration composition — donate the state, scatter a block,
+    sample from the SAME program — works as one jitted function and matches
+    the eager reference bit-for-bit."""
+    ring = _ring(capacity=4)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, rows, key):
+        state = ring.write(state, rows)
+        return state, ring.sample(state, key, 16)
+
+    key = jax.random.PRNGKey(3)
+    eager_state = ring.write(ring.init_state(), _rows(0, 3))
+    eager_batch = ring.sample(eager_state, key, 16)
+    jit_state, jit_batch = step(ring.init_state(), _rows(0, 3), key)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        (eager_state, eager_batch),
+        (jit_state, jit_batch),
+    )
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError, match="capacity"):
+        ReplayRing(0, 2, {"obs": ((1,), jnp.float32)})

@@ -1,0 +1,34 @@
+"""Satellite registration of scripts/ingraph_train_smoke.py as a tier-1 test:
+fresh-interpreter fused whole-iteration PPO training (single-device AND the
+2-device shard_map variant) must finish with zero retraces and leave a
+finite-return-playing env behind — the cheapest end-to-end proof that the
+fused train path stays wired through the config, compile, and algo layers."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.ingraph
+@pytest.mark.timeout(600)
+def test_ingraph_train_smoke(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "ingraph_train_smoke.py"),
+            "--workdir",
+            str(tmp_path),
+            "--timeout",
+            "420",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-1500:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "ingraph train smoke OK" in out.stdout
