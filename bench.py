@@ -19,25 +19,48 @@ import json
 import sys
 import time
 
-# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets)
-_PEAK_BF16 = {
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v4": 275e12,
-    "v3": 123e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
-
 def _chip_peak_flops(device):
-    kind = getattr(device, "device_kind", "").lower()
-    for key, peak in _PEAK_BF16.items():
-        if key in kind:
-            return peak
-    return None  # unknown chip: report MFU as null rather than fabricate one
+    # single source of truth for the per-chip bf16 peak table lives in the
+    # telemetry fabric (imported lazily: bench must stay importable before the
+    # backend-discovery watchdog has run)
+    from sheeprl_tpu.telemetry.device import chip_peak_flops
+
+    return chip_peak_flops(device)
+
+
+def _provenance() -> dict:
+    """run_id + git SHA + telemetry trace pointers stamped on every bench
+    record, so a BENCH_r*.json row is attributable to the exact tree and trace
+    that produced it (null-tolerant: a missing git binary or disabled tracer
+    must never cost the measurement)."""
+    import os
+    import subprocess
+    import uuid
+
+    out = {"run_id": uuid.uuid4().hex[:12], "git_sha": None}
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short=12", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True,
+            text=True,
+            timeout=10,
+        )
+        out["git_sha"] = sha.stdout.strip() or None
+    except Exception:
+        pass
+    try:
+        from sheeprl_tpu.telemetry import trace
+
+        out["trace_id"] = trace.current_trace_id() or None
+        out["trace_path"] = (
+            trace.export(os.path.join("logs", "telemetry", f"bench_{out['run_id']}.trace.json"))
+            if trace.enabled()
+            else None
+        )
+    except Exception:
+        out["trace_id"] = out["trace_path"] = None
+    return out
 
 
 def _ppo_pass(total_steps: int) -> float:
@@ -343,6 +366,149 @@ def bench_ingraph_train(num_envs: int = 4096, rollout_steps: int = 128, iters: i
         "ingraph_fused_train_num_envs": num_envs,
         "ingraph_fused_train_rollout_steps": rollout_steps,
         "ingraph_fused_train_tpu_slice_target_env_steps_per_sec": 1_000_000,
+    }
+
+
+def bench_telemetry(num_envs: int = 256, rollout_steps: int = 32, iters: int = 8, reps: int = 3) -> dict:
+    """Span-tracer overhead on the fused PPO iteration, plus auto-computed MFU.
+
+    Three interleaved variants of the same AOT-warmed fused loop: ``baseline``
+    (no instrumentation calls at all), ``spans-off`` (the production span/
+    instant seams present, tracer disabled — the zero-cost-when-disabled
+    guarantee as a measured number), and ``spans-on`` (tracer recording into
+    the ring). Interleaving reps A/B/C absorbs thermal/scheduler drift; the
+    assertions use each variant's best-of (overhead is additive, so the
+    fastest rep of each is the least-noise comparison):
+
+    - spans-on must cost < 2% env-steps/s vs baseline,
+    - spans-off must be indistinguishable from baseline (< 1%, i.e. 0 modulo
+      measurement noise).
+
+    MFU is computed, not hand-derived: the fused step's FLOPs come from
+    ``lowered.compile().cost_analysis()`` captured by the retrace guard at
+    AOT-warm time (core/compile.py), divided by measured iteration time and
+    the chip's bf16 peak (telemetry/device.py) — null on chips with no peak
+    table entry rather than fabricated.
+    """
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+    from sheeprl_tpu.algos.ppo.ppo import make_update_impl
+    from sheeprl_tpu.config import instantiate, load_config
+    from sheeprl_tpu.core.runtime import build_runtime
+    from sheeprl_tpu.envs import ingraph as ig
+    from sheeprl_tpu.telemetry import device as tel_device
+    from sheeprl_tpu.telemetry import trace
+    from sheeprl_tpu.utils.optim import with_clipping
+    from sheeprl_tpu.utils.utils import PlayerParamsSync
+
+    n_data = num_envs * rollout_steps
+    cfg = load_config(
+        overrides=[
+            "exp=ppo",
+            "env=jax_cartpole",
+            f"env.num_envs={num_envs}",
+            f"algo.rollout_steps={rollout_steps}",
+            f"algo.per_rank_batch_size={n_data}",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+        ]
+    )
+    runtime = build_runtime(cfg.fabric)
+    venv = ig.make_vector_env(cfg, num_envs, 42, device=runtime.device)
+    agent, params, player = build_agent(runtime, (2,), False, cfg, venv.single_observation_space, None)
+    player.params = jax.device_put(player.params, runtime.device)
+    venv.reset(seed=42)
+    collector = ig.InGraphRolloutCollector(
+        venv, player, rollout_steps=rollout_steps, gamma=float(cfg.algo.gamma), name="bench_tel"
+    )
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    params_sync = PlayerParamsSync(player.params)
+    update_impl = make_update_impl(
+        agent, tx, cfg, runtime, n_data, list(cfg.algo.mlp_keys.encoder), [], params_sync
+    )
+    trainer = ig.FusedInGraphTrainer(collector, update_impl, n_extras=3, name="bench_tel")
+    key = jax.random.PRNGKey(0)
+    extras = (jnp.float32(cfg.algo.clip_coef), jnp.float32(cfg.algo.ent_coef), jnp.float32(1.0))
+    st = {"params": params, "opt": opt_state, "key": key}
+
+    def plain_step():
+        st["key"], sub = jax.random.split(st["key"])
+        st["params"], st["opt"], _flat, _roll, _train = trainer.step(st["params"], st["opt"], sub, *extras)
+
+    def traced_step():
+        # the production fused loop's per-iteration seams: one update span +
+        # one instant (ppo.py wraps the fused step exactly like this)
+        with trace.span("train/update", fused=True):
+            plain_step()
+        trace.instant("bench/iter")
+
+    saved_env = os.environ.get(trace.ENV_VAR)
+    trace.disable()
+    # AOT-warm registers the executable AND captures its cost_analysis() FLOPs
+    trainer.step_fn.aot_compile(
+        *trainer.warmup_specs(st["params"], st["opt"], st["key"], *extras)
+    )
+    plain_step()  # first dispatch
+    jax.block_until_ready(st["params"])
+
+    def measure(step) -> float:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            step()
+        jax.block_until_ready(st["params"])
+        return n_data * iters / (time.perf_counter() - t0)
+
+    base, off, on = [], [], []
+    try:
+        for _ in range(reps):
+            trace.disable()
+            base.append(measure(plain_step))
+            off.append(measure(traced_step))
+            trace.configure(plane="train", capacity=65536)
+            on.append(measure(traced_step))
+        tel_stats = trace.stats()
+        trace_path = trace.export(
+            os.path.join(tempfile.mkdtemp(prefix="bench_telemetry_"), "trace.json")
+        )
+    finally:
+        trace.disable()
+        if saved_env is not None:
+            os.environ[trace.ENV_VAR] = saved_env
+
+    overhead_on = (max(base) / max(on) - 1.0) * 100.0
+    overhead_off = (max(base) / max(off) - 1.0) * 100.0
+    if overhead_on >= 2.0:
+        raise RuntimeError(
+            f"span tracer costs {overhead_on:.2f}% env-steps/s on the fused loop (budget: < 2%)"
+        )
+    if overhead_off >= 1.0:
+        raise RuntimeError(
+            f"DISABLED span seams cost {overhead_off:.2f}% env-steps/s (must be 0 within noise)"
+        )
+    step_flops = trainer.step_fn.last_step_flops
+    iter_s = n_data / max(base)
+    mfu = tel_device.mfu(step_flops, iter_s, runtime.device)
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    return {
+        "telemetry_tracer_overhead_pct": round(overhead_on, 3),
+        "telemetry_disabled_overhead_pct": round(overhead_off, 3),
+        "telemetry_baseline_env_steps_per_sec": round(med(base), 2),
+        "telemetry_spans_off_env_steps_per_sec": round(med(off), 2),
+        "telemetry_spans_on_env_steps_per_sec": round(med(on), 2),
+        "telemetry_spans_recorded": tel_stats.get("Telemetry/spans_recorded"),
+        "telemetry_trace_export_path": trace_path,
+        "telemetry_step_tflops": round(step_flops / 1e12, 4) if step_flops else None,
+        "telemetry_mfu": round(mfu, 4) if mfu is not None else None,
+        "telemetry_num_envs": num_envs,
+        "telemetry_rollout_steps": rollout_steps,
+        "telemetry_overhead_budget_pct": 2.0,
     }
 
 
@@ -904,6 +1070,7 @@ def _target_metric(target: str) -> str:
         "transport": "transport_chunk_roundtrip_ms",
         "ingraph": "ingraph_env_steps_per_sec",
         "ingraph_train": "ingraph_fused_train_env_steps_per_sec",
+        "telemetry": "telemetry_tracer_overhead_pct",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -922,6 +1089,7 @@ _METRIC_UNITS = {
     "transport_chunk_roundtrip_ms": "ms",
     "ingraph_env_steps_per_sec": "env-steps/s",
     "ingraph_fused_train_env_steps_per_sec": "env-steps/s",
+    "telemetry_tracer_overhead_pct": "%",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
 
@@ -986,6 +1154,7 @@ if __name__ == "__main__":
             "transport",
             "ingraph",
             "ingraph_train",
+            "telemetry",
             "all",
         ),
         default="all",
@@ -1140,6 +1309,16 @@ if __name__ == "__main__":
                 result.setdefault("value", igt.get("ingraph_fused_train_env_steps_per_sec"))
                 result.setdefault("unit", "env-steps/s")
                 result.setdefault("vs_baseline", igt.get("vs_baseline"))
+            if cli_args.target == "telemetry":
+                # opt-in only: span-tracer overhead on the AOT-warmed fused
+                # PPO loop (spans-on vs spans-off vs no-seams baseline) with
+                # MFU auto-computed from the executable's own cost_analysis
+                tel = bench_telemetry()
+                result.update(tel)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", tel.get("telemetry_tracer_overhead_pct"))
+                result.setdefault("unit", "%")
+                result.setdefault("vs_baseline", None)
             if cli_args.target == "transport":
                 # opt-in only: host control-plane latency/throughput drill
                 # (sockets + failpoints; no accelerator involved at all)
@@ -1158,4 +1337,5 @@ if __name__ == "__main__":
     # backend), "cpu_fallback" (measured, but on the fallback), or "skipped"
     # (the watchdog's double-timeout record above — no measurement at all)
     result.setdefault("status", "ok")
+    result.update(_provenance())
     print(json.dumps(result))

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Telemetry smoke: one trace id across every cross-plane record surface.
+
+The drill proves the ISSUE's acceptance shape end-to-end with real processes:
+
+1. reuse the serve-smoke fixture (tiny certified PPO checkpoint, no training)
+   and launch ``sheeprl_serve.py`` with a pinned trace id in the
+   ``SHEEPRL_TPU_TRACE`` env var — the shape a parent orchestrator uses to
+   join children into its trace — plus a one-shot ``reload.canary:raise``
+   failpoint;
+2. drive infer requests over the TCP frontend (each records the
+   admit->batch->infer->respond span lifecycle), then scrape the
+   ``{"op": "metrics"}`` Prometheus exposition and check the trace id rides
+   the ``sheeprl_run_info`` series;
+3. certify a second checkpoint generation: the canary failpoint trips the
+   reload, and the rollback must land in ``<run_dir>/health/events.jsonl``
+   stamped with the SAME trace id (core/health.append_event); the retry then
+   hot-reloads generation 2 for real;
+4. SIGTERM: the final stats snapshot must carry ``trace_path``/``trace_id``,
+   and the exported Chrome trace at that path must hold the same id in its
+   metadata plus the serve/request and rollback-marked serve/reload spans.
+
+One request tripping one failpoint is therefore visible — under one id — in
+the Perfetto export, the Prometheus op, and the health event log. Run
+directly (``python scripts/telemetry_smoke.py``) or through the registered
+tier-1 test (tests/test_utils/test_telemetry_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+import uuid
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from scripts.serve_smoke import (  # noqa: E402
+    _wait_until,
+    build_fixture,
+    launch_server,
+    perturb,
+    rpc,
+    wait_ready,
+    write_generation,
+)
+
+
+def _read_events(events_path: str) -> list:
+    if not os.path.isfile(events_path):
+        return []
+    with open(events_path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def main(workdir: str | None = None, timeout: float = 300.0) -> dict:
+    workdir = workdir or tempfile.mkdtemp(prefix="telemetry_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    started = time.monotonic()
+    trace_id = uuid.uuid4().hex[:16]
+
+    fixture = build_fixture(workdir)
+    events_path = os.path.join(fixture["run_dir"], "health", "events.jsonl")
+    ready_file = os.path.join(workdir, "ready.json")
+    stats_file = os.path.join(workdir, "stats.json")
+    log_file = os.path.join(workdir, "server.log")
+    proc = launch_server(
+        fixture,
+        ready_file,
+        stats_file,
+        log_file,
+        env_extra={
+            # the parent-pins-the-id join: the server's tracer must adopt this
+            # trace id at import instead of minting its own
+            "SHEEPRL_TPU_TRACE": f"plane=serve;trace_id={trace_id}",
+            "SHEEPRL_TPU_FAILPOINTS": "reload.canary:raise:telemetry-drill:hit=1",
+        },
+    )
+    try:
+        info = wait_ready(ready_file, proc, log_file, timeout=min(240.0, timeout))
+        addr = (info["host"], info["port"])
+
+        # -- surface 1: request lifecycle spans + the Prometheus op ----------
+        for i in range(8):
+            resp = rpc(addr, {"id": f"tel-{i}", "obs": fixture["obs"]})
+            if resp.get("status") != "ok":
+                raise SystemExit(f"infer request {i} not ok: {resp}")
+        metrics = rpc(addr, {"op": "metrics"})
+        if metrics.get("status") != "ok":
+            raise SystemExit(f"metrics op failed: {metrics}")
+        if metrics.get("trace_id") != trace_id:
+            raise SystemExit(
+                f"metrics op trace_id={metrics.get('trace_id')!r}, expected {trace_id!r}: "
+                "the server did not join the parent's trace"
+            )
+        text = metrics["text"]
+        run_info = f'sheeprl_run_info{{trace_id="{trace_id}"}} 1'
+        if run_info not in text.splitlines():
+            raise SystemExit(f"Prometheus exposition lacks {run_info!r}; got:\n{text[:1500]}")
+        for series in ("sheeprl_serve_requests_total", "sheeprl_telemetry_spans_recorded"):
+            if f"\n{series} " not in "\n" + text:
+                raise SystemExit(f"Prometheus exposition lacks the {series} series:\n{text[:1500]}")
+
+        # -- surface 2: the rollback event row carries the same id -----------
+        write_generation(fixture["ckpt_dir"], perturb(fixture["state"]), step=200)
+        _wait_until(
+            lambda: any(e.get("event") == "serve_reload_rollback" for e in _read_events(events_path)),
+            90,
+            "the canary-tripped rollback to reach health/events.jsonl",
+            log_file,
+        )
+        rollback = next(e for e in _read_events(events_path) if e["event"] == "serve_reload_rollback")
+        if rollback.get("trace_id") != trace_id:
+            raise SystemExit(f"rollback event trace_id={rollback.get('trace_id')!r} != {trace_id!r}: {rollback}")
+        # the one-shot failpoint is spent: the retry must land generation 2
+        _wait_until(
+            lambda: rpc(addr, {"op": "health"}).get("gen", 0) >= 2,
+            90,
+            "the post-rollback retry to hot-reload generation 2",
+            log_file,
+        )
+
+        # -- surface 3: shutdown exports the Perfetto trace at trace_path ----
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=90)
+        if rc != 0:
+            with open(log_file) as f:
+                raise SystemExit(f"server exited rc={rc} on SIGTERM; log tail:\n{f.read()[-2000:]}")
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+    with open(stats_file) as f:
+        stats = json.load(f)
+    if stats.get("trace_id") != trace_id:
+        raise SystemExit(f"shutdown stats trace_id={stats.get('trace_id')!r} != {trace_id!r}")
+    trace_path = stats.get("trace_path")
+    if not trace_path or not os.path.isfile(trace_path):
+        raise SystemExit(f"shutdown stats trace_path={trace_path!r} missing or not a file")
+    with open(trace_path) as f:
+        doc = json.load(f)
+    if doc["metadata"]["trace_id"] != trace_id:
+        raise SystemExit(f"exported trace metadata trace_id={doc['metadata']['trace_id']!r} != {trace_id!r}")
+    names = [ev.get("name") for ev in doc["traceEvents"]]
+    for required in ("serve/request", "serve/queue_wait", "serve/infer", "serve/reload"):
+        if required not in names:
+            raise SystemExit(f"exported trace lacks a {required!r} span; spans seen: {sorted(set(names))}")
+    rollbacks = [
+        ev
+        for ev in doc["traceEvents"]
+        if ev.get("name") == "serve/reload" and ev.get("args", {}).get("rollback")
+    ]
+    if not rollbacks:
+        raise SystemExit("exported trace has no rollback-marked serve/reload span")
+    if any(ev.get("args", {}).get("trace_id") not in (None, trace_id) for ev in doc["traceEvents"]):
+        raise SystemExit("exported trace mixes foreign trace ids")
+
+    return {
+        "workdir": workdir,
+        "wall_s": round(time.monotonic() - started, 2),
+        "trace_id": trace_id,
+        "trace_path": trace_path,
+        "trace_spans": len(doc["traceEvents"]),
+        "rollback_event": rollback,
+        "serve_ok": stats.get("Serve/ok"),
+        "spans_recorded": stats.get("Telemetry/spans_recorded"),
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None, help="drill directory (default: fresh tempdir)")
+    parser.add_argument("--timeout", type=float, default=300.0, help="overall budget in seconds")
+    cli = parser.parse_args()
+    result = main(cli.workdir, cli.timeout)
+    print(
+        "telemetry smoke OK: "
+        f"trace id {result['trace_id']} joined the Prometheus op, the rollback row in "
+        f"health/events.jsonl, and the {result['trace_spans']}-event Perfetto export at "
+        f"{result['trace_path']} ({result['wall_s']}s)"
+    )

@@ -31,6 +31,7 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
+from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -483,7 +484,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # rollout scan, GAE, and the accumulated update run as ONE
                 # compiled donated-carry program (see ppo.py)
                 failpoints.failpoint("train.fused_update", iter=iter_num)
-                with timer("Time/train_time", SumMetric()):
+                with trace.span("train/update", fused=True, iter=iter_num), timer(
+                    "Time/train_time", SumMetric()
+                ):
                     if iter_num == start_iter:
                         warmup.wait()
                     policy_step += n_envs * cfg.algo.rollout_steps
@@ -504,7 +507,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # ----- split ingraph path (env.fused=False): the fused rollout
                 # scan followed by the separately jitted train step below — the
                 # fused path's parity reference
-                with timer("Time/env_interaction_time", SumMetric()):
+                with trace.span("train/collect", iter=iter_num), timer(
+                    "Time/env_interaction_time", SumMetric()
+                ):
                     policy_step += n_envs * cfg.algo.rollout_steps
                     ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
                 # zero-cost unless an env.autoreset drill is armed
@@ -566,7 +571,9 @@ def main(runtime, cfg: Dict[str, Any]):
                     if cfg.buffer.size > cfg.algo.rollout_steps:
                         idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
                         local_data = {k: v[idx] for k, v in local_data.items()}
-                with timer("Time/train_time", SumMetric()):
+                with trace.span("train/update", iter=iter_num), timer(
+                    "Time/train_time", SumMetric()
+                ):
                     if iter_num == start_iter:
                         # surface any residual warmup compile time here rather than
                         # inside the train call (the rollout overlapped the thread)

@@ -15,6 +15,7 @@ TPU-first structure:
 from __future__ import annotations
 
 import os
+import time
 import warnings
 from functools import partial
 from typing import Any, Dict
@@ -37,6 +38,8 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
+from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -603,7 +606,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # leaves return to the host. Chaos seam first, so drills and
                 # the sentinel's rollback ladder cover the fused path too.
                 failpoints.failpoint("train.fused_update", iter=iter_num)
-                with timer("Time/train_time", SumMetric()):
+                with trace.span("train/update", fused=True, iter=iter_num), timer(
+                    "Time/train_time", SumMetric()
+                ):
                     if iter_num == start_iter:
                         warmup.wait()
                     policy_step += n_envs * cfg.algo.rollout_steps
@@ -626,7 +631,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # ----- split ingraph path (env.fused=False): the fused rollout
                 # scan (envs/ingraph/rollout.py) followed by the separately
                 # jitted train step below — the fused path's parity reference
-                with timer("Time/env_interaction_time", SumMetric()):
+                with trace.span("train/collect", iter=iter_num), timer(
+                    "Time/env_interaction_time", SumMetric()
+                ):
                     policy_step += n_envs * cfg.algo.rollout_steps
                     ingraph_data, roll_metrics, ingraph_next_values = collector.collect()
                 # zero-cost unless an env.autoreset drill is armed (the has()
@@ -634,6 +641,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 envs.fire_autoreset_failpoints(roll_metrics["dones"])
                 _drain_ingraph_episodes(roll_metrics)
             else:
+                _collect_t0 = time.perf_counter()
                 for _ in range(cfg.algo.rollout_steps):
                     policy_step += n_envs
 
@@ -723,6 +731,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 with timer("Time/env_interaction_time", SumMetric()):
                     # flush: the rollout's last row has no next act transfer to ride
                     _process_pending(None)
+                # whole host-rollout phase as one span (explicit timestamps: the
+                # per-step loop is too hot to wrap per step)
+                trace.add_span(
+                    "train/collect", _collect_t0, time.perf_counter(), clock="perf", iter=iter_num
+                )
 
             # ----- optimization phase: single jitted call (GAE + epochs x minibatches).
             # The fused path already ran its update inside the one program above.
@@ -734,7 +747,9 @@ def main(runtime, cfg: Dict[str, Any]):
                         # beyond the write head would corrupt GAE)
                         idx = np.arange(rb._pos - cfg.algo.rollout_steps, rb._pos) % cfg.buffer.size
                         local_data = {k: v[idx] for k, v in local_data.items()}
-                with timer("Time/train_time", SumMetric()):
+                with trace.span("train/update", iter=iter_num), timer(
+                    "Time/train_time", SumMetric()
+                ):
                     if iter_num == start_iter:
                         # every registered entry point compiled before the first
                         # train dispatch (usually already done: the whole first
@@ -786,6 +801,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     aggregator.update_from_device(train_metrics)
                 logger.log_metrics({"Info/clip_coef": cfg.algo.clip_coef, "Info/ent_coef": cfg.algo.ent_coef}, policy_step)
                 if policy_step - last_log >= cfg.metric.log_every or iter_num == total_iters:
+                    _drain_t0 = time.perf_counter()
                     overlap_s, overlap_steps = stepper.drain_overlap()
                     if overlap_s > 0:
                         # env-step throughput absorbed into the overlap window
@@ -805,6 +821,17 @@ def main(runtime, cfg: Dict[str, Any]):
                                 {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
                                 policy_step,
                             )
+                            # MFU from the compiler's own cost model: the train
+                            # fn's per-call FLOPs were captured off
+                            # cost_analysis() when its executable AOT-compiled
+                            _train_gfn = fused_trainer.step_fn if fused_trainer is not None else train_fn
+                            _mfu = tel_device.mfu(
+                                getattr(_train_gfn, "last_step_flops", None),
+                                timer_metrics["Time/train_time"] / max(train_step - last_train, 1),
+                                runtime.device,
+                            )
+                            if _mfu is not None:
+                                logger.log_metrics({"Time/mfu": _mfu}, policy_step)
                         if timer_metrics.get("Time/env_interaction_time", 0) > 0:
                             logger.log_metrics(
                                 {
@@ -816,6 +843,13 @@ def main(runtime, cfg: Dict[str, Any]):
                                 policy_step,
                             )
                         timer.reset()
+                    trace.add_span(
+                        "train/metric_drain",
+                        _drain_t0,
+                        time.perf_counter(),
+                        clock="perf",
+                        step=policy_step,
+                    )
                     last_log = policy_step
                     last_train = train_step
 
@@ -883,13 +917,14 @@ def main(runtime, cfg: Dict[str, Any]):
             ):
                 last_checkpoint = policy_step
                 ckpt_path = os.path.join(log_dir, f"checkpoint/ckpt_{policy_step}_{runtime.global_rank}.ckpt")
-                runtime.call(
-                    "on_checkpoint_coupled",
-                    ckpt_path=ckpt_path,
-                    state=_ckpt_state(),
-                    healthy=sentinel.certifiable,
-                    policy_step=policy_step,
-                )
+                with trace.span("train/checkpoint", step=policy_step):
+                    runtime.call(
+                        "on_checkpoint_coupled",
+                        ckpt_path=ckpt_path,
+                        state=_ckpt_state(),
+                        healthy=sentinel.certifiable,
+                        policy_step=policy_step,
+                    )
 
             guard.completed_iteration()
             if guard.should_stop:
@@ -912,6 +947,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 break
 
     profiler.close()
+    if trace.enabled() and runtime.is_global_zero and log_dir:
+        try:
+            trace.export(os.path.join(log_dir, "telemetry", "trace.json"))
+        except OSError:
+            pass
     envs.close()
     if runtime.is_global_zero and cfg.algo.run_test:
         if use_ingraph:

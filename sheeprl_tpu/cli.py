@@ -149,15 +149,23 @@ def _find_entrypoint(algo_name: str) -> Optional[Dict[str, Any]]:
     return None
 
 
-def _apply_global_flags(cfg: dotdict) -> None:
+def _apply_global_flags(cfg: dotdict, plane: str = "train") -> None:
     import jax
 
     from sheeprl_tpu.core import compile as jax_compile
+    from sheeprl_tpu.telemetry import trace
     from sheeprl_tpu.utils.timer import timer
 
     # Compile-management policy (retrace guard, AOT switch, persistent-cache
     # knobs) must be live before the first trace of the run.
     jax_compile.configure(cfg)
+
+    # Span tracer: an inherited SHEEPRL_TPU_TRACE env var wins over config —
+    # the orchestrator (or an operator) sets it to join child processes into
+    # one trace id, and a sidecar config must not sever that.
+    tel_cfg = cfg.get("metric", {}).get("telemetry") if "metric" in cfg else None
+    if tel_cfg and bool(tel_cfg.get("trace", False)) and not os.environ.get(trace.ENV_VAR):
+        trace.configure(plane=plane, capacity=int(tel_cfg.get("capacity", 16384)))
 
     # Reference cli.py:161. Critical on remote accelerators: the train loops fence
     # device work ONLY when timing (block_until_ready costs a full round-trip per
@@ -454,7 +462,7 @@ def serve(overrides: Optional[Sequence[str]] = None) -> None:
         node[parts[-1]] = value
     cfg.fabric.devices = 1
     seed_everything(cfg.seed)
-    _apply_global_flags(cfg)
+    _apply_global_flags(cfg, plane="serve")
     server = PolicyServer(cfg, state, source=source, ckpt_dir=ckpt_dir, boot_info=boot_info)
     server.start()
     print(f"serving on {server.host}:{server.port} (source {source})", flush=True)

@@ -290,6 +290,12 @@ class GuardedFn:
         self.name = name or getattr(fun, "__name__", "<fn>")
         self._jit_kwargs = dict(jit_kwargs)
         self._aot: Dict[Tuple, Any] = {}
+        # exact model FLOPs per AOT executable, from cost_analysis() at
+        # compile time (telemetry: Time/mfu is computed from these, never
+        # hand-derived). Keyed like _aot; last_step_flops is the newest.
+        self._aot_flops: Dict[Tuple, float] = {}
+        self.last_step_flops: Optional[float] = None
+        self.flops_dispatched = 0.0
         # warmup jobs queued for this fn but not yet compiled (threading.Events,
         # set by the AOTWarmup thread): callers racing the warmup wait for them
         # instead of redundantly tracing the same signature on the hot path
@@ -336,6 +342,8 @@ class GuardedFn:
             "aot_fallbacks": self.aot_fallbacks,
             "compile_seconds": self.compile_seconds,
             "first_call_s": self.first_call_s,
+            "flops_dispatched": self.flops_dispatched,
+            "step_flops": self.last_step_flops,
         }
 
     # ----- AOT ------------------------------------------------------------------
@@ -346,8 +354,12 @@ class GuardedFn:
         t0 = time.perf_counter()
         exe = jax.jit(self.fun, **self._jit_kwargs).lower(*specs, **kwspecs).compile()
         dt = time.perf_counter() - t0
+        flops = _cost_flops(exe)
         with _LOCK:
             self._aot[_routing_key(sig)] = exe
+            if flops is not None:
+                self._aot_flops[_routing_key(sig)] = flops
+                self.last_step_flops = flops
             self.aot_compiles += 1
             self.compile_seconds += dt
             self._had_any_compile = True
@@ -369,7 +381,8 @@ class GuardedFn:
         sig: Optional[Tuple] = None
         if self._aot or self._aot_pending:
             sig = abstract_signature(args, kwargs)
-            exe = self._aot.get(_routing_key(sig))
+            key = _routing_key(sig)
+            exe = self._aot.get(key)
             if exe is None and self._aot_pending:
                 # a background warmup for this fn is (probably) compiling the
                 # executable this call needs: waiting is never slower than
@@ -378,10 +391,13 @@ class GuardedFn:
                 for ev in list(self._aot_pending):
                     ev.wait(timeout=600.0)
                 self._aot_pending = []
-                exe = self._aot.get(_routing_key(sig))
+                exe = self._aot.get(key)
             if exe is not None:
                 try:
                     out = exe(*args, **kwargs)
+                    fl = self._aot_flops.get(key)
+                    if fl is not None:
+                        self.flops_dispatched += fl
                     if self.first_call_s is None:
                         self.first_call_s = time.perf_counter() - _T0
                     return out
@@ -395,7 +411,8 @@ class GuardedFn:
                         raise
                     self.aot_fallbacks += 1
                     with _LOCK:
-                        self._aot.pop(_routing_key(sig), None)
+                        self._aot.pop(key, None)
+                        self._aot_flops.pop(key, None)
                     _logger.warning(
                         "[compile] AOT executable for '%s' rejected its inputs (%s); "
                         "falling back to JIT for this signature",
@@ -436,9 +453,36 @@ class GuardedFn:
             raise RetraceError(msg)
 
 
+def _cost_flops(exe: Any) -> Optional[float]:
+    """Model FLOPs from a compiled executable's own cost model, or None where
+    the backend reports none. Never raises: FLOPs accounting is telemetry and
+    must not take down a compile that otherwise succeeded."""
+    try:
+        cost = exe.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    try:
+        flops = float(cost.get("flops", 0.0))
+    except (AttributeError, TypeError, ValueError):
+        return None
+    return flops if flops > 0 else None
+
+
 def guarded_jit(fun: Callable, name: Optional[str] = None, **jit_kwargs: Any) -> GuardedFn:
     """Drop-in ``jax.jit`` replacement returning a :class:`GuardedFn`."""
     return GuardedFn(fun, name=name, **jit_kwargs)
+
+
+def step_flops(name: str) -> Optional[float]:
+    """Per-call FLOPs of the newest AOT executable warmed for ``name``
+    (cost_analysis at compile time), or None when it never AOT-compiled —
+    the lookup Time/mfu rows are computed from."""
+    gfn = find(name)
+    return gfn.last_step_flops if gfn is not None else None
 
 
 def find(name: str) -> Optional[GuardedFn]:
@@ -463,6 +507,7 @@ def process_stats() -> Dict[str, Any]:
         "aot_compiles": 0,
         "aot_fallbacks": 0,
         "compile_seconds": 0.0,
+        "flops_dispatched": 0.0,
     }
     per_fn = {}
     for gfn in fns:

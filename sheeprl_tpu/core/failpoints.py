@@ -96,6 +96,9 @@ class _Spec:
     hits: int = 0
     fires: int = 0
     extras: Dict[str, str] = field(default_factory=dict)
+    # telemetry trace id active at the most recent hit ("" while tracing is
+    # off): ties a drill's injected fault to the exact trace that tripped it
+    last_trace_id: str = ""
 
 
 # None <=> disabled: failpoint() must do NOTHING beyond this identity check.
@@ -117,10 +120,30 @@ def _fire(name: str, ctx: Dict[str, Any]) -> Any:
         if spec is None:
             return None
         spec.hits += 1
-        if not _should_trigger(spec):
-            return None
-        spec.fires += 1
+        spec.last_trace_id = _trace_id()
+        triggered = _should_trigger(spec)
+        if triggered:
+            spec.fires += 1
+    if not triggered:
+        return None
+    # a fired failpoint is an event worth correlating: mark it in the active
+    # trace BEFORE the action runs (kill/raise actions never return here)
+    try:
+        from sheeprl_tpu.telemetry import trace as _trace
+
+        _trace.instant(f"failpoint/{name}", action=spec.action, hit=spec.hits)
+    except Exception:
+        pass
     return _run_action(spec, ctx)
+
+
+def _trace_id() -> str:
+    try:
+        from sheeprl_tpu.telemetry import trace as _trace
+
+        return _trace.current_trace_id()
+    except Exception:
+        return ""
 
 
 def _should_trigger(spec: _Spec) -> bool:
@@ -293,11 +316,15 @@ def has(name: str) -> bool:
     return a is not None and name in a
 
 
-def counts() -> Dict[str, Dict[str, int]]:
-    """Per-failpoint ``{"hits": .., "fires": ..}`` — for drill assertions."""
+def counts() -> Dict[str, Dict[str, Any]]:
+    """Per-failpoint ``{"hits": .., "fires": .., "last_trace_id": ..}`` — for
+    drill assertions and fault<->trace correlation."""
     with _lock:
         a = _active or {}
-        return {name: {"hits": s.hits, "fires": s.fires} for name, s in a.items()}
+        return {
+            name: {"hits": s.hits, "fires": s.fires, "last_trace_id": s.last_trace_id}
+            for name, s in a.items()
+        }
 
 
 class active:

@@ -166,6 +166,36 @@ EVENTS_FILENAME = "events.jsonl"
 DIVERGENCE_EVENT_KINDS = ("warn", "backoff", "rollback_requested", "rollback")
 
 
+def append_event(events_dir: Optional[str], kind: str, step: int, **fields: Any) -> None:
+    """Append one row to ``<events_dir>/events.jsonl`` (best-effort, whole-line
+    atomic under POSIX append semantics).
+
+    The shared write path for every plane's operational events — the sentinel's
+    ladder actions, the serve reloader's canary incidents — so they all land in
+    the same stream :func:`read_events` tails. Each row is stamped with the
+    active telemetry ``trace_id`` (when tracing is enabled), making an event
+    joinable with the Perfetto export and the Prometheus surface that share it.
+    """
+    if events_dir is None:
+        return
+    row: Dict[str, Any] = {"event": kind, "step": int(step), "time": time.time()}
+    try:
+        from sheeprl_tpu.telemetry import trace as _trace
+
+        tid = _trace.current_trace_id()
+        if tid:
+            row["trace_id"] = tid
+    except Exception:
+        pass
+    row.update(fields)
+    try:
+        os.makedirs(events_dir, exist_ok=True)
+        with open(os.path.join(events_dir, EVENTS_FILENAME), "a") as f:
+            f.write(json.dumps(row) + "\n")
+    except OSError:
+        pass
+
+
 def read_events(path: str, offset: int = 0) -> Tuple[List[Dict[str, Any]], int]:
     """Incrementally parse a sentinel ``events.jsonl``; returns
     ``(new_events, new_offset)``.
@@ -521,17 +551,7 @@ class HealthSentinel:
     # -- events --------------------------------------------------------------
 
     def _event(self, kind: str, step: int, **fields: Any) -> None:
-        if self._log_dir is None:
-            return
-        try:
-            os.makedirs(self._log_dir, exist_ok=True)
-            with open(os.path.join(self._log_dir, "events.jsonl"), "a") as f:
-                f.write(
-                    json.dumps({"event": kind, "step": int(step), "time": time.time(), **fields})
-                    + "\n"
-                )
-        except OSError:
-            pass
+        append_event(self._log_dir, kind, step, **fields)
 
     # -- observation ---------------------------------------------------------
 

@@ -47,6 +47,7 @@ from sheeprl_tpu.orchestrate.lineage import LineageLog
 from sheeprl_tpu.orchestrate.resow import certified_fitness, perturb, select_parent
 from sheeprl_tpu.orchestrate.scheduler import SlotScheduler
 from sheeprl_tpu.orchestrate.trial import Trial, TrialSpec
+from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.checkpoint import ckpt_sort_key
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -235,6 +236,9 @@ class PopulationController:
                 {"trial": trial.key, "wall_s": round(now - self._diverged_at.pop(trial.key), 3)}
             )
         trial.to(T.RUNNING, pid=proc.pid, run_name=run_name, kind=kind)
+        trace.instant(
+            "orchestrate/spawn", trial=trial.key, gen=trial.generation, kind=kind, pid=proc.pid
+        )
         self.lineage.record(
             kind,
             trial.key,
@@ -288,6 +292,7 @@ class PopulationController:
             trial.resume_ckpt = _newest_ckpt(self.trial_dir(key))
             state = self.scheduler.requeue_failed(trial, f"rc={rc}", now)
             self._log(f"exit {key}: failed (rc={rc}) -> {state}")
+        trace.instant("orchestrate/exit", trial=key, rc=rc, state=str(trial.state))
         self._save()
 
     def _poll_exits(self, now: float) -> None:

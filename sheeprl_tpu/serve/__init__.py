@@ -30,7 +30,7 @@ from __future__ import annotations
 from typing import Any, Dict
 
 _DEFAULTS: Dict[str, Dict[str, Any]] = {
-    "server": {"host": "127.0.0.1", "port": 0, "ready_file": None},
+    "server": {"host": "127.0.0.1", "port": 0, "ready_file": None, "latency_window": 4096},
     "policy": {"greedy": True},
     "batch": {"max_size": 16, "max_wait_ms": 5.0},
     "queue": {

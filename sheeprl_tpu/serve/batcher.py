@@ -31,6 +31,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional
 
 from sheeprl_tpu.core.compile import pow2_bucket
 from sheeprl_tpu.serve.stats import ServeStats
+from sheeprl_tpu.telemetry import trace
 
 # terminal status -> Serve/* counter
 _STATUS_COUNTER = {
@@ -43,7 +44,7 @@ _STATUS_COUNTER = {
 
 
 class PendingRequest:
-    __slots__ = ("rid", "obs", "future", "enqueued_at", "deadline_at")
+    __slots__ = ("rid", "obs", "future", "enqueued_at", "deadline_at", "span_id", "batched_at")
 
     def __init__(self, rid: Any, obs: Any, deadline_s: Optional[float]):
         self.rid = rid
@@ -51,6 +52,12 @@ class PendingRequest:
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
         self.deadline_at = None if deadline_s is None else self.enqueued_at + deadline_s
+        # telemetry: the request span's id is allocated at ADMIT so the
+        # queue-wait child recorded at batch-assembly time can point at its
+        # parent before the parent closes ("" while tracing is disabled —
+        # new_span_id is one identity check on the disabled fast path)
+        self.span_id = trace.new_span_id()
+        self.batched_at: Optional[float] = None
 
 
 class MicroBatcher:
@@ -171,6 +178,7 @@ class MicroBatcher:
         now = time.monotonic()
         live: List[PendingRequest] = []
         for r in batch:
+            r.batched_at = now
             if r.deadline_at is not None and now > r.deadline_at:
                 self._finish(r, "deadline_expired")
             else:
@@ -179,7 +187,8 @@ class MicroBatcher:
             return
         self.stats.observe_batch(len(live), min(pow2_bucket(len(live)), self.max_batch))
         try:
-            results = self._compute(live)
+            with trace.span("serve/infer", plane="serve", batch=len(live)):
+                results = self._compute(live)
         except Exception as e:  # device/engine failure: fail the batch, not the server
             err = f"{type(e).__name__}: {e}"
             for r in live:
@@ -193,6 +202,26 @@ class MicroBatcher:
     # ----- terminal resolution ----------------------------------------------------
     def _finish(self, req: PendingRequest, status: str, **extra: Any) -> None:
         self.stats.inc(_STATUS_COUNTER[status])
+        if req.span_id:  # tracing was enabled at admit: close the lifecycle spans
+            done = time.monotonic()
+            if req.batched_at is not None:
+                # admit -> batch assembly, as a child of the request span
+                trace.add_span(
+                    "serve/queue_wait",
+                    req.enqueued_at,
+                    req.batched_at,
+                    plane="serve",
+                    parent_id=req.span_id,
+                )
+            trace.add_span(
+                "serve/request",
+                req.enqueued_at,
+                done,
+                plane="serve",
+                span_id=req.span_id,
+                status=status,
+                rid=str(req.rid),
+            )
         payload = {"id": req.rid, "status": status}
         payload.update(extra)
         if not req.future.set_running_or_notify_cancel():

@@ -20,12 +20,15 @@ generation and says so in its health surface.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
 from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.core.health import append_event
 from sheeprl_tpu.serve.engine import PolicyEngine, GenerationStore
 from sheeprl_tpu.serve.stats import ServeStats
+from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.checkpoint import certified_info, latest_certified, load_state
 
 _logger = logging.getLogger(__name__)
@@ -52,6 +55,11 @@ class HotReloader(threading.Thread):
         self.canary = bool(canary)
         self.degraded_after = int(degraded_after)
         self.consecutive_failures = 0
+        # reload incidents (swap, canary rollback) land in the run's shared
+        # operational event stream — the same health/events.jsonl the train
+        # sentinel writes, trace-id-stamped by append_event, so a canary
+        # failure is joinable with the serve trace that tripped it
+        self.events_dir = os.path.join(os.path.dirname(os.path.abspath(ckpt_dir)), "health")
         self._stop = threading.Event()
         # identity of the artifact the CURRENT generation came from: path alone
         # is not enough (the trainer may legitimately re-certify new bytes
@@ -83,32 +91,46 @@ class HotReloader(threading.Thread):
         if (path, info.get("crc32")) == self._loaded:
             return None
         cur = self.store.get()
-        try:
-            state = load_state(path, fallback_to_older=False)
-            gen = self.engine.make_generation(state, (cur.gen_id if cur else 0) + 1, path, info)
-            self.engine.warm_sync()  # no-op unless a bucket lost its executable
-        except Exception as e:
-            self._record_failure(path, e)
-            return None
-        prev = self.store.swap(gen)
-        if self.canary:
+        with trace.span("serve/reload", plane="serve", path=path) as sp:
             try:
-                # Drill site: `reload.canary:raise` exercises the full
-                # swap -> canary-fail -> rollback path on a healthy artifact.
-                failpoints.failpoint("reload.canary", path=path, gen_id=gen.gen_id)
-                self.engine.canary(gen.params)
+                state = load_state(path, fallback_to_older=False)
+                gen = self.engine.make_generation(state, (cur.gen_id if cur else 0) + 1, path, info)
+                self.engine.warm_sync()  # no-op unless a bucket lost its executable
             except Exception as e:
-                # post-swap canary failed: put the last-known-good generation
-                # back before anything beyond the canary touched the new one
-                self.store.swap(prev)
-                self.stats.inc("reload_rollbacks")
                 self._record_failure(path, e)
                 return None
-        self._loaded = (path, info.get("crc32"))
-        self.consecutive_failures = 0
-        self.stats.inc("reload_generations")
-        self.stats.set_gauge("generation", gen.gen_id)
-        self.stats.set_gauge("degraded", 0)
+            prev = self.store.swap(gen)
+            if self.canary:
+                try:
+                    # Drill site: `reload.canary:raise` exercises the full
+                    # swap -> canary-fail -> rollback path on a healthy artifact.
+                    failpoints.failpoint("reload.canary", path=path, gen_id=gen.gen_id)
+                    self.engine.canary(gen.params)
+                except Exception as e:
+                    # post-swap canary failed: put the last-known-good generation
+                    # back before anything beyond the canary touched the new one
+                    self.store.swap(prev)
+                    self.stats.inc("reload_rollbacks")
+                    sp.set(rollback=True)
+                    append_event(
+                        self.events_dir,
+                        "serve_reload_rollback",
+                        int(gen.step or 0),
+                        path=path,
+                        gen_id=gen.gen_id,
+                        error=f"{type(e).__name__}: {e}",
+                    )
+                    self._record_failure(path, e)
+                    return None
+            self._loaded = (path, info.get("crc32"))
+            self.consecutive_failures = 0
+            self.stats.inc("reload_generations")
+            self.stats.set_gauge("generation", gen.gen_id)
+            self.stats.set_gauge("degraded", 0)
+            sp.set(gen_id=gen.gen_id)
+        append_event(
+            self.events_dir, "serve_reload", int(gen.step or 0), path=path, gen_id=gen.gen_id
+        )
         _logger.info(
             "[serve] hot-reloaded generation %d from %s (step=%s)", gen.gen_id, path, gen.step
         )

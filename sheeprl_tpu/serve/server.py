@@ -9,6 +9,12 @@ request ``id`` and may arrive out of order on a pipelined connection):
   ``reason: draining``), ``shed``, ``deadline_expired``, ``error``.
 - ``{"op": "stats"}`` -> the ``Serve/*`` snapshot (plus compile totals).
 - ``{"op": "health"}`` -> ``{"ready", "live", "degraded", "draining", "gen"}``.
+- ``{"op": "metrics"}`` -> the whole metrics fabric as a Prometheus
+  text-exposition body (``{"status": "ok", "text": ...}``) — scrape it off
+  the same socket, no second listener.
+- ``{"op": "profile", "action": "start|stop|toggle"}`` -> toggle an
+  on-demand ``jax.profiler`` capture window on the live server
+  (:mod:`sheeprl_tpu.telemetry.device`).
 
 Shutdown contract (the chaos drill's core assertion): on SIGTERM the server
 stops ADMITTING (new requests get ``rejected/draining`` — still a response),
@@ -32,6 +38,10 @@ from sheeprl_tpu.serve.batcher import MicroBatcher
 from sheeprl_tpu.serve.engine import GenerationStore, PolicyEngine
 from sheeprl_tpu.serve.reload import HotReloader
 from sheeprl_tpu.serve.stats import ServeStats
+from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import export as tel_export
+from sheeprl_tpu.telemetry import registry as tel_registry
+from sheeprl_tpu.telemetry import trace
 
 _logger = logging.getLogger(__name__)
 
@@ -70,6 +80,10 @@ class _Handler(socketserver.StreamRequestHandler):
                 send(server.stats_payload())
             elif op == "health":
                 send(server.health_payload())
+            elif op == "metrics":
+                send(server.metrics_payload())
+            elif op == "profile":
+                send(server.profile_payload(msg))
             elif op == "infer":
                 server.handle_infer(msg, send)
             else:
@@ -92,7 +106,7 @@ class PolicyServer:
         boot_info: Optional[Dict[str, Any]] = None,
     ):
         self.sv = resolve(cfg)
-        self.stats = ServeStats()
+        self.stats = ServeStats(latency_window=int(self.sv.server.latency_window))
         self.engine = PolicyEngine(cfg, state, source=source, boot_info=boot_info)
         self.store = GenerationStore(self.engine.boot_generation)
         self.stats.set_gauge("generation", self.store.gen_id)
@@ -122,6 +136,17 @@ class PolicyServer:
         self._tcp_thread: Optional[threading.Thread] = None
         self.host = str(self.sv.server.host)
         self.port = int(self.sv.server.port)
+        # telemetry artifacts land beside the run's other outputs (the ckpt
+        # dir's parent is the run dir when serving a recorded run; cwd-local
+        # dirs otherwise)
+        run_dir = os.path.dirname(os.path.abspath(ckpt_dir)) if ckpt_dir else os.getcwd()
+        self.telemetry_dir = os.path.join(run_dir, "telemetry")
+        self.profile_dir = os.path.join(self.telemetry_dir, "profiler")
+        # plug this server's counters into the process-wide metrics fabric:
+        # the `metrics` op (and any JsonlSink) sees Serve/Compile/Telemetry/
+        # Device series in one snapshot
+        tel_registry.register_default_providers()
+        tel_registry.register("serve", self.stats.snapshot)
 
     # ----- lifecycle ------------------------------------------------------------------
     def start(self) -> "PolicyServer":
@@ -174,9 +199,19 @@ class PolicyServer:
             self._tcp.shutdown()
             self._tcp.server_close()
         self.batcher.close()
+        tel_device.stop_capture()  # never leak an open profiler window across exit
+        trace_path = None
+        if trace.enabled():
+            try:
+                trace_path = trace.export(os.path.join(self.telemetry_dir, "trace.json"))
+            except OSError:
+                _logger.exception("[serve] trace export failed")
         if stats_file:
             payload = self.stats_payload()
             payload["drained"] = drained
+            if trace_path:
+                payload["trace_path"] = trace_path
+                payload["trace_id"] = trace.current_trace_id()
             tmp = f"{stats_file}.tmp"
             with open(tmp, "w") as f:
                 json.dump(payload, f, indent=2)
@@ -216,6 +251,37 @@ class PolicyServer:
         payload["Compile/retraces"] = compile_totals["retraces"]
         payload["Compile/aot_compiles"] = compile_totals["aot_compiles"]
         return payload
+
+    def metrics_payload(self) -> Dict[str, Any]:
+        """The whole metrics fabric as Prometheus text (the ``metrics`` op)."""
+        try:
+            text = tel_export.to_prometheus()
+        except Exception as e:  # the fabric must not crash the frontend
+            return {"status": "error", "error": f"{type(e).__name__}: {e}"}
+        return {
+            "status": "ok",
+            "content_type": "text/plain; version=0.0.4",
+            "trace_id": trace.current_trace_id(),
+            "text": text,
+        }
+
+    def profile_payload(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """On-demand jax.profiler window (the ``profile`` op): ``action`` in
+        start | stop | toggle; ``dir`` overrides the capture directory."""
+        action = str(msg.get("action", "toggle"))
+        cap_dir = str(msg.get("dir") or self.profile_dir)
+        try:
+            if action == "start":
+                state = "started" if tel_device.start_capture(cap_dir) else "busy"
+            elif action == "stop":
+                state = "stopped" if tel_device.stop_capture() else "idle"
+            elif action == "toggle":
+                state = tel_device.toggle_capture(cap_dir)
+            else:
+                return {"status": "error", "error": f"unknown profile action '{action}'"}
+        except Exception as e:
+            return {"status": "error", "error": f"{type(e).__name__}: {e}"}
+        return {"status": "ok", "profile": state, "dir": cap_dir}
 
     def health_payload(self) -> Dict[str, Any]:
         snap = self.stats.snapshot()

@@ -36,8 +36,17 @@ class ServeStats:
         self._counts: Dict[str, int] = {k: 0 for k in COUNTERS}
         self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}
         # windowed reservoir: p50/p99 over the LAST N served requests, not the
-        # lifetime mean — load tests care about current-tail behaviour
-        self._latencies: Deque[float] = deque(maxlen=int(latency_window))
+        # lifetime mean — load tests care about current-tail behaviour. The
+        # maxlen bound is what keeps a long-running server's memory flat; the
+        # window size/cap are exposed as gauges so operators can see how much
+        # history the percentiles actually cover.
+        self._latency_cap = max(int(latency_window), 1)
+        self._latencies: Deque[float] = deque(maxlen=self._latency_cap)
+        # snapshot() used to re-sort the full window on EVERY stats op; cache
+        # the sorted view and only re-sort when new observations arrived, so a
+        # tight health/stats polling loop against an idle server costs O(1)
+        self._lat_sorted: list = []
+        self._lat_dirty = False
         self._occupancy_sum = 0.0
         self._occupancy_n = 0
 
@@ -64,6 +73,7 @@ class ServeStats:
     def observe_latency(self, seconds: float) -> None:
         with self._lock:
             self._latencies.append(float(seconds))
+            self._lat_dirty = True
 
     @staticmethod
     def _percentile(sorted_vals, q: float) -> float:
@@ -77,11 +87,16 @@ class ServeStats:
         with self._lock:
             counts = dict(self._counts)
             gauges = dict(self._gauges)
-            lat = sorted(self._latencies)
+            if self._lat_dirty:
+                self._lat_sorted = sorted(self._latencies)
+                self._lat_dirty = False
+            lat = self._lat_sorted
             occ = self._occupancy_sum / self._occupancy_n if self._occupancy_n else 0.0
         out: Dict[str, Any] = {f"Serve/{k}": v for k, v in counts.items()}
         out.update({f"Serve/{k}": v for k, v in gauges.items()})
         out["Serve/batch_occupancy"] = occ
         out["Serve/latency_p50_ms"] = self._percentile(lat, 0.50) * 1000.0
         out["Serve/latency_p99_ms"] = self._percentile(lat, 0.99) * 1000.0
+        out["Serve/latency_window_size"] = len(lat)
+        out["Serve/latency_window_cap"] = self._latency_cap
         return out

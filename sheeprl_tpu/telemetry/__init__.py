@@ -1,0 +1,31 @@
+"""Unified cross-plane telemetry: spans, device introspection, metrics fabric.
+
+The instrumentation layer the measurement-gated roadmap items stand on. Three
+sub-modules, one trace id:
+
+- :mod:`sheeprl_tpu.telemetry.trace` — ring-buffered structured spans with
+  trace/span ids, zero-cost when disabled (the ``failpoints`` guard pattern),
+  exported as Chrome trace-event / Perfetto JSON. Spans wrap train-iteration
+  phases (collect / update / metric-drain / checkpoint), the serve request
+  lifecycle (admit -> queue-wait -> infer -> respond), and orchestrate trial
+  transitions; the trace id is stamped into ``health/events.jsonl`` rows,
+  failpoint hit records, and certified-checkpoint sidecars.
+- :mod:`sheeprl_tpu.telemetry.device` — per-device HBM gauges, on-demand
+  ``jax.profiler`` capture windows (signal- or serve-op-triggered, leak-proof
+  via a context manager), and MFU computed from the FLOPs
+  ``core/compile.py`` captures off ``lowered.compile().cost_analysis()``.
+- :mod:`sheeprl_tpu.telemetry.registry` + :mod:`sheeprl_tpu.telemetry.export`
+  — one process-wide provider registry the existing Serve / Health / Compile
+  / Resilience counters plug into, rendered as a Prometheus text-exposition
+  op on the serve frontend or a periodic JSONL sink for headless runs.
+
+Enable spans with ``SHEEPRL_TPU_TRACE=1`` (inherited by subprocesses) or
+``metric.telemetry.enabled=True`` through any CLI entry point. See
+``howto/observability.md``.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.telemetry import device, export, registry, trace
+
+__all__ = ["trace", "device", "registry", "export"]

@@ -1,0 +1,218 @@
+"""Device introspection: HBM gauges, on-demand profiler windows, MFU.
+
+Three capabilities, all safe on CPU-only hosts (everything degrades to
+"report nothing" rather than crash — observability must never take down the
+run it observes):
+
+- :func:`hbm_gauges` — per-device memory gauges from ``device.memory_stats()``
+  (``Device/<i>/hbm_in_use_bytes`` etc. plus cross-device maxima), registered
+  into the metrics fabric by
+  :func:`sheeprl_tpu.telemetry.registry.register_default_providers`. CPU
+  devices expose no memory stats; the provider then reports only the device
+  count.
+
+- On-demand ``jax.profiler`` capture windows: :func:`start_capture` /
+  :func:`stop_capture` (idempotent, lock-guarded — jax allows ONE active
+  trace per process) plus the :class:`CaptureWindow` context manager whose
+  ``finally`` guarantees the trace is closed on exception paths.
+  :func:`install_signal_trigger` arms SIGUSR2 (by default) to toggle a
+  capture on a live process — the "why is iteration 40k slow" tool that
+  needs no restart. The serve frontend's ``{"op": "profile"}`` uses the same
+  start/stop pair.
+
+- MFU arithmetic: :func:`chip_peak_flops` (bf16 peak per chip from public
+  spec sheets, keyed on ``device_kind`` substrings) and :func:`mfu`, fed by
+  the exact per-executable FLOPs that ``core/compile.py`` records from
+  ``lowered.compile().cost_analysis()`` at AOT-warm time — Time/mfu is
+  computed from the compiler's own cost model, never hand-derived.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal as _signal_mod
+import threading
+from typing import Any, Dict, Optional
+
+_logger = logging.getLogger(__name__)
+
+# bf16 peak FLOP/s per chip by device_kind substring (public spec sheets).
+# Single source of truth — bench.py and the fabric both read this table.
+PEAK_BF16_FLOPS = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+# memory_stats() key -> our gauge suffix (only the ones every backend that has
+# memory_stats at all agrees on; extras are ignored)
+_MEM_KEYS = {
+    "bytes_in_use": "hbm_in_use_bytes",
+    "peak_bytes_in_use": "hbm_peak_bytes",
+    "bytes_limit": "hbm_limit_bytes",
+}
+
+
+def chip_peak_flops(device: Any) -> Optional[float]:
+    """bf16 peak FLOP/s for a jax device, or None for unknown chips (report
+    MFU as null rather than fabricate one)."""
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def mfu(step_flops: Optional[float], sec_per_step: float, device: Any = None) -> Optional[float]:
+    """Model-FLOPs utilization of one device for a step of ``step_flops``
+    taking ``sec_per_step``; None when either the FLOPs or the chip's peak is
+    unknown."""
+    if not step_flops or sec_per_step <= 0:
+        return None
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:
+            return None
+    peak = chip_peak_flops(device)
+    if not peak:
+        return None
+    return float(step_flops) / sec_per_step / peak
+
+
+def hbm_gauges() -> Dict[str, float]:
+    """Per-device memory gauges (empty-ish on backends without memory_stats)."""
+    try:
+        import jax
+
+        devices = jax.devices()
+    except Exception:
+        return {}
+    out: Dict[str, float] = {"Device/count": float(len(devices))}
+    in_use_max = peak_max = 0.0
+    have_any = False
+    for i, d in enumerate(devices):
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        have_any = True
+        for src, suffix in _MEM_KEYS.items():
+            if src in stats:
+                out[f"Device/{i}/{suffix}"] = float(stats[src])
+        in_use_max = max(in_use_max, float(stats.get("bytes_in_use", 0)))
+        peak_max = max(peak_max, float(stats.get("peak_bytes_in_use", 0)))
+    if have_any:
+        out["Device/hbm_in_use_bytes_max"] = in_use_max
+        out["Device/hbm_peak_bytes_max"] = peak_max
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# on-demand jax.profiler capture windows
+# --------------------------------------------------------------------------- #
+
+_capture_lock = threading.Lock()
+_capture_dir: Optional[str] = None  # non-None <=> a trace is open
+
+
+def capture_active() -> bool:
+    return _capture_dir is not None
+
+
+def start_capture(trace_dir: str) -> bool:
+    """Open a jax.profiler trace into ``trace_dir``. False (not an error) if a
+    capture is already running — jax supports one trace per process, and a
+    second signal/op racing the first should not crash the run."""
+    global _capture_dir
+    with _capture_lock:
+        if _capture_dir is not None:
+            return False
+        import jax
+
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        _capture_dir = trace_dir
+        _logger.info("[telemetry] profiler capture started -> %s", trace_dir)
+        return True
+
+
+def stop_capture() -> Optional[str]:
+    """Close the open trace; returns its directory, or None if none was open.
+    Never raises on a half-open trace (shutdown paths call this blindly)."""
+    global _capture_dir
+    with _capture_lock:
+        if _capture_dir is None:
+            return None
+        d = _capture_dir
+        _capture_dir = None
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            _logger.exception("[telemetry] profiler stop_trace failed")
+            return None
+        _logger.info("[telemetry] profiler capture stopped (%s)", d)
+        return d
+
+
+class CaptureWindow:
+    """``with CaptureWindow(dir):`` — a profiler window that cannot leak an
+    open trace: stop runs in ``__exit__`` whatever the body raised. Shared by
+    :class:`sheeprl_tpu.utils.profiler.TraceProfiler` and the on-demand
+    triggers."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self.started = False
+
+    def __enter__(self) -> "CaptureWindow":
+        self.started = start_capture(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if self.started:
+            stop_capture()
+        return False
+
+
+def toggle_capture(trace_dir: str) -> str:
+    """Start if idle, stop if running — the single-button form the signal
+    trigger and the serve ``profile`` op share. Returns ``"started"``,
+    ``"stopped"`` or ``"busy"`` (another directory's capture is open)."""
+    if _capture_dir is None:
+        return "started" if start_capture(trace_dir) else "busy"
+    return "stopped" if stop_capture() else "busy"
+
+
+def install_signal_trigger(trace_dir: str, signum: int = getattr(_signal_mod, "SIGUSR2", 12)) -> bool:
+    """Arm ``signum`` (default SIGUSR2) to toggle a profiler capture into
+    ``trace_dir`` on a live process. Main-thread only (CPython restricts
+    signal.signal); returns False where that does not hold."""
+    if threading.current_thread() is not threading.main_thread():
+        return False
+
+    def _on_signal(_signum: int, _frame: Any) -> None:
+        # toggle from a thread: profiler start can compile/IO — never block
+        # the main loop inside a signal handler
+        threading.Thread(
+            target=toggle_capture, args=(trace_dir,), name="sheeprl-profile-toggle", daemon=True
+        ).start()
+
+    try:
+        _signal_mod.signal(signum, _on_signal)
+        return True
+    except (ValueError, OSError):
+        return False

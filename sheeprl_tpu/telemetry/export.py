@@ -1,0 +1,135 @@
+"""Exposition formats for the metrics fabric: Prometheus text + JSONL sink.
+
+Two consumers of :func:`sheeprl_tpu.telemetry.registry.collect`:
+
+- :func:`to_prometheus` renders a Prometheus text-exposition (version 0.0.4)
+  body. The serve TCP frontend answers ``{"op": "metrics"}`` with it, so a
+  scraper (or ``curl``-over-netcat) gets fleet metrics without a second
+  listener. Metric names are sanitized from the repo's ``Plane/name`` keys
+  (``Serve/latency_p50_ms`` -> ``sheeprl_serve_latency_p50_ms``) and an
+  info-style series ``sheeprl_run_info{trace_id="..."} 1`` carries the trace
+  id so scraped series are joinable with Perfetto exports and
+  ``health/events.jsonl`` rows.
+
+- :class:`JsonlSink` appends one timestamped JSON line of the full snapshot
+  every ``interval_s`` from a daemon thread — the headless-run story (no
+  scraper on a TPU pod slice; the lines land next to the run's other
+  artifacts and are greppable/plottable after the fact).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, Mapping, Optional
+
+from sheeprl_tpu.telemetry import registry, trace
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_PREFIX = "sheeprl"
+
+
+def sanitize_name(key: str) -> str:
+    """``Serve/latency_p50_ms`` -> ``sheeprl_serve_latency_p50_ms``."""
+    name = _NAME_RE.sub("_", key.strip().replace("/", "_")).strip("_").lower()
+    return f"{_PREFIX}_{name}"
+
+
+def to_prometheus(
+    metrics: Optional[Mapping[str, Any]] = None,
+    extra_labels: Optional[Mapping[str, str]] = None,
+) -> str:
+    """Prometheus text-exposition body for ``metrics`` (default: a fresh
+    :func:`registry.collect` snapshot). Non-numeric values are skipped —
+    Prometheus series are numbers; strings belong in the info series."""
+    if metrics is None:
+        metrics = registry.collect()
+    lines = []
+    labels = {"trace_id": trace.current_trace_id()}
+    if extra_labels:
+        labels.update({str(k): str(v) for k, v in extra_labels.items()})
+    label_body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()) if v)
+    lines.append(f"# TYPE {_PREFIX}_run_info gauge")
+    lines.append(f"{_PREFIX}_run_info{{{label_body}}} 1")
+    for key in sorted(metrics):
+        val = metrics[key]
+        if isinstance(val, bool):
+            val = int(val)
+        if not isinstance(val, (int, float)):
+            continue
+        name = sanitize_name(key)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(val):g}")
+    return "\n".join(lines) + "\n"
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class JsonlSink:
+    """Periodic JSONL dump of the registry snapshot for headless runs.
+
+    Context manager; :meth:`flush` is also callable directly (the train loop
+    flushes once at shutdown so short runs still leave a snapshot). Writes are
+    append-only single lines — crash-safe by construction."""
+
+    def __init__(self, path: str, interval_s: float = 30.0):
+        self.path = path
+        self.interval_s = float(interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.lines_written = 0
+
+    def flush(self) -> None:
+        row = {
+            "time": time.time(),
+            "trace_id": trace.current_trace_id(),
+            "metrics": _jsonable(registry.collect()),
+        }
+        try:
+            os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+            with open(self.path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+            self.lines_written += 1
+        except OSError:
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+    def start(self) -> "JsonlSink":
+        self._thread = threading.Thread(target=self._loop, name="sheeprl-metrics-sink", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_flush:
+            self.flush()
+
+    def __enter__(self) -> "JsonlSink":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def _jsonable(metrics: Mapping[str, Any]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for k, v in metrics.items():
+        if isinstance(v, (bool, int, float, str)) or v is None:
+            out[k] = v
+        else:
+            try:
+                out[k] = float(v)
+            except (TypeError, ValueError):
+                out[k] = str(v)
+    return out

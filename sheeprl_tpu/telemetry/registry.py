@@ -1,0 +1,94 @@
+"""One process-wide metrics registry: every plane's counters, one read path.
+
+The repo grew four independent metric surfaces (``utils/metric.py``
+aggregators, ``serve/stats.py`` ``Serve/*`` counters, ``core/health.py``
+``Health/*`` counters, ``core/compile.py`` ``Compile/*`` totals) plus
+resilience and telemetry counters. This module does NOT replace any of them —
+each plane keeps its own write path and locking — it gives them one *read*
+fabric: a provider is a zero-argument callable returning a flat
+``{"Plane/name": value}`` mapping, registered once at subsystem boot, and
+:func:`collect` merges every provider's current snapshot on demand.
+
+Consumers: the serve frontend's ``metrics`` op
+(:func:`sheeprl_tpu.telemetry.export.to_prometheus`) and the headless
+:class:`~sheeprl_tpu.telemetry.export.JsonlSink`.
+
+A crashing provider never takes down the fabric: its error is folded into the
+snapshot as ``Telemetry/provider_errors`` and the remaining providers still
+report (an observability layer that can crash the thing it observes is worse
+than none).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Mapping, Tuple
+
+Provider = Callable[[], Mapping[str, Any]]
+
+_providers: Dict[str, Provider] = {}
+_lock = threading.Lock()
+
+
+def register(name: str, provider: Provider) -> None:
+    """Register (or replace) the named provider. Re-registration is the normal
+    lifecycle: a fresh ``PolicyServer`` or train loop installs its own stats
+    object under the same name, superseding a previous run's."""
+    with _lock:
+        _providers[name] = provider
+
+
+def unregister(name: str) -> None:
+    with _lock:
+        _providers.pop(name, None)
+
+
+def providers() -> Tuple[str, ...]:
+    with _lock:
+        return tuple(sorted(_providers))
+
+
+def clear() -> None:
+    """Drop every provider (tests)."""
+    with _lock:
+        _providers.clear()
+
+
+def collect() -> Dict[str, Any]:
+    """Merged snapshot of every provider. Later-registered providers win key
+    collisions (deterministic: providers iterate in sorted-name order)."""
+    with _lock:
+        items = sorted(_providers.items())
+    out: Dict[str, Any] = {}
+    errors = 0
+    for _name, provider in items:
+        try:
+            snap = provider()
+        except Exception:
+            errors += 1
+            continue
+        if snap:
+            out.update(snap)
+    if errors:
+        out["Telemetry/provider_errors"] = errors
+    return out
+
+
+def register_default_providers() -> None:
+    """Install the cross-cutting process-level providers (compile totals,
+    tracer counters, device memory). Plane-local providers (serve stats,
+    health counters) register themselves where their objects are built."""
+    from sheeprl_tpu.core import compile as jax_compile
+    from sheeprl_tpu.telemetry import device, trace
+
+    def _compile_totals() -> Dict[str, Any]:
+        totals = jax_compile.process_stats()
+        return {
+            f"Compile/{k}": v
+            for k, v in totals.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+
+    register("compile", _compile_totals)
+    register("trace", trace.stats)
+    register("device", device.hbm_gauges)

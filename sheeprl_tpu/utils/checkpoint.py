@@ -249,6 +249,16 @@ def certify(path: str, crc32: Optional[int] = None, size: Optional[int] = None, 
 
     sidecar = certified_sidecar(path)
     payload = {"certified": True, "ckpt": os.path.basename(path), "crc32": crc32, "size": size}
+    try:
+        from sheeprl_tpu.telemetry import trace as _trace
+
+        tid = _trace.current_trace_id()
+        if tid:
+            # joinable with the span/export + events.jsonl surfaces: which run
+            # (and which trace) produced the artifact a reload/rollback used
+            payload["trace_id"] = tid
+    except Exception:
+        pass
     payload.update(extra)
     tmp = sidecar + ".tmp"
     with open(tmp, "w") as f:

@@ -1,4 +1,4 @@
-"""Optional jax.profiler trace capture around training iterations.
+"""Optional jax.profiler trace capture around any plane's hot loop.
 
 The reference has no profiler integration (SURVEY §5: profiling is wall-clock
 timers only); on TPU the XLA trace is the tool that actually explains where
@@ -9,6 +9,16 @@ device time goes, so the TPU build adds it behind ``metric.profiler.*``:
 
 Traces are written to ``<log_dir>/profiler`` and open in TensorBoard's profile
 plugin or Perfetto (trace.json.gz inside the capture directory).
+
+The actual start/stop goes through :mod:`sheeprl_tpu.telemetry.device`
+(``start_capture``/``stop_capture``): one process-wide lock shared with the
+serve frontend's ``{"op": "profile"}`` and the SIGUSR2 trigger, so a step-
+window profile and an on-demand capture can never fight over jax's single
+trace slot. ``close()`` runs from ``__exit__``/``atexit`` whatever the loop
+raised — a dying iteration flushes a partial capture instead of leaking an
+open trace. The window is labelled with its ``plane`` (train by default;
+serve/orchestrate pass theirs) in the span tracer, so the Perfetto timeline
+shows which plane asked for the XLA capture.
 """
 
 from __future__ import annotations
@@ -16,52 +26,76 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import trace
+
 
 class TraceProfiler:
-    """Start/stop a jax.profiler trace across a window of training iterations.
+    """Start/stop a jax.profiler trace across a window of iterations.
 
-    Call :meth:`step` once per iteration with the global policy step; the trace
-    starts when ``policy_step >= start_step`` and stops ``num_iters`` calls
-    later (or at :meth:`close`).
+    Call :meth:`step` once per iteration with the plane's progress counter
+    (the global policy step for train loops); the trace starts when
+    ``counter >= start_step`` and stops ``num_iters`` calls later (or at
+    :meth:`close`). Also usable as a context manager for planes without a
+    natural step counter::
+
+        with TraceProfiler({"enabled": True, "num_iters": 10**9}, log_dir,
+                           plane="orchestrate"):
+            ...
     """
 
-    def __init__(self, cfg_profiler, log_dir: Optional[str]):
+    def __init__(self, cfg_profiler, log_dir: Optional[str], plane: str = "train"):
         cfg_profiler = cfg_profiler or {}
         self._enabled = bool(cfg_profiler.get("enabled", False)) and log_dir is not None
         self._start_step = int(cfg_profiler.get("start_step", 0))
         self._num_iters = int(cfg_profiler.get("num_iters", 5))
         self._trace_dir = os.path.join(log_dir, "profiler") if log_dir else None
+        self.plane = str(plane)
         self._active = False
         self._done = False
         self._iters_left = self._num_iters
         if self._enabled:
-            # flush a partial capture even when the training loop dies mid-window
+            # flush a partial capture even when the loop dies mid-window
             # (close() is idempotent, so the explicit end-of-run call stays cheap)
             import atexit
 
             atexit.register(self.close)
 
-    def step(self, policy_step: int) -> None:
+    def _start(self) -> None:
+        if tel_device.start_capture(self._trace_dir):
+            self._active = True
+            trace.instant("profiler/start", plane_label=self.plane, dir=self._trace_dir)
+        else:
+            # another capture (on-demand op / signal toggle) owns the trace
+            # slot: skip this window rather than corrupt theirs
+            self._done = True
+
+    def _stop(self) -> None:
+        tel_device.stop_capture()
+        self._active = False
+        self._done = True
+        trace.instant("profiler/stop", plane_label=self.plane)
+
+    def step(self, counter: int) -> None:
         if not self._enabled or self._done:
             return
-        import jax
-
         if not self._active:
-            if policy_step >= self._start_step:
-                os.makedirs(self._trace_dir, exist_ok=True)
-                jax.profiler.start_trace(self._trace_dir)
-                self._active = True
+            if counter >= self._start_step:
+                self._start()
             return
         self._iters_left -= 1
         if self._iters_left <= 0:
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self._stop()
+
+    def __enter__(self) -> "TraceProfiler":
+        if self._enabled and not self._done and not self._active:
+            self._start()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def close(self) -> None:
         if self._active:
-            import jax
-
-            jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+            self._stop()

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "against Gymnasium, zero-transfer rollout guarantees, and the smoke drill; "
         "select with `-m ingraph` before touching envs/ingraph or the fused collector",
     )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: cross-plane telemetry (sheeprl_tpu/telemetry/) — span tracer, "
+        "metrics fabric, device introspection, trace-id propagation; select with "
+        "`-m telemetry` before touching telemetry/ or its instrumentation seams",
+    )
 
 
 @pytest.hookimpl(wrapper=True)
@@ -88,6 +94,7 @@ def pytest_runtest_call(item):
 
 @pytest.fixture(autouse=True)
 def _reset_metric_state():
+    from sheeprl_tpu.telemetry import trace
     from sheeprl_tpu.utils.metric import MetricAggregator
     from sheeprl_tpu.utils.timer import timer
 
@@ -95,6 +102,9 @@ def _reset_metric_state():
     MetricAggregator.disabled = False
     timer.disabled = False
     timer.reset()
+    # a test that configured the span tracer must not leak it (or its
+    # SHEEPRL_TPU_TRACE env mirror) into tests asserting disabled-mode behavior
+    trace.disable()
 
 
 @pytest.fixture()

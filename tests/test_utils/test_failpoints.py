@@ -34,7 +34,7 @@ def test_disabled_failpoint_never_touches_the_registry(monkeypatch):
 def test_unmatched_name_is_a_noop_even_when_enabled():
     failpoints.configure("other.name:raise")
     assert failpoints.failpoint("ckpt.finalize") is None
-    assert failpoints.counts()["other.name"] == {"hits": 0, "fires": 0}
+    assert failpoints.counts()["other.name"] == {"hits": 0, "fires": 0, "last_trace_id": ""}
 
 
 # --------------------------------------------------------------------------- #
@@ -58,7 +58,7 @@ def test_spec_grammar_arg_and_trigger_fields_are_order_free():
 def test_hit_trigger_fires_exactly_once_on_the_nth_evaluation():
     failpoints.configure("p:fire:hit=3")
     assert [failpoints.failpoint("p") for _ in range(5)] == [None, None, True, None, None]
-    assert failpoints.counts()["p"] == {"hits": 5, "fires": 1}
+    assert failpoints.counts()["p"] == {"hits": 5, "fires": 1, "last_trace_id": ""}
 
 
 @pytest.mark.faults
