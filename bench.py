@@ -1209,7 +1209,10 @@ if __name__ == "__main__":
                     ),
                     flush=True,
                 )
-                os._exit(3)
+                # rc 0: the "skipped" status row IS the result — a hard rc=3
+                # here turned an environment problem into a bench-step failure
+                # for the whole run (see BENCH_r05.json)
+                os._exit(0)
             print(
                 "WARNING: accelerator unreachable (backend discovery exceeded 180s, "
                 "tunnel/relay down?) — falling back to JAX_PLATFORMS=cpu",

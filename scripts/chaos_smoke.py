@@ -32,6 +32,10 @@ import sys
 import tempfile
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.core import failpoints  # noqa: E402
 
 
 def _find_ckpts(root: str) -> list:
@@ -51,7 +55,9 @@ def main(workdir: str | None = None, timeout: float = 540.0, preempt_at_iter: in
         SHEEPRL_PREEMPTION_READY_FILE=ready_file,
         # self-preemption at a deterministic iteration boundary (the old
         # parent-side SIGTERM raced process startup and iteration timing)
-        SHEEPRL_TPU_FAILPOINTS=f"preempt.iteration:signal:SIGTERM:hit={preempt_at_iter}",
+        SHEEPRL_TPU_FAILPOINTS=failpoints.spec_entry(
+            "preempt.iteration", "signal", "SIGTERM", f"hit={preempt_at_iter}"
+        ),
     )
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO_ROOT, "bench.py"), "--smoke"],

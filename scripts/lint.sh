@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Static analysis over the default tree (sheeprl_tpu/ + scripts/).
+# Exit 0 clean, 1 unsuppressed findings. See howto/static_analysis.md.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec python -m sheeprl_tpu.analysis "$@"

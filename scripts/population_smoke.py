@@ -43,6 +43,10 @@ import tempfile
 import time
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.core import failpoints  # noqa: E402
 
 # Mirror of the proven health_smoke PPO-dummy configuration, shrunk for fleet
 # duty: policy steps == env steps (rollout 4 x 1 sync env), certified
@@ -134,7 +138,9 @@ def _controller(spec_path: str, state_dir: str, inject: int, spacing: float) -> 
         # fire-failpoint triggers on every 10th eligible poll tick (2s of ticks
         # at poll_interval_s=0.2) instead of racing wall-clock spacing against
         # trial startup — same injection schedule on every run and machine.
-        env["SHEEPRL_TPU_FAILPOINTS"] = "orchestrate.inject:fire:every=10"
+        env["SHEEPRL_TPU_FAILPOINTS"] = failpoints.spec_entry(
+            "orchestrate.inject", "fire", trigger="every=10"
+        )
     return subprocess.Popen(
         [
             sys.executable,

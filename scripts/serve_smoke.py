@@ -45,6 +45,8 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+from sheeprl_tpu.core import failpoints  # noqa: E402
+
 # Tiny MLP agent on the dummy discrete env: big enough to exercise the real
 # build_agent/player path, small enough that boot + 3-bucket AOT warmup is
 # seconds on CPU.
@@ -306,7 +308,11 @@ def main(workdir: str | None = None, timeout: float = 420.0) -> dict:
         rf1,
         sf1,
         log1,
-        env_extra={"SHEEPRL_TPU_FAILPOINTS": "reload.canary:raise:injected-canary-drill:hit=1"},
+        env_extra={
+            "SHEEPRL_TPU_FAILPOINTS": failpoints.spec_entry(
+                "reload.canary", "raise", "injected-canary-drill", "hit=1"
+            )
+        },
     )
     holder = {"addr": None}
     try:

@@ -41,6 +41,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+from sheeprl_tpu.core import failpoints  # noqa: E402
 from scripts.serve_smoke import (  # noqa: E402
     _wait_until,
     build_fixture,
@@ -79,7 +80,9 @@ def main(workdir: str | None = None, timeout: float = 300.0) -> dict:
             # the parent-pins-the-id join: the server's tracer must adopt this
             # trace id at import instead of minting its own
             "SHEEPRL_TPU_TRACE": f"plane=serve;trace_id={trace_id}",
-            "SHEEPRL_TPU_FAILPOINTS": "reload.canary:raise:telemetry-drill:hit=1",
+            "SHEEPRL_TPU_FAILPOINTS": failpoints.spec_entry(
+                "reload.canary", "raise", "telemetry-drill", "hit=1"
+            ),
         },
     )
     try:

@@ -47,6 +47,7 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
+from sheeprl_tpu.core import failpoints  # noqa: E402
 from sheeprl_tpu.parallel.control import ControlPlane, SocketKV  # noqa: E402
 
 CHANNEL = "roll"
@@ -162,13 +163,20 @@ def main(total: int = 12, crash_after: int = 4, timeout: float = 300.0) -> dict:
         consumer = _spawn(
             ["--role", "consumer", "--addr", server.address, "--total", str(total)],
             # delayed acks: the writer's ack-poll must tolerate a slow reader
-            "control.kv_set:sleep:0.05:every=5",
+            failpoints.spec_entry("control.kv_set", "sleep", "0.05", "every=5"),
         )
 
         # phase 1: drops + a mid-stream kill after `crash_after` sent chunks
         player1 = _spawn(
             ["--role", "player", "--addr", server.address, "--total", str(total)],
-            f"control.chunk_send:drop:every=3,transport.player_crash:kill:9:hit={crash_after}",
+            ",".join(
+                [
+                    failpoints.spec_entry("control.chunk_send", "drop", trigger="every=3"),
+                    failpoints.spec_entry(
+                        "transport.player_crash", "kill", "9", f"hit={crash_after}"
+                    ),
+                ]
+            ),
         )
         p1_out, p1_err = player1.communicate(timeout=timeout)
         if player1.returncode != 9:

@@ -1,0 +1,34 @@
+"""sheeprl_tpu.analysis — a JAX-invariant static analyzer.
+
+Pure-AST linting for the invariants this codebase's performance and
+correctness rest on: no host syncs inside jit-traced code, split-before-use
+PRNG discipline, donated buffers never read again, no retrace hazards, and no
+drift between string-keyed registries (failpoint names, config keys) and their
+canonical sources. The analyzer never imports the code it checks — no jax, no
+device, <20s on the whole tree — so it runs as a tier-1 test and as
+``python -m sheeprl_tpu.analysis`` (or ``scripts/lint.sh``) locally.
+
+Intentionally-kept findings live in ``baseline.txt`` next to this module, one
+justified suppression per row; see :mod:`sheeprl_tpu.analysis.baseline`.
+"""
+
+from __future__ import annotations
+
+from sheeprl_tpu.analysis import baseline
+from sheeprl_tpu.analysis.callgraph import CallGraph, load_jit_entry_wrappers
+from sheeprl_tpu.analysis.engine import Analyzer, Context, Finding, Module, Rule
+from sheeprl_tpu.analysis.rules import RULES_BY_ID, RULE_CLASSES, default_rules
+
+__all__ = [
+    "Analyzer",
+    "CallGraph",
+    "Context",
+    "Finding",
+    "Module",
+    "Rule",
+    "RULE_CLASSES",
+    "RULES_BY_ID",
+    "baseline",
+    "default_rules",
+    "load_jit_entry_wrappers",
+]

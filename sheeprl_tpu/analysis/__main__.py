@@ -1,0 +1,153 @@
+"""CLI: ``python -m sheeprl_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean (after baseline), 1 unsuppressed findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from sheeprl_tpu.analysis import baseline as baseline_mod
+from sheeprl_tpu.analysis.engine import Analyzer
+from sheeprl_tpu.analysis.rules import RULES_BY_ID, RULE_CLASSES
+
+
+def _default_paths(root: str) -> List[str]:
+    cands = [os.path.join(root, "sheeprl_tpu"), os.path.join(root, "scripts")]
+    return [p for p in cands if os.path.isdir(p)]
+
+
+def _repo_root() -> str:
+    # analysis/ lives at <root>/sheeprl_tpu/analysis
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m sheeprl_tpu.analysis",
+        description="JAX-invariant static analyzer (host-sync, PRNG reuse, "
+        "use-after-donate, retrace hazards, failpoint/config drift).",
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        help="files or directories to analyze (default: sheeprl_tpu/ and scripts/)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--baseline",
+        metavar="PATH",
+        default=None,
+        help=f"baseline file (default: {baseline_mod.default_baseline_path()})",
+    )
+    parser.add_argument(
+        "--no-baseline",
+        action="store_true",
+        help="report every finding, ignoring the baseline",
+    )
+    parser.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="regenerate the baseline from the current findings "
+        "(keeps justifications of still-matching rows) and exit 0",
+    )
+    parser.add_argument(
+        "--rules",
+        metavar="IDS",
+        default=None,
+        help="comma-separated rule ids to run (e.g. SA001,SA005)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list the rule catalog and exit"
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for cls in RULE_CLASSES:
+            print(f"{cls.id}  {cls.name:26s} [{cls.severity}] {cls.hint}")
+        return 0
+
+    rule_ids: Optional[List[str]] = None
+    if args.rules:
+        rule_ids = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids if r not in RULES_BY_ID]
+        if unknown:
+            print(
+                f"error: unknown rule id(s): {', '.join(unknown)} "
+                f"(known: {', '.join(sorted(RULES_BY_ID))})",
+                file=sys.stderr,
+            )
+            return 2
+
+    root = _repo_root()
+    paths = [os.path.abspath(p) for p in args.paths] or _default_paths(root)
+    if not paths:
+        print("error: no paths to analyze", file=sys.stderr)
+        return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"error: no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    analyzer = Analyzer(paths, root=root)
+    findings = analyzer.run(rule_ids=rule_ids)
+
+    baseline_path = args.baseline or baseline_mod.default_baseline_path()
+    if args.write_baseline:
+        entries = baseline_mod.write(findings, baseline_path)
+        print(f"wrote {len(entries)} suppression(s) to {baseline_path}")
+        todo = sum(1 for e in entries if e.justification == baseline_mod.TODO_JUSTIFICATION)
+        if todo:
+            print(f"note: {todo} entr(y/ies) still carry '{baseline_mod.TODO_JUSTIFICATION}'")
+        return 0
+
+    if args.no_baseline:
+        unsuppressed, suppressed, stale = list(findings), [], []
+    else:
+        entries = baseline_mod.load(baseline_path)
+        unsuppressed, suppressed, stale = baseline_mod.apply(findings, entries)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in unsuppressed],
+                    "suppressed": len(suppressed),
+                    "stale_baseline_entries": [e.to_line() for e in stale],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in unsuppressed:
+            print(f"{f.location()}: {f.rule} [{f.severity}] {f.message}")
+            if f.hint:
+                print(f"    hint: {f.hint}")
+        tail = (
+            f"{len(unsuppressed)} finding(s), {len(suppressed)} suppressed by baseline"
+        )
+        if stale:
+            tail += f", {len(stale)} stale baseline entr(y/ies):"
+        print(("" if not unsuppressed else "\n") + tail)
+        for e in stale:
+            print(f"    stale: {e.to_line()}")
+
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

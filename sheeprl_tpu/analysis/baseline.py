@@ -1,0 +1,138 @@
+"""Checked-in baseline: suppressions for reviewed, intentionally-kept findings.
+
+Line-oriented text so every suppression carries its one-line justification in
+the same row a reviewer reads::
+
+    # comment lines and blanks are ignored
+    SA001 | sheeprl_tpu/algos/ppo/ppo.py | train_loop | real_actions = np.asarray(env_actions) | the one unavoidable per-step host sync
+
+Columns: ``rule | path | scope | match | justification`` — the first four are
+the finding's :meth:`~sheeprl_tpu.analysis.engine.Finding.fingerprint`
+(line-number free, so edits above a suppressed line do not churn the file).
+``--write-baseline`` regenerates the file from the current findings,
+preserving justifications of entries that still match and stamping
+``TODO: justify`` on new ones — an un-justified entry is a review debt the
+file itself exposes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from sheeprl_tpu.analysis.engine import Finding
+
+DEFAULT_BASELINE_NAME = "baseline.txt"
+TODO_JUSTIFICATION = "TODO: justify"
+
+_HEADER = """\
+# sheeprl_tpu.analysis baseline — reviewed findings that stay suppressed.
+# One row per suppression: rule | path | scope | match | justification
+# Regenerate with:  python -m sheeprl_tpu.analysis --write-baseline
+# (justifications of still-matching rows are preserved; never hand-edit the
+# first four columns — they are the finding's fingerprint).
+"""
+
+
+@dataclass(frozen=True)
+class BaselineEntry:
+    rule: str
+    path: str
+    scope: str
+    match: str
+    justification: str
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.scope}|{self.match}"
+
+    def to_line(self) -> str:
+        return " | ".join((self.rule, self.path, self.scope, self.match, self.justification))
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), DEFAULT_BASELINE_NAME)
+
+
+def load(path: Optional[str] = None) -> List[BaselineEntry]:
+    path = path or default_baseline_path()
+    entries: List[BaselineEntry] = []
+    if not os.path.isfile(path):
+        return entries
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = [p.strip() for p in line.split("|")]
+            if len(parts) < 4:
+                raise ValueError(f"malformed baseline row (want >=4 '|' columns): {line!r}")
+            rule, fpath, scope, match = parts[:4]
+            justification = " | ".join(parts[4:]) if len(parts) > 4 else ""
+            entries.append(
+                BaselineEntry(
+                    rule=rule, path=fpath, scope=scope, match=match, justification=justification
+                )
+            )
+    return entries
+
+
+def apply(
+    findings: Sequence[Finding], entries: Sequence[BaselineEntry]
+) -> Tuple[List[Finding], List[Finding], List[BaselineEntry]]:
+    """Split ``findings`` against the baseline.
+
+    Returns ``(unsuppressed, suppressed, stale)``: findings not covered by any
+    entry, findings an entry covers, and entries that matched nothing (stale —
+    reported so the file shrinks as findings get fixed, but never failing the
+    run on their own).
+    """
+    by_fp: Dict[str, BaselineEntry] = {e.fingerprint: e for e in entries}
+    used: set = set()
+    unsuppressed: List[Finding] = []
+    suppressed: List[Finding] = []
+    for f in findings:
+        fp = f.fingerprint()
+        if fp in by_fp:
+            used.add(fp)
+            suppressed.append(f)
+        else:
+            unsuppressed.append(f)
+    stale = [e for e in entries if e.fingerprint not in used]
+    return unsuppressed, suppressed, stale
+
+
+def write(
+    findings: Sequence[Finding],
+    path: Optional[str] = None,
+    previous: Optional[Sequence[BaselineEntry]] = None,
+) -> List[BaselineEntry]:
+    """Regenerate the baseline from ``findings``, carrying forward the
+    justification of any entry whose fingerprint still matches."""
+    path = path or default_baseline_path()
+    prev_by_fp: Dict[str, BaselineEntry] = {
+        e.fingerprint: e for e in (previous if previous is not None else load(path))
+    }
+    entries: List[BaselineEntry] = []
+    seen: set = set()
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        fp = f.fingerprint()
+        if fp in seen:
+            continue
+        seen.add(fp)
+        kept = prev_by_fp.get(fp)
+        entries.append(
+            BaselineEntry(
+                rule=f.rule,
+                path=f.path,
+                scope=f.scope,
+                match=f.match,
+                justification=kept.justification if kept and kept.justification else TODO_JUSTIFICATION,
+            )
+        )
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(_HEADER)
+        for e in entries:
+            f.write(e.to_line() + "\n")
+    return entries

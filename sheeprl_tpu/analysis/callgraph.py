@@ -1,0 +1,309 @@
+"""jit-reachability call graph.
+
+Traced-context rules (host-sync, retrace hazards) must only fire inside code
+that actually runs under a jax trace. This module computes that set
+statically:
+
+1. **Entry points** — every function passed to one of the wrapper callables in
+   ``core/compile.py``'s ``JIT_ENTRY_WRAPPERS`` export (``jax.jit``,
+   ``guarded_jit``, ``shard_map``, ``lax.scan``, ``vmap``, ``grad``, ...),
+   whether as a call argument (``guarded_jit(train, ...)``) or a decorator
+   (``@jax.jit`` / ``@partial(jax.jit, ...)``).
+2. **Edges** — import-aware, name-based call resolution: a call to ``name``
+   inside a function resolves to the nested def, the module-level def, or —
+   via the module's ``from m import name`` / ``import m`` table — the def in
+   the imported module. Function names passed as call *arguments* inside a
+   traced function also become edges (``lax.scan(step, ...)``,
+   ``tree_map(fn, ...)`` run their argument under the same trace).
+3. **Reachability** — BFS closure over the edges from the entry points.
+
+The wrapper list is read **statically** from ``core/compile.py`` (the module
+is never imported), with a baked-in fallback so the graph still roots itself
+when analyzing a tree that lacks the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.pyutil import FUNCTION_NODES, dotted_name, last_segment
+
+# Fallback mirror of core/compile.py's JIT_ENTRY_WRAPPERS (kept in sync by
+# tests/test_analysis/test_callgraph.py).
+FALLBACK_JIT_ENTRY_WRAPPERS: Tuple[str, ...] = (
+    "jit",
+    "guarded_jit",
+    "aot_compile",
+    "shard_map",
+    "_shard_map",
+    "scan",
+    "associative_scan",
+    "fori_loop",
+    "while_loop",
+    "cond",
+    "switch",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+)
+
+
+def load_jit_entry_wrappers(package_dir: str) -> Tuple[str, ...]:
+    """Read ``JIT_ENTRY_WRAPPERS`` out of ``core/compile.py`` without importing
+    it (the analyzer must not pull jax in)."""
+    path = os.path.join(package_dir, "core", "compile.py")
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return FALLBACK_JIT_ENTRY_WRAPPERS
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == "JIT_ENTRY_WRAPPERS":
+                    try:
+                        value = ast.literal_eval(node.value)
+                        return tuple(str(v) for v in value)
+                    except (ValueError, SyntaxError):
+                        return FALLBACK_JIT_ENTRY_WRAPPERS
+    return FALLBACK_JIT_ENTRY_WRAPPERS
+
+
+@dataclass
+class FunctionInfo:
+    """One function/method definition in the scanned tree."""
+
+    module_rel: str
+    qualname: str  # Outer.inner dotted chain inside the module
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    class_name: Optional[str] = None
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module_rel, self.qualname)
+
+    @property
+    def simple_name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+
+@dataclass
+class _ModuleInfo:
+    rel: str
+    dotted: Optional[str]  # e.g. "sheeprl_tpu.algos.ppo.ppo"
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)  # qualname -> info
+    by_simple: Dict[str, List[FunctionInfo]] = field(default_factory=dict)
+    # import tables: alias -> dotted module, and name -> (dotted module, original name)
+    import_modules: Dict[str, str] = field(default_factory=dict)
+    import_names: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+
+
+def _module_dotted(rel: str) -> Optional[str]:
+    if not rel.endswith(".py"):
+        return None
+    parts = rel[: -len(".py")].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else None
+
+
+class CallGraph:
+    def __init__(self, modules: Sequence, package_dir: str):
+        self.wrappers: Set[str] = set(load_jit_entry_wrappers(package_dir))
+        self._modules: Dict[str, _ModuleInfo] = {}
+        self._by_dotted: Dict[str, _ModuleInfo] = {}
+        self._functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        for m in modules:
+            info = self._index_module(m)
+            self._modules[m.rel] = info
+            if info.dotted:
+                self._by_dotted[info.dotted] = info
+        self._edges: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        self._entry_points: Set[Tuple[str, str]] = set()
+        for m in modules:
+            self._collect_entries_and_edges(m)
+        self._traced = self._closure()
+
+    # ----- indexing --------------------------------------------------------
+    def _index_module(self, m) -> _ModuleInfo:
+        info = _ModuleInfo(rel=m.rel, dotted=_module_dotted(m.rel))
+
+        def visit(node: ast.AST, prefix: str, class_name: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, FUNCTION_NODES):
+                    qual = f"{prefix}{child.name}" if prefix else child.name
+                    fi = FunctionInfo(
+                        module_rel=m.rel, qualname=qual, node=child, class_name=class_name
+                    )
+                    info.functions[qual] = fi
+                    info.by_simple.setdefault(child.name, []).append(fi)
+                    self._functions[fi.key] = fi
+                    visit(child, qual + ".", class_name)
+                elif isinstance(child, ast.ClassDef):
+                    visit(child, f"{prefix}{child.name}.", child.name)
+                else:
+                    visit(child, prefix, class_name)
+
+        visit(m.tree, "", None)
+
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.import_modules[alias.asname or alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+                for alias in node.names:
+                    info.import_names[alias.asname or alias.name] = (node.module, alias.name)
+        return info
+
+    # ----- entries + edges -------------------------------------------------
+    def _resolve(
+        self,
+        info: _ModuleInfo,
+        name: str,
+        enclosing: Optional[FunctionInfo],
+    ) -> Optional[FunctionInfo]:
+        """Resolve a (possibly dotted) callee name to a FunctionInfo."""
+        # nested def inside the enclosing function chain
+        if enclosing is not None and "." not in name:
+            prefix = enclosing.qualname
+            while True:
+                cand = info.functions.get(f"{prefix}.{name}")
+                if cand is not None:
+                    return cand
+                if "." not in prefix:
+                    break
+                prefix = prefix.rsplit(".", 1)[0]
+        if "." not in name:
+            # module-level def (or method of the enclosing class)
+            if enclosing is not None and enclosing.class_name:
+                cand = info.functions.get(f"{enclosing.class_name}.{name}")
+                if cand is not None:
+                    return cand
+            cand = info.functions.get(name)
+            if cand is not None:
+                return cand
+            imported = info.import_names.get(name)
+            if imported is not None:
+                target = self._by_dotted.get(imported[0])
+                if target is not None:
+                    return target.functions.get(imported[1])
+            return None
+        base, _, attr = name.partition(".")
+        if base == "self" and enclosing is not None and enclosing.class_name and "." not in attr:
+            return info.functions.get(f"{enclosing.class_name}.{attr}")
+        if base in info.import_modules and "." not in attr:
+            target = self._by_dotted.get(info.import_modules[base])
+            if target is not None:
+                return target.functions.get(attr)
+        imported = info.import_names.get(base)
+        if imported is not None and "." not in attr:
+            # "from sheeprl_tpu.algos.ppo import loss; loss.policy_loss(...)"
+            target = self._by_dotted.get(f"{imported[0]}.{imported[1]}")
+            if target is not None:
+                return target.functions.get(attr)
+        return None
+
+    def _function_args_of_call(self, call: ast.Call) -> Iterator[ast.AST]:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            yield arg
+
+    def _collect_entries_and_edges(self, m) -> None:
+        info = self._modules[m.rel]
+
+        def walk(node: ast.AST, current: Optional[FunctionInfo]) -> None:
+            for child in ast.iter_child_nodes(node):
+                nxt = current
+                if isinstance(child, FUNCTION_NODES):
+                    for fi in info.by_simple.get(child.name, []):
+                        if fi.node is child:
+                            nxt = fi
+                            break
+                    self._visit_decorators(info, child, nxt)
+                if isinstance(child, ast.Call):
+                    self._visit_call(info, child, current)
+                walk(child, nxt)
+
+        walk(m.tree, None)
+
+    def _visit_decorators(self, info: _ModuleInfo, fn: ast.AST, fi: Optional[FunctionInfo]) -> None:
+        if fi is None:
+            return
+        for dec in getattr(fn, "decorator_list", []):
+            name = dotted_name(dec)
+            if name is None and isinstance(dec, ast.Call):
+                name = dotted_name(dec.func)
+                # @partial(jax.jit, ...) — the wrapper hides in the first arg
+                if name and last_segment(name) == "partial" and dec.args:
+                    inner = dotted_name(dec.args[0])
+                    if inner and last_segment(inner) in self.wrappers:
+                        self._entry_points.add(fi.key)
+                        continue
+            if name and last_segment(name) in self.wrappers:
+                self._entry_points.add(fi.key)
+
+    def _visit_call(self, info: _ModuleInfo, call: ast.Call, enclosing: Optional[FunctionInfo]) -> None:
+        name = dotted_name(call.func)
+        seg = last_segment(name)
+        if seg in self.wrappers:
+            # every function-valued argument of a jit-entry wrapper is traced
+            # lambdas handed to a wrapper need no node of their own: walk_own
+            # of the enclosing traced function descends into lambda bodies
+            for arg in self._function_args_of_call(call):
+                if isinstance(arg, ast.Name):
+                    target = self._resolve(info, arg.id, enclosing)
+                    if target is not None:
+                        self._entry_points.add(target.key)
+            return
+        if enclosing is None or name is None:
+            return
+        target = self._resolve(info, name, enclosing)
+        if target is not None:
+            self._edges.setdefault(enclosing.key, set()).add(target.key)
+        # function names passed as arguments (tree_map(fn, x), scan(step, c))
+        for arg in self._function_args_of_call(call):
+            if isinstance(arg, ast.Name):
+                t = self._resolve(info, arg.id, enclosing)
+                if t is not None:
+                    self._edges.setdefault(enclosing.key, set()).add(t.key)
+
+    # ----- reachability ----------------------------------------------------
+    def _closure(self) -> Set[Tuple[str, str]]:
+        seen: Set[Tuple[str, str]] = set()
+        stack = list(self._entry_points)
+        while stack:
+            key = stack.pop()
+            if key in seen:
+                continue
+            seen.add(key)
+            stack.extend(self._edges.get(key, ()))
+        return seen
+
+    # ----- public API ------------------------------------------------------
+    @property
+    def entry_points(self) -> Set[Tuple[str, str]]:
+        return set(self._entry_points)
+
+    def is_traced(self, module_rel: str, qualname: str) -> bool:
+        return (module_rel, qualname) in self._traced
+
+    def traced_functions(self, module_rel: Optional[str] = None) -> List[FunctionInfo]:
+        out = []
+        for key in self._traced:
+            fi = self._functions.get(key)
+            if fi is None:
+                continue
+            if module_rel is None or fi.module_rel == module_rel:
+                out.append(fi)
+        out.sort(key=lambda fi: (fi.module_rel, getattr(fi.node, "lineno", 0)))
+        return out
+
+    def function(self, module_rel: str, qualname: str) -> Optional[FunctionInfo]:
+        return self._functions.get((module_rel, qualname))

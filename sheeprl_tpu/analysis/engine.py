@@ -1,0 +1,225 @@
+"""Analyzer engine: file discovery, parsing, rule driving, finding model.
+
+The analyzer is **purely static**: it parses source with ``ast`` and never
+imports the code under analysis, so it runs before any device (or even jax)
+is touched by the analyzed modules. One :class:`Analyzer` owns the parsed
+module set, the jit-reachability call graph, and the rule list; rules receive
+a :class:`Context` and yield :class:`Finding`\\s.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence
+
+from sheeprl_tpu.analysis.callgraph import CallGraph
+
+#: Rule id used for files the analyzer itself cannot parse.
+PARSE_ERROR_RULE = "SA000"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to ``path:line``."""
+
+    rule: str
+    path: str  # repo-root-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str = "error"  # "error" | "warning"
+    scope: str = "<module>"  # enclosing function qualname
+    hint: str = ""
+    match: str = ""  # normalized source line (baseline fingerprint component)
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: rule + path + scope
+        + the normalized source text, so unrelated edits above a suppressed
+        finding do not invalidate its suppression."""
+        return f"{self.rule}|{self.path}|{self.scope}|{self.match}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "severity": self.severity,
+            "scope": self.scope,
+            "message": self.message,
+            "hint": self.hint,
+            "match": self.match,
+        }
+
+
+def normalize_match(text: str, width: int = 96) -> str:
+    """Whitespace-collapsed, width-capped source line for fingerprints."""
+    return " ".join(text.split())[:width]
+
+
+@dataclass
+class Module:
+    """One parsed source file."""
+
+    path: str  # absolute
+    rel: str  # repo-root-relative, posix
+    tree: ast.Module
+    lines: List[str]
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Rule:
+    """Base class: subclasses set the class attributes and implement
+    :meth:`run` (whole-context rules) or :meth:`check_module`."""
+
+    id: str = "SA0XX"
+    name: str = "unnamed"
+    severity: str = "error"
+    hint: str = ""
+
+    def run(self, ctx: "Context") -> Iterator[Finding]:
+        for module in ctx.modules:
+            yield from self.check_module(ctx, module)
+
+    def check_module(self, ctx: "Context", module: Module) -> Iterator[Finding]:
+        return iter(())
+
+    # ----- helpers ---------------------------------------------------------
+    def finding(
+        self,
+        module: Module,
+        node: ast.AST,
+        message: str,
+        scope: str = "<module>",
+        hint: Optional[str] = None,
+        severity: Optional[str] = None,
+    ) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id,
+            path=module.rel,
+            line=line,
+            col=getattr(node, "col_offset", 0) + 1,
+            message=message,
+            severity=severity or self.severity,
+            scope=scope,
+            hint=self.hint if hint is None else hint,
+            match=normalize_match(module.line_text(line)),
+        )
+
+
+@dataclass
+class Context:
+    """Everything a rule may consult."""
+
+    root: str  # repo root (absolute)
+    modules: List[Module]
+    callgraph: CallGraph
+    package_dir: str  # .../sheeprl_tpu (registry + configs live beside it)
+    extras: Dict[str, Any] = field(default_factory=dict)
+
+
+def _iter_py_files(path: str) -> Iterator[str]:
+    if os.path.isfile(path):
+        if path.endswith(".py"):
+            yield path
+        return
+    for dirpath, dirnames, filenames in os.walk(path):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__" and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+class Analyzer:
+    """Parse ``paths``, build the call graph, run the rules.
+
+    ``root`` anchors the repo-relative paths findings and baselines use; it
+    defaults to the parent of the installed ``sheeprl_tpu`` package (the repo
+    checkout). ``package_dir`` locates the failpoint registry and the Hydra
+    config tree the drift rules validate against — overridable so the
+    self-lint test can run the analyzer against a seeded copy of the tree.
+    """
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        root: Optional[str] = None,
+        rules: Optional[Sequence[Rule]] = None,
+        package_dir: Optional[str] = None,
+    ):
+        if root is None:
+            root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        self.root = os.path.abspath(root)
+        if package_dir is None:
+            candidate = os.path.join(self.root, "sheeprl_tpu")
+            package_dir = candidate if os.path.isdir(candidate) else os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))
+            )
+        self.package_dir = package_dir
+        self.paths = [os.path.abspath(p) for p in paths]
+        if rules is None:
+            from sheeprl_tpu.analysis.rules import default_rules
+
+            rules = default_rules()
+        self.rules = list(rules)
+        self.parse_errors: List[Finding] = []
+        self.modules = self._parse_all()
+        self.callgraph = CallGraph(self.modules, package_dir=self.package_dir)
+
+    # ----- parsing ---------------------------------------------------------
+    def _parse_all(self) -> List[Module]:
+        modules: List[Module] = []
+        seen = set()
+        for path in self.paths:
+            for file_path in _iter_py_files(path):
+                if file_path in seen:
+                    continue
+                seen.add(file_path)
+                rel = os.path.relpath(file_path, self.root).replace(os.sep, "/")
+                try:
+                    with open(file_path, "r", encoding="utf-8") as f:
+                        source = f.read()
+                    tree = ast.parse(source, filename=file_path)
+                except (SyntaxError, UnicodeDecodeError, OSError) as e:
+                    lineno = getattr(e, "lineno", 1) or 1
+                    self.parse_errors.append(
+                        Finding(
+                            rule=PARSE_ERROR_RULE,
+                            path=rel,
+                            line=lineno,
+                            col=(getattr(e, "offset", 0) or 0) + 1,
+                            message=f"cannot parse: {type(e).__name__}: {e}",
+                            scope="<module>",
+                            match="",
+                        )
+                    )
+                    continue
+                modules.append(Module(path=file_path, rel=rel, tree=tree, lines=source.splitlines()))
+        return modules
+
+    # ----- driving ---------------------------------------------------------
+    def run(self, rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+        wanted = set(rule_ids) if rule_ids is not None else None
+        ctx = Context(
+            root=self.root,
+            modules=self.modules,
+            callgraph=self.callgraph,
+            package_dir=self.package_dir,
+        )
+        findings: List[Finding] = list(self.parse_errors)
+        for rule in self.rules:
+            if wanted is not None and rule.id not in wanted:
+                continue
+            findings.extend(rule.run(ctx))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings

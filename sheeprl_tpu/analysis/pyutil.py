@@ -1,0 +1,233 @@
+"""Shared AST helpers for the analyzer rules.
+
+Everything here is plain ``ast`` plumbing: dotted-name rendering, walking a
+function's *own* body (without descending into nested ``def``s, which are
+separate call-graph nodes), assignment-target extraction, and the light
+tracer-taint pass the traced-context rules (SA001 host-sync, SA004 retrace)
+share.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator, List, Optional, Sequence, Set
+
+FUNCTION_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """Render ``a.b.c`` for a Name/Attribute chain; None for anything dynamic
+    (subscripts, calls) anywhere in the chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> Optional[str]:
+    return dotted_name(call.func)
+
+
+def last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def walk_own(fn: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` over a function's own statements, NOT descending into
+    nested function/class definitions (lambdas ARE descended: a lambda inside
+    a traced function traces with it)."""
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, FUNCTION_NODES + (ast.ClassDef,)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def own_statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """Statements of a block in source order, recursing into compound
+    statements (if/for/while/with/try) but not into nested defs/classes."""
+    for stmt in body:
+        if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+            continue
+        yield stmt
+        for block in child_blocks(stmt):
+            yield from own_statements(block)
+
+
+def child_blocks(stmt: ast.stmt) -> List[List[ast.stmt]]:
+    """The nested statement blocks of a compound statement."""
+    blocks: List[List[ast.stmt]] = []
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if block:
+            blocks.append(block)
+    for handler in getattr(stmt, "handlers", []) or []:
+        blocks.append(handler.body)
+    return blocks
+
+
+def assigned_names(target: ast.AST) -> Set[str]:
+    """Plain names bound by an assignment target (tuples unpacked; starred,
+    subscript and attribute targets contribute nothing)."""
+    names: Set[str] = set()
+    if isinstance(target, ast.Name):
+        names.add(target.id)
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            names |= assigned_names(elt)
+    elif isinstance(target, ast.Starred):
+        names |= assigned_names(target.value)
+    return names
+
+
+def stmt_assigned_names(stmt: ast.stmt) -> Set[str]:
+    """Names (re)bound by one statement, whatever its flavor."""
+    names: Set[str] = set()
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            names |= assigned_names(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        names |= assigned_names(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        names |= assigned_names(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                names |= assigned_names(item.optional_vars)
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.NamedExpr):
+            names |= assigned_names(node.target)
+    return names
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """Every Name referenced anywhere under ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+_ARRAY_PRODUCING_PREFIXES = ("jnp", "jax", "lax", "jrandom", "jax_random")
+
+# attribute accesses on a tracer that are STATIC at trace time: branching on
+# them is normal Python, not a traced-boolean hazard
+STATIC_TRACER_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "weak_type", "aval"}
+
+
+def param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+_STATIC_ANNOTATIONS = {"bool", "str", "int", "float", "dict", "list", "tuple", "Sequence", "Dict", "List", "Tuple", "Optional"}
+_HOST_MODULE_PREFIXES = ("np", "numpy", "onp")
+
+
+def _static_params_by_signature(fn: ast.AST) -> Set[str]:
+    """Params whose annotation or default says "plain Python value, not array":
+    a ``greedy: bool = False`` or ``reduction: str`` argument of a jitted fn is
+    a static (hashable/closure) value, never a tracer."""
+    static: Set[str] = set()
+    args = fn.args
+    all_args = args.posonlyargs + args.args + args.kwonlyargs
+    for a in all_args:
+        ann = a.annotation
+        if ann is not None:
+            base = ann
+            if isinstance(base, ast.Subscript):  # Optional[bool], List[str], ...
+                base = base.value
+            name = dotted_name(base)
+            if name and name.rsplit(".", 1)[-1] in _STATIC_ANNOTATIONS:
+                static.add(a.arg)
+    positional = args.posonlyargs + args.args
+    for a, d in zip(positional[len(positional) - len(args.defaults):], args.defaults):
+        if isinstance(d, ast.Constant) and isinstance(d.value, (bool, str, type(None))):
+            static.add(a.arg)
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        if isinstance(d, ast.Constant) and isinstance(d.value, (bool, str, type(None))):
+            static.add(a.arg)
+    return static
+
+
+def tainted_names(fn: ast.AST, static_params: Iterable[str] = ()) -> Set[str]:
+    """Tracer-taint over a traced function body.
+
+    Seeds: the function's parameters (minus declared static ones) — inside a
+    jit-traced function every array argument is a tracer. Params whose
+    signature marks them static (bool/str/... annotation, bool/str/None
+    default) are excluded: they are Python-level flags, constant under trace.
+    Propagation: a name assigned from an expression that references a tainted
+    name, or from a call into ``jnp``/``jax``/``lax`` (array-producing),
+    becomes tainted — unless the producing call is ``np.*`` (numpy executes on
+    host at trace time; its results are concrete). Two passes reach the
+    fixpoint for the straight-line code these rules target.
+    """
+    taint: Set[str] = param_names(fn) - set(static_params) - _static_params_by_signature(fn)
+    taint.discard("self")
+    taint.discard("cfg")
+    for _ in range(2):
+        for stmt in own_statements(getattr(fn, "body", [])):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                continue
+            value = stmt.value
+            if value is None:
+                continue
+            # np.* executes on host at trace time: np.dtype(x).itemsize and
+            # friends yield concrete values even when fed tainted names
+            root = value
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Call):
+                root_name = call_name(root)
+                if root_name and root_name.split(".", 1)[0] in _HOST_MODULE_PREFIXES:
+                    continue
+            tainted = bool(names_in(value) & taint)
+            if not tainted:
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Call):
+                        name = call_name(sub)
+                        if name and name.split(".", 1)[0] in _ARRAY_PRODUCING_PREFIXES:
+                            tainted = True
+                            break
+            if tainted:
+                taint |= stmt_assigned_names(stmt)
+    return taint
+
+
+def literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def fstring_prefix(node: ast.AST) -> Optional[str]:
+    """The leading constant text of an f-string (None when it starts dynamic)."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and isinstance(first.value, str):
+            return first.value
+    return None
+
+
+def int_literal_seq(node: ast.AST) -> Optional[List[int]]:
+    """A literal int, or tuple/list of literal ints; None for anything else."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[int] = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+            else:
+                return None
+        return out
+    return None

@@ -1,0 +1,47 @@
+"""Rule registry for :mod:`sheeprl_tpu.analysis`.
+
+Each rule is an :class:`~sheeprl_tpu.analysis.engine.Rule` subclass with a
+stable id (``SA00x``). ``default_rules()`` returns one fresh instance of each —
+rules are stateless between runs by construction, but fresh instances keep any
+future per-run caching honest.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from sheeprl_tpu.analysis.engine import Rule
+from sheeprl_tpu.analysis.rules.config_keys import ConfigKeyRule
+from sheeprl_tpu.analysis.rules.donation import UseAfterDonateRule
+from sheeprl_tpu.analysis.rules.failpoint_names import FailpointNameRule
+from sheeprl_tpu.analysis.rules.host_sync import HostSyncRule
+from sheeprl_tpu.analysis.rules.prng import PrngKeyReuseRule
+from sheeprl_tpu.analysis.rules.retrace import RetraceHazardRule
+
+RULE_CLASSES: List[Type[Rule]] = [
+    HostSyncRule,
+    PrngKeyReuseRule,
+    UseAfterDonateRule,
+    RetraceHazardRule,
+    FailpointNameRule,
+    ConfigKeyRule,
+]
+
+RULES_BY_ID: Dict[str, Type[Rule]] = {cls.id: cls for cls in RULE_CLASSES}
+
+
+def default_rules() -> List[Rule]:
+    return [cls() for cls in RULE_CLASSES]
+
+
+__all__ = [
+    "RULE_CLASSES",
+    "RULES_BY_ID",
+    "default_rules",
+    "HostSyncRule",
+    "PrngKeyReuseRule",
+    "UseAfterDonateRule",
+    "RetraceHazardRule",
+    "FailpointNameRule",
+    "ConfigKeyRule",
+]

@@ -1,0 +1,260 @@
+"""SA006 — config key drift.
+
+``cfg`` is a :class:`dotdict`: ``cfg.algo.rolout_steps`` (typo) raises
+``AttributeError`` only when that exact line runs — usually ten minutes into a
+TPU job, after compile. This rule resolves every ``cfg.<dotted>`` chain in the
+training/serving/orchestration planes against the **union** of the Hydra-style
+config tree under ``sheeprl_tpu/configs/``:
+
+* every ``<group>/<option>.yaml`` body is unioned into the group's subtree;
+* ``defaults:`` mounts (``- /optim@world_model.optimizer: adam``) graft the
+  source group's union at the mount path, so ``cfg.algo.critic.optimizer.lr``
+  resolves;
+* ``# @package _global_`` files (``exp/``) merge at the root;
+* the root also carries ``config.yaml``'s own keys.
+
+Chains are validated left-to-right while the tree has something to say: a leaf
+(scalar in every yaml), an *open* node (leaf in one file, mapping in another —
+shape varies by option), a ``_``-prefixed segment, or a dict-method segment
+(``get``/``items``/...) all end validation without a finding. Only a segment
+missing from a node that is a mapping in **every** contributing file flags.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Module, Rule
+
+try:
+    import yaml
+except ImportError:  # pragma: no cover - yaml ships with the image
+    yaml = None
+
+# chain bases that denote the composed root config
+_CFG_BASES = {"cfg", "config"}
+# only these planes receive the fully-composed cfg; helpers elsewhere get subtrees
+_CHECKED_PREFIXES = ("sheeprl_tpu/algos/", "sheeprl_tpu/serve/", "sheeprl_tpu/orchestrate/")
+# dict/dotdict API — a chain continuing through these is method access, not keys
+_METHOD_SEGMENTS = {
+    "get",
+    "pop",
+    "setdefault",
+    "update",
+    "copy",
+    "items",
+    "keys",
+    "values",
+    "as_dict",
+    "to_dict",
+    "to_container",
+    "lower",
+    "upper",
+    "startswith",
+    "endswith",
+    "split",
+    "strip",
+    "format",
+    "join",
+}
+
+# tree node values: dict (mapping), None (scalar leaf), _OPEN (shape varies)
+_OPEN = object()
+
+_MOUNT_RE = re.compile(r"^(?:override\s+)?/?(?P<group>[\w.-]+)@(?P<path>[\w.]+)$")
+
+
+def _merge_yaml(dst: Dict[str, Any], src: Mapping) -> None:
+    for key, value in src.items():
+        if not isinstance(key, str):
+            continue
+        if isinstance(value, Mapping):
+            cur = dst.get(key)
+            if isinstance(cur, dict):
+                _merge_yaml(cur, value)
+            elif key in dst and cur is not _OPEN:
+                dst[key] = _OPEN  # leaf in one file, mapping in another
+            else:
+                node: Dict[str, Any] = {}
+                _merge_yaml(node, value)
+                dst[key] = node
+        else:
+            cur = dst.get(key)
+            if isinstance(cur, dict):
+                dst[key] = _OPEN
+            elif key not in dst:
+                dst[key] = None
+
+
+def _mount(tree: Dict[str, Any], path: List[str], subtree: Dict[str, Any]) -> None:
+    cur = tree
+    for seg in path[:-1]:
+        nxt = cur.get(seg)
+        if not isinstance(nxt, dict):
+            nxt = {}
+            cur[seg] = nxt
+        cur = nxt
+    leaf = cur.get(path[-1])
+    if isinstance(leaf, dict):
+        for k, v in subtree.items():
+            leaf.setdefault(k, v)
+    else:
+        cur[path[-1]] = dict(subtree)
+
+
+def build_config_tree(configs_dir: str) -> Optional[Dict[str, Any]]:
+    """Union all yaml option files into one permissive key tree. ``None`` when
+    the configs dir (or yaml itself) is unavailable — the rule then no-ops."""
+    if yaml is None or not os.path.isdir(configs_dir):
+        return None
+
+    groups: Dict[str, Dict[str, Any]] = {}
+    group_defaults: Dict[str, List[Mapping]] = {}
+    global_bodies: List[Mapping] = []
+    root_body: Dict[str, Any] = {}
+
+    for entry in sorted(os.listdir(configs_dir)):
+        path = os.path.join(configs_dir, entry)
+        if os.path.isdir(path):
+            union: Dict[str, Any] = {}
+            defaults: List[Mapping] = []
+            for fname in sorted(os.listdir(path)):
+                if not fname.endswith((".yaml", ".yml")):
+                    continue
+                fpath = os.path.join(path, fname)
+                try:
+                    with open(fpath, "r", encoding="utf-8") as f:
+                        raw = f.read()
+                    data = yaml.safe_load(raw)
+                except Exception:
+                    continue
+                if not isinstance(data, Mapping):
+                    continue
+                body = {k: v for k, v in data.items() if k != "defaults"}
+                if "@package _global_" in "\n".join(raw.splitlines()[:3]):
+                    global_bodies.append(body)
+                else:
+                    _merge_yaml(union, body)
+                dlist = data.get("defaults")
+                if isinstance(dlist, list):
+                    defaults.extend(d for d in dlist if isinstance(d, Mapping))
+            groups[entry] = union
+            group_defaults[entry] = defaults
+        elif entry.endswith((".yaml", ".yml")):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    data = yaml.safe_load(f.read())
+            except Exception:
+                continue
+            if isinstance(data, Mapping):
+                _merge_yaml(root_body, {k: v for k, v in data.items() if k != "defaults"})
+
+    # graft defaults-list mounts: "- /optim@world_model.optimizer: adam" in an
+    # algo file mounts the optim union under algo.world_model.optimizer
+    for group, defaults in group_defaults.items():
+        for d in defaults:
+            for key in d:
+                if not isinstance(key, str):
+                    continue
+                m = _MOUNT_RE.match(key.strip())
+                if not m:
+                    continue
+                src = groups.get(m.group("group"))
+                if src is None:
+                    continue
+                _mount(groups[group], m.group("path").split("."), src)
+
+    tree: Dict[str, Any] = dict(root_body)
+    for group, union in groups.items():
+        cur = tree.get(group)
+        if isinstance(cur, dict):
+            for k, v in union.items():
+                cur.setdefault(k, v)
+        else:
+            tree[group] = union
+    for body in global_bodies:
+        _merge_yaml(tree, body)
+    return tree
+
+
+class ConfigKeyRule(Rule):
+    id = "SA006"
+    name = "config-key-drift"
+    severity = "warning"
+    hint = (
+        "check the key against sheeprl_tpu/configs/<group>/*.yaml — add it to the "
+        "yaml if it is new, or fix the access if it drifted"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        tree = ctx.extras.get("config_tree")
+        if tree is None:
+            tree = build_config_tree(os.path.join(ctx.package_dir, "configs"))
+            ctx.extras["config_tree"] = tree if tree is not None else False
+        if not tree:
+            return
+        for module in ctx.modules:
+            rel = module.rel.replace(os.sep, "/")
+            if not rel.startswith(_CHECKED_PREFIXES):
+                continue
+            yield from self._check_module(module, tree)
+
+    def _check_module(self, module: Module, tree: Dict[str, Any]) -> Iterator[Finding]:
+        consumed: Set[int] = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Attribute) or id(node) in consumed:
+                continue
+            segments, base = self._unwind(node, consumed)
+            if base not in _CFG_BASES or not segments:
+                continue
+            # only chains rooted at a known top-level key are checkable: the
+            # codebase also passes *sub*-configs around under the name `cfg`
+            if segments[0] not in tree:
+                continue
+            bad = self._validate(segments, tree)
+            if bad is not None:
+                prefix, seg = bad
+                yield self.finding(
+                    module,
+                    node,
+                    f"config key '{'.'.join(prefix + [seg])}' not found in any yaml under "
+                    f"configs/ (chain cfg.{'.'.join(segments)})",
+                    scope="<module>",
+                )
+
+    @staticmethod
+    def _unwind(node: ast.Attribute, consumed: Set[int]) -> Tuple[List[str], Optional[str]]:
+        """cfg.a.b.c -> (["a","b","c"], "cfg"); marks inner nodes consumed."""
+        segments: List[str] = []
+        cur: ast.AST = node
+        while isinstance(cur, ast.Attribute):
+            consumed.add(id(cur))
+            segments.append(cur.attr)
+            cur = cur.value
+        segments.reverse()
+        if isinstance(cur, ast.Name):
+            # self.cfg.algo...: the loop swallowed "cfg" into segments; re-root
+            if cur.id == "self" and segments and segments[0] in _CFG_BASES:
+                return segments[1:], segments[0]
+            return segments, cur.id
+        return segments, None
+
+    @staticmethod
+    def _validate(
+        segments: List[str], tree: Dict[str, Any]
+    ) -> Optional[Tuple[List[str], str]]:
+        cur: Any = tree
+        prefix: List[str] = []
+        for seg in segments:
+            if seg.startswith("_") or seg in _METHOD_SEGMENTS:
+                return None
+            if not isinstance(cur, dict):
+                return None  # leaf or open: shape unknown past here
+            if seg not in cur:
+                return (prefix, seg)
+            cur = cur[seg]
+            prefix.append(seg)
+        return None

@@ -1,0 +1,200 @@
+"""SA003 — use after donation.
+
+``donate_argnums`` hands the input buffer to XLA for in-place reuse: after the
+call the Python reference points at **deleted** device memory, and touching it
+raises a runtime error at best — or, on a cached executable path, silently
+reads aliased garbage. The donated-carry seams of this repo
+(``envs/ingraph/fused.py``, ``replay_ring.py``, every ``*.train`` fn) all rely
+on the caller rebinding the carry in the same statement; this rule enforces
+exactly that: a name passed at a donated position is dead until reassigned.
+
+Detection is scope-aware: bindings of the shape
+``fn = guarded_jit(f, donate_argnums=(0, 1))`` are collected at module level
+plus per enclosing function (plain names), and ``self.attr`` bindings are
+visible to every method; each function body is then walked linearly — a read of a dead name
+flags, an assignment revives. Branches merge conservatively (dead only if dead
+on every path); loop bodies are scanned twice so a donate-at-bottom /
+read-at-top pair across iterations is caught.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Module, Rule
+from sheeprl_tpu.analysis.pyutil import (
+    FUNCTION_NODES,
+    call_name,
+    int_literal_seq,
+    last_segment,
+    stmt_assigned_names,
+    walk_own,
+)
+
+_JIT_NAMES = {"jit", "guarded_jit"}
+
+
+class UseAfterDonateRule(Rule):
+    id = "SA003"
+    name = "use-after-donate"
+    severity = "error"
+    hint = (
+        "rebind the donated operand from the call's result (`state = fn(state, ...)`) "
+        "or pass a copy; a donated buffer must never be read again"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for module in ctx.modules:
+            shared = self._collect_shared_bindings(module)
+            for node in ast.walk(module.tree):
+                if isinstance(node, FUNCTION_NODES):
+                    donated = dict(shared)
+                    donated.update(self._collect_local_bindings(node))
+                    if donated:
+                        yield from self._check_function(module, node, donated)
+
+    # ----- binding collection ----------------------------------------------
+    @staticmethod
+    def _donated_positions(call: ast.Call) -> Optional[List[int]]:
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                if kw.arg == "donate_argnames":
+                    return None  # name-keyed donation: positions unknown, skip
+                return int_literal_seq(kw.value)
+        return None
+
+    def _binding_positions(self, node: ast.stmt) -> Optional[List[int]]:
+        if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+            return None
+        if last_segment(call_name(node.value)) not in _JIT_NAMES:
+            return None
+        return self._donated_positions(node.value)
+
+    def _collect_shared_bindings(self, module: Module) -> Dict[str, List[int]]:
+        """Bindings visible across functions: module-level plain names
+        (``train_fn = jit(...)``) and attribute tails anywhere (``self.step_fn``
+        in ``__init__`` is keyed as ``step_fn`` for every method — class-blind:
+        a same-module collision on an attr name is vastly less likely than a
+        missed donation bug)."""
+        donated: Dict[str, List[int]] = {}
+        for node in ast.walk(module.tree):
+            positions = self._binding_positions(node) if isinstance(node, ast.stmt) else None
+            if not positions:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Attribute):
+                    donated[target.attr] = positions
+        for node in module.tree.body:
+            positions = self._binding_positions(node)
+            if not positions:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    donated[target.id] = positions
+        return donated
+
+    def _collect_local_bindings(self, fn: ast.AST) -> Dict[str, List[int]]:
+        """Plain-name bindings inside this function body only — a ``step``
+        rebound in another function does not donate here."""
+        donated: Dict[str, List[int]] = {}
+        for node in walk_own(fn):
+            positions = self._binding_positions(node) if isinstance(node, ast.stmt) else None
+            if not positions:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    donated[target.id] = positions
+        return donated
+
+    # ----- per-function dead-name scan -------------------------------------
+    def _check_function(
+        self, module: Module, fn: ast.AST, donated: Dict[str, List[int]]
+    ) -> Iterator[Finding]:
+        findings: Dict[Tuple[int, str], Finding] = {}
+
+        def callee_key(call: ast.Call) -> Optional[str]:
+            name = call_name(call)
+            seg = last_segment(name)
+            return seg if seg in donated else None
+
+        def scan_expr(expr: ast.AST, dead: Dict[str, int]) -> None:
+            """Flag reads of dead names; mark donated args dead (inner calls first)."""
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    if node.id in dead:
+                        key = (node.lineno, node.id)
+                        if key not in findings:
+                            findings[key] = self.finding(
+                                module,
+                                node,
+                                f"'{node.id}' was donated at line {dead[node.id]} "
+                                "(donate_argnums) and is read again before reassignment — "
+                                "the buffer no longer exists",
+                                scope=getattr(fn, "name", "<lambda>"),
+                            )
+            # after checking reads, process donations made by calls in this expr
+            for node in ast.walk(expr):
+                if isinstance(node, ast.Call):
+                    key = callee_key(node)
+                    if key is None:
+                        continue
+                    for pos in donated[key]:
+                        if pos < len(node.args) and isinstance(node.args[pos], ast.Name):
+                            dead[node.args[pos].id] = node.lineno
+
+        def scan_block(body, dead: Dict[str, int]) -> Dict[str, int]:
+            for stmt in body:
+                if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+                    continue
+                for expr in self._stmt_exprs(stmt):
+                    scan_expr(expr, dead)
+                for name in stmt_assigned_names(stmt):
+                    dead.pop(name, None)  # rebound: alive again
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    # two passes over the body: catches reads at the top of
+                    # iteration N+1 of a buffer donated at the bottom of N
+                    body_dead = dict(dead)
+                    body_dead = scan_block(stmt.body, body_dead)
+                    body_dead = scan_block(stmt.body, body_dead)
+                    scan_block(stmt.orelse, dict(dead))
+                    dead.update(body_dead)
+                elif isinstance(stmt, ast.If):
+                    then_dead = scan_block(stmt.body, dict(dead))
+                    else_dead = scan_block(stmt.orelse, dict(dead))
+                    # conservative merge: dead only when dead on both paths
+                    merged = {
+                        k: v for k, v in then_dead.items() if k in else_dead
+                    }
+                    dead.clear()
+                    dead.update(merged)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    dead.update(scan_block(stmt.body, dead))
+                elif isinstance(stmt, ast.Try):
+                    dead.update(scan_block(stmt.body, dict(dead)))
+                    for handler in stmt.handlers:
+                        scan_block(handler.body, dict(dead))
+                    scan_block(stmt.orelse, dict(dead))
+                    dead.update(scan_block(stmt.finalbody, dead))
+            return dead
+
+        scan_block(fn.body, {})
+        yield from findings.values()
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+        exprs: List[ast.AST] = []
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return)):
+            if getattr(stmt, "value", None) is not None:
+                exprs.append(stmt.value)
+        elif isinstance(stmt, (ast.If, ast.While)):
+            exprs.append(stmt.test)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            exprs.append(stmt.iter)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            exprs.extend(item.context_expr for item in stmt.items)
+        elif isinstance(stmt, ast.Assert):
+            exprs.append(stmt.test)
+        elif isinstance(stmt, ast.Raise) and stmt.exc is not None:
+            exprs.append(stmt.exc)
+        return exprs

@@ -1,0 +1,183 @@
+"""SA005 — failpoint name drift.
+
+A chaos drill that injects ``ckpt.pre_fsnyc`` (typo) instead of
+``ckpt.pre_fsync`` silently tests nothing: :func:`failpoint` sites that nobody
+configured fire zero actions and the smoke "passes". The canonical name list
+lives in ``core/failpoints.py``'s ``KNOWN_FAILPOINTS`` registry; this rule
+resolves every literal failpoint reference in the tree against it:
+
+* ``failpoint("name")`` / ``failpoints.has("name")`` call sites,
+* spec strings handed to ``configure()`` / ``active()`` / the
+  ``SHEEPRL_TPU_FAILPOINTS`` env var (``"name:action[:arg][:trigger]"``,
+  comma-separated; f-strings are checked up to their first ``{``),
+* action tokens in those specs against the runtime's ``_ACTIONS`` tuple.
+
+The registry is read **statically** — the analyzer never imports the runtime —
+and test files are exempt (unit tests mint throwaway names on purpose).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Module, Rule
+from sheeprl_tpu.analysis.pyutil import (
+    call_name,
+    fstring_prefix,
+    last_segment,
+    literal_str,
+)
+
+_ENV_VAR = "SHEEPRL_TPU_FAILPOINTS"
+# spec-consuming callables: every str literal argument is a spec string
+_SPEC_SINKS = {"configure", "active"}
+# name-consuming callables: the first str literal argument is a bare name
+_NAME_SINKS = {"failpoint", "has", "spec_entry"}
+
+
+def load_registry(package_dir: str) -> Tuple[Set[str], Set[str]]:
+    """Statically read ``KNOWN_FAILPOINTS`` keys and ``_ACTIONS`` from
+    ``core/failpoints.py``. Empty sets disable the corresponding check (the
+    rule degrades to a no-op on trees without the registry)."""
+    path = os.path.join(package_dir, "core", "failpoints.py")
+    names: Set[str] = set()
+    actions: Set[str] = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return names, actions
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        else:
+            continue
+        for target in targets:
+            if not isinstance(target, ast.Name):
+                continue
+            if target.id == "KNOWN_FAILPOINTS" and isinstance(node.value, ast.Dict):
+                for key in node.value.keys:
+                    lit = literal_str(key)
+                    if lit is not None:
+                        names.add(lit)
+            elif target.id == "_ACTIONS":
+                try:
+                    actions.update(str(a) for a in ast.literal_eval(node.value))
+                except (ValueError, SyntaxError):
+                    pass
+    return names, actions
+
+
+class FailpointNameRule(Rule):
+    id = "SA005"
+    name = "failpoint-name-drift"
+    severity = "error"
+    hint = (
+        "use a name from core/failpoints.py KNOWN_FAILPOINTS (or register the new "
+        "site there); build specs with failpoints.spec_entry() to get this check at runtime"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        known, actions = load_registry(ctx.package_dir)
+        if not known:
+            return
+        for module in ctx.modules:
+            if self._is_test_file(module.rel):
+                continue
+            if module.rel.replace(os.sep, "/").endswith("core/failpoints.py"):
+                continue  # the registry itself
+            yield from self._check_module(module, known, actions)
+
+    @staticmethod
+    def _is_test_file(rel: str) -> bool:
+        parts = rel.replace(os.sep, "/").split("/")
+        return any(p in ("tests", "test_analysis", "fixtures") for p in parts) or parts[
+            -1
+        ].startswith("test_")
+
+    # -----------------------------------------------------------------------
+    def _check_module(
+        self, module: Module, known: Set[str], actions: Set[str]
+    ) -> Iterator[Finding]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                seg = last_segment(call_name(node)) or ""
+                if seg in _NAME_SINKS and node.args:
+                    name = literal_str(node.args[0])
+                    if name is not None and name not in known:
+                        yield self._unknown_name(module, node.args[0], name, known, seg)
+                elif seg in _SPEC_SINKS:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        yield from self._check_spec_expr(module, arg, known, actions)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # FOO = "spec" where the env-var name appears in the statement,
+                # and env dict writes: env["SHEEPRL_TPU_FAILPOINTS"] = "spec"
+                if self._mentions_env_var(node):
+                    value = getattr(node, "value", None)
+                    # a value equal to the env-var name is its constant
+                    # definition (`_ENV_VAR = "SHEEPRL_TPU_FAILPOINTS"`), not a spec
+                    if value is not None and literal_str(value) != _ENV_VAR:
+                        yield from self._check_spec_expr(module, value, known, actions)
+            elif isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if k is not None and literal_str(k) == _ENV_VAR and v is not None:
+                        yield from self._check_spec_expr(module, v, known, actions)
+
+    @staticmethod
+    def _mentions_env_var(stmt: ast.stmt) -> bool:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Constant) and node.value == _ENV_VAR:
+                return True
+            if isinstance(node, ast.Name) and node.id == _ENV_VAR:
+                return True
+        return False
+
+    def _check_spec_expr(
+        self, module: Module, expr: ast.AST, known: Set[str], actions: Set[str]
+    ) -> Iterator[Finding]:
+        spec = literal_str(expr)
+        if spec is None and isinstance(expr, ast.JoinedStr):
+            # f-string: only the constant prefix before the first placeholder is
+            # checkable; its trailing entry may be cut mid-name, so keep it only
+            # when the name field visibly completed (a ':' follows it)
+            spec = fstring_prefix(expr)
+            entries = spec.split(",") if spec else []
+            if entries and ":" not in entries[-1]:
+                entries = entries[:-1]
+            spec = ",".join(entries)
+        if not spec:
+            return
+        for entry in spec.split(","):
+            entry = entry.strip()
+            if not entry:
+                continue
+            fields = entry.split(":")
+            name = fields[0].strip()
+            if name and name not in known:
+                yield self._unknown_name(module, expr, name, known, "spec")
+            if len(fields) >= 2:
+                action = fields[1].strip()
+                if action and actions and action not in actions:
+                    yield self.finding(
+                        module,
+                        expr,
+                        f"unknown failpoint action '{action}' in spec entry '{entry}' "
+                        f"(known: {', '.join(sorted(actions))})",
+                        scope="<module>",
+                    )
+
+    def _unknown_name(
+        self, module: Module, node: ast.AST, name: str, known: Set[str], via: str
+    ) -> Finding:
+        hint_names = ", ".join(sorted(n for n in known if n.split(".")[0] == name.split(".")[0]))
+        extra = f" — nearby registered: {hint_names}" if hint_names else ""
+        return self.finding(
+            module,
+            node,
+            f"failpoint name '{name}' (via {via}) is not in KNOWN_FAILPOINTS{extra}",
+            scope="<module>",
+        )

@@ -1,0 +1,106 @@
+"""SA001 — host synchronization inside jit-traced code.
+
+A single ``.item()``, ``float(tracer)``, ``np.asarray(tracer)``,
+``jax.device_get`` or ``print`` inside a jit-reachable function either fails
+at trace time (array conversion of a tracer) or — worse — silently runs at
+trace time only / forces a device round-trip per call, costing the order of
+magnitude the fused paths exist to save. The dynamic counterpart is the
+``jax.transfer_guard`` tests; this rule catches the pattern at the source.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Rule
+from sheeprl_tpu.analysis.pyutil import (
+    call_name,
+    last_segment,
+    names_in,
+    tainted_names,
+    walk_own,
+)
+
+# device->host pulls regardless of the argument (the receiver is device data
+# by construction, or the call itself is the sync)
+_ALWAYS_HOST_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+_ALWAYS_HOST_SYNC_CALLS = {"jax.device_get", "device_get", "jax.block_until_ready"}
+# host-materializing constructors: a pull when fed a tracer-tainted value
+_NUMPY_MATERIALIZERS = {"asarray", "array", "ascontiguousarray"}
+_NUMPY_MODULES = {"np", "numpy", "onp"}
+_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+class HostSyncRule(Rule):
+    id = "SA001"
+    name = "host-sync-in-traced-code"
+    severity = "error"
+    hint = (
+        "keep the value on device (jnp ops), move the pull outside the jitted "
+        "function, or use jax.debug.print / jax.debug.callback for tracing-safe output"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for module in ctx.modules:
+            for fi in ctx.callgraph.traced_functions(module.rel):
+                taint = tainted_names(fi.node)
+                for node in walk_own(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = call_name(node)
+                    seg = last_segment(name)
+                    if seg in _ALWAYS_HOST_SYNC_ATTRS and isinstance(node.func, ast.Attribute):
+                        yield self.finding(
+                            module,
+                            node,
+                            f".{seg}() in jit-traced '{fi.qualname}' forces a device->host sync",
+                            scope=fi.qualname,
+                        )
+                    elif name in _ALWAYS_HOST_SYNC_CALLS:
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{name}() in jit-traced '{fi.qualname}' pulls device data to host",
+                            scope=fi.qualname,
+                        )
+                    elif name == "print":
+                        yield self.finding(
+                            module,
+                            node,
+                            f"print() in jit-traced '{fi.qualname}' runs at trace time only "
+                            "(and never per step)",
+                            scope=fi.qualname,
+                        )
+                    elif (
+                        seg in _NUMPY_MATERIALIZERS
+                        and name is not None
+                        and name.split(".", 1)[0] in _NUMPY_MODULES
+                        and self._args_tainted(node, taint)
+                    ):
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{name}() on a traced value in '{fi.qualname}' materializes a "
+                            "tracer on host (TracerArrayConversionError or a silent pull)",
+                            scope=fi.qualname,
+                        )
+                    elif (
+                        name in _CAST_BUILTINS
+                        and node.args
+                        and self._args_tainted(node, taint)
+                    ):
+                        yield self.finding(
+                            module,
+                            node,
+                            f"{name}() on a traced value in '{fi.qualname}' concretizes the "
+                            "tracer (TracerBoolConversionError / host sync)",
+                            scope=fi.qualname,
+                        )
+
+    @staticmethod
+    def _args_tainted(call: ast.Call, taint: set) -> bool:
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if names_in(arg) & taint:
+                return True
+        return False

@@ -1,0 +1,230 @@
+"""SA002 — PRNG key reuse.
+
+Consuming the same key twice produces **correlated randomness**: two dropout
+masks that agree, two exploration streams in lockstep — statistically wrong
+results with no crash. The discipline everywhere in this repo is
+"split-before-use": every consumption gets a fresh key from
+``jax.random.split`` / ``fold_in``. This rule tracks key-typed names through
+each function body and flags (a) a second consumption without an intervening
+reassignment and (b) consumption inside a loop of a key minted outside it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Module, Rule
+from sheeprl_tpu.analysis.pyutil import (
+    FUNCTION_NODES,
+    call_name,
+    last_segment,
+    stmt_assigned_names,
+)
+
+# calls that MINT keys: assigning from them makes the target a key name
+_KEY_SOURCES = {"PRNGKey", "split", "fold_in", "key", "clone"}
+# passing a key here neither consumes nor invalidates it
+_NEUTRAL_SINKS = {
+    "split",
+    "fold_in",
+    "PRNGKey",
+    "key_data",
+    "device_put",
+    "device_get",
+    "to_mesh",
+    "spec_like",
+    "specs_of",
+    "block_until_ready",
+    "append",
+    "isinstance",
+    "len",
+    "type",
+    "repr",
+    "str",
+    "id",
+}
+
+
+@dataclass
+class _KeyState:
+    minted_line: int
+    minted_loops: Tuple[int, ...]  # id-stack of enclosing loops at mint time
+    consumed_at: Optional[int] = None
+    flagged: bool = False
+    loop_flagged: Set[int] = field(default_factory=set)
+
+
+class PrngKeyReuseRule(Rule):
+    id = "SA002"
+    name = "prng-key-reuse"
+    severity = "error"
+    hint = (
+        "split before every consumption: `key, sub = jax.random.split(key)` (or "
+        "fold_in a loop/shard index) so each use sees an independent stream"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for module in ctx.modules:
+            yield from self._check_tree(module, module.tree)
+
+    def _check_tree(self, module: Module, tree: ast.AST) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if isinstance(node, FUNCTION_NODES):
+                yield from self._check_function(module, node)
+
+    # ----- per-function linear scan ----------------------------------------
+    def _check_function(self, module: Module, fn: ast.AST) -> Iterator[Finding]:
+        keys: Dict[str, _KeyState] = {}
+        findings: List[Finding] = []
+
+        def mint(name: str, line: int, loops: Tuple[int, ...]) -> None:
+            keys[name] = _KeyState(minted_line=line, minted_loops=loops)
+
+        def visit_expr(
+            expr: ast.AST, loops: Tuple[int, ...], rebinding: Set[str] = frozenset()
+        ) -> None:
+            """Find key consumptions in an expression (calls taking a key arg)."""
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                seg = last_segment(call_name(node)) or ""
+                neutral = seg in _NEUTRAL_SINKS
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if not isinstance(arg, ast.Name) or arg.id not in keys:
+                        continue
+                    state = keys[arg.id]
+                    if neutral:
+                        continue
+                    if arg.id in rebinding:
+                        # key threading: `out, key = f(obs, key)` — the callee
+                        # returns the split successor, no reuse possible
+                        continue
+                    line = getattr(node, "lineno", getattr(fn, "lineno", 1))
+                    # (b) consumption in a loop the key was minted outside of
+                    inner = [l for l in loops if l not in state.minted_loops]
+                    if inner and inner[-1] not in state.loop_flagged:
+                        state.loop_flagged.add(inner[-1])
+                        findings.append(
+                            self.finding(
+                                module,
+                                node,
+                                f"key '{arg.id}' (minted at line {state.minted_line}) is "
+                                f"consumed inside a loop without a per-iteration split — "
+                                "every iteration sees the SAME randomness",
+                                scope=self._qualname(fn),
+                            )
+                        )
+                        continue
+                    # (a) second consumption without reassignment
+                    if state.consumed_at is not None and not state.flagged:
+                        state.flagged = True
+                        findings.append(
+                            self.finding(
+                                module,
+                                node,
+                                f"key '{arg.id}' already consumed at line "
+                                f"{state.consumed_at} is consumed again without an "
+                                "intervening split/fold_in — correlated randomness",
+                                scope=self._qualname(fn),
+                            )
+                        )
+                    elif state.consumed_at is None:
+                        state.consumed_at = line
+
+        def clone_state(s: _KeyState) -> _KeyState:
+            return _KeyState(
+                minted_line=s.minted_line,
+                minted_loops=s.minted_loops,
+                consumed_at=s.consumed_at,
+                flagged=s.flagged,
+                loop_flagged=set(s.loop_flagged),
+            )
+
+        def visit_block(body, loops: Tuple[int, ...]) -> None:
+            for stmt in body:
+                if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+                    continue
+                # scan the statement's own expressions (not its nested blocks)
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return)):
+                    if getattr(stmt, "value", None) is not None:
+                        visit_expr(stmt.value, loops, stmt_assigned_names(stmt))
+                elif isinstance(stmt, (ast.If, ast.While)):
+                    visit_expr(stmt.test, loops)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    visit_expr(stmt.iter, loops)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        visit_expr(item.context_expr, loops)
+                elif isinstance(stmt, ast.Assert):
+                    visit_expr(stmt.test, loops)
+                elif isinstance(stmt, ast.Raise) and stmt.exc is not None:
+                    visit_expr(stmt.exc, loops)
+                # (re)bindings AFTER the RHS was scanned: `k, sub = split(k)`
+                bound = stmt_assigned_names(stmt)
+                if bound:
+                    minted = self._is_key_mint(stmt)
+                    for name in bound:
+                        if minted:
+                            mint(name, getattr(stmt, "lineno", 1), loops)
+                        elif name in keys:
+                            del keys[name]  # rebound to something else: not a key anymore
+                # recurse into nested blocks
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    visit_block(stmt.body, loops + (id(stmt),))
+                    visit_block(stmt.orelse, loops)
+                elif isinstance(stmt, ast.If):
+                    # branches are mutually exclusive: each starts from the
+                    # pre-if key state, and a key consumed in only ONE branch
+                    # is NOT consumed after the if (the k_rep-in-if/else
+                    # pattern in dreamer agents is legal)
+                    snapshot = {n: clone_state(s) for n, s in keys.items()}
+                    visit_block(stmt.body, loops)
+                    body_keys = dict(keys)
+                    keys.clear()
+                    keys.update({n: clone_state(s) for n, s in snapshot.items()})
+                    visit_block(stmt.orelse, loops)
+                    merged: Dict[str, _KeyState] = {}
+                    for n in set(body_keys) & set(keys):
+                        b, o = body_keys[n], keys[n]
+                        m = clone_state(b)
+                        m.consumed_at = (
+                            b.consumed_at
+                            if (b.consumed_at is not None and o.consumed_at is not None)
+                            else None
+                        )
+                        m.flagged = b.flagged or o.flagged
+                        m.loop_flagged = b.loop_flagged | o.loop_flagged
+                        merged[n] = m
+                    keys.clear()
+                    keys.update(merged)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    visit_block(stmt.body, loops)
+                elif isinstance(stmt, ast.Try):
+                    visit_block(stmt.body, loops)
+                    for handler in stmt.handlers:
+                        visit_block(handler.body, loops)
+                    visit_block(stmt.orelse, loops)
+                    visit_block(stmt.finalbody, loops)
+
+        visit_block(fn.body, ())
+        yield from findings
+
+    @staticmethod
+    def _is_key_mint(stmt: ast.stmt) -> bool:
+        value = getattr(stmt, "value", None)
+        if value is None:
+            return False
+        # direct call, or subscript of a split result: split(key)[0]
+        node = value
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if isinstance(node, ast.Call):
+            seg = last_segment(call_name(node))
+            return seg in _KEY_SOURCES
+        return False
+
+    @staticmethod
+    def _qualname(fn: ast.AST) -> str:
+        return getattr(fn, "name", "<lambda>")

@@ -1,0 +1,223 @@
+"""SA004 — retrace hazards.
+
+Every retrace recompiles the whole program: on TPU that is seconds-to-minutes
+of XLA time billed per occurrence, and the ``GuardedFn`` retrace budget in
+``core/compile.py`` exists precisely to surface it at runtime. This rule flags
+the three static shapes that cause it:
+
+a. **Python ``if`` on a traced value** — branching on a tracer either raises
+   ``TracerBoolConversionError`` at trace time or, when the value happens to be
+   concrete, bakes one branch into the executable and silently retraces when
+   the other is taken. (``is None`` checks, ``isinstance``, ``len()``, and
+   static tracer attributes like ``.shape``/``.ndim`` are fine and excluded.)
+b. **jit call inside a Python loop** — ``jit(f)(x)`` inside ``for``/``while``
+   re-wraps (and re-caches) per iteration; hoist the wrapped callable out.
+c. **non-hashable static arg** — passing a list/dict/set literal at a
+   position declared in ``static_argnums`` fails hashing and retraces (or
+   raises) on every call.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set
+
+from sheeprl_tpu.analysis.engine import Context, Finding, Module, Rule
+from sheeprl_tpu.analysis.pyutil import (
+    FUNCTION_NODES,
+    STATIC_TRACER_ATTRS,
+    call_name,
+    int_literal_seq,
+    last_segment,
+    tainted_names,
+    walk_own,
+)
+
+_JIT_NAMES = {"jit", "guarded_jit"}
+_SAFE_TEST_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable"}
+
+
+class RetraceHazardRule(Rule):
+    id = "SA004"
+    name = "retrace-hazard"
+    severity = "warning"
+    hint = (
+        "branch with lax.cond/jnp.where instead of Python `if`; hoist jit() out of "
+        "loops; pass tuples (hashable) for static args"
+    )
+
+    def run(self, ctx: Context) -> Iterator[Finding]:
+        for module in ctx.modules:
+            # (a) only inside jit-traced functions — host code may branch freely
+            for fi in ctx.callgraph.traced_functions(module.rel):
+                yield from self._check_traced_branches(module, fi)
+            # (b) + (c) anywhere: the loop/static-arg hazard lives in host code
+            for node in ast.walk(module.tree):
+                if isinstance(node, FUNCTION_NODES):
+                    yield from self._check_jit_in_loop(module, node)
+                    yield from self._check_static_args(module, node)
+
+    # ----- (a) Python `if` on a tracer --------------------------------------
+    def _check_traced_branches(self, module: Module, fi) -> Iterator[Finding]:
+        taint = tainted_names(fi.node)
+        if not taint:
+            return
+        for node in walk_own(fi.node):
+            if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                continue
+            hazard = self._tainted_test_name(node.test, taint)
+            if hazard is None:
+                continue
+            kind = "while" if isinstance(node, ast.While) else "if"
+            yield self.finding(
+                module,
+                node,
+                f"Python `{kind}` on traced value '{hazard}' in jit-traced "
+                f"'{fi.qualname}' — concretization error or a silent retrace per branch",
+                scope=fi.qualname,
+            )
+
+    def _tainted_test_name(self, test: ast.AST, taint: Set[str]) -> Optional[str]:
+        """Return the tainted name driving the test, or None if the test is
+        trace-safe (None checks, isinstance, static attrs, ...)."""
+        if isinstance(test, ast.BoolOp):
+            for v in test.values:
+                hit = self._tainted_test_name(v, taint)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._tainted_test_name(test.operand, taint)
+        if isinstance(test, ast.Compare):
+            # `x is None` / `x is not None` are identity checks, never traced
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+                return None
+            # `k in cnn_keys` — membership over python containers, not arrays
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in test.ops):
+                return None
+            # `reduction == "mean"` — string dispatch is static under trace
+            if any(
+                isinstance(side, ast.Constant) and isinstance(side.value, (str, bytes))
+                for side in [test.left] + list(test.comparators)
+            ):
+                return None
+            for side in [test.left] + list(test.comparators):
+                hit = self._tainted_test_name(side, taint)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.Name):
+            return test.id if test.id in taint else None
+        if isinstance(test, ast.Attribute):
+            # cfg.foo / x.shape — static under trace
+            if test.attr in STATIC_TRACER_ATTRS:
+                return None
+            return None  # attribute reads resolve to config/metadata, not tracers
+        if isinstance(test, ast.Call):
+            seg = last_segment(call_name(test))
+            if seg in _SAFE_TEST_CALLS or seg in STATIC_TRACER_ATTRS:
+                return None
+            # float(x) / bool(x) on a tracer is SA001's finding; jnp.any(x)
+            # returns a traced bool -> hazard when its arg is tainted
+            for arg in test.args:
+                hit = self._tainted_test_name(arg, taint)
+                if hit:
+                    return hit
+            return None
+        if isinstance(test, ast.Subscript):
+            return self._tainted_test_name(test.value, taint)
+        return None
+
+    # ----- (b) jit() wrapped inside a loop body -----------------------------
+    def _check_jit_in_loop(self, module: Module, fn: ast.AST) -> Iterator[Finding]:
+        def stmt_exprs(stmt: ast.stmt) -> List[ast.AST]:
+            exprs: List[ast.AST] = []
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return)):
+                if getattr(stmt, "value", None) is not None:
+                    exprs.append(stmt.value)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                exprs.append(stmt.test)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                exprs.append(stmt.iter)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                exprs.extend(item.context_expr for item in stmt.items)
+            return exprs
+
+        def scan(body, in_loop: bool) -> Iterator[Finding]:
+            for stmt in body:
+                if isinstance(stmt, FUNCTION_NODES + (ast.ClassDef,)):
+                    continue
+                if in_loop:
+                    for expr in stmt_exprs(stmt):
+                        for node in ast.walk(expr):
+                            if (
+                                isinstance(node, ast.Call)
+                                and last_segment(call_name(node)) in _JIT_NAMES
+                                and node.args  # bare jit() partial-style is fine
+                            ):
+                                yield self.finding(
+                                    module,
+                                    node,
+                                    f"{last_segment(call_name(node))}(...) constructed inside a "
+                                    "loop re-wraps (and can re-trace) every iteration",
+                                    scope=getattr(fn, "name", "<lambda>"),
+                                )
+                if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    yield from scan(stmt.body, True)
+                    yield from scan(stmt.orelse, in_loop)
+                elif isinstance(stmt, ast.If):
+                    yield from scan(stmt.body, in_loop)
+                    yield from scan(stmt.orelse, in_loop)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    yield from scan(stmt.body, in_loop)
+                elif isinstance(stmt, ast.Try):
+                    yield from scan(stmt.body, in_loop)
+                    for handler in stmt.handlers:
+                        yield from scan(handler.body, in_loop)
+                    yield from scan(stmt.orelse, in_loop)
+                    yield from scan(stmt.finalbody, in_loop)
+
+        yield from scan(fn.body, False)
+
+    # ----- (c) non-hashable literal at a static position --------------------
+    def _check_static_args(self, module: Module, fn: ast.AST) -> Iterator[Finding]:
+        # locally-bound `f = jit(g, static_argnums=(1,))` -> {"f": [1]}
+        static_of: Dict[str, List[int]] = {}
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            if not isinstance(value, ast.Call):
+                continue
+            if last_segment(call_name(value)) not in _JIT_NAMES:
+                continue
+            positions: Optional[List[int]] = None
+            for kw in value.keywords:
+                if kw.arg == "static_argnums":
+                    positions = int_literal_seq(kw.value)
+            if not positions:
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    static_of[target.id] = positions
+                elif isinstance(target, ast.Attribute):
+                    static_of[target.attr] = positions
+        if not static_of:
+            return
+        for node in walk_own(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = last_segment(call_name(node))
+            if seg not in static_of:
+                continue
+            for pos in static_of[seg]:
+                if pos < len(node.args) and isinstance(
+                    node.args[pos], (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+                ):
+                    yield self.finding(
+                        module,
+                        node.args[pos],
+                        f"non-hashable literal at static position {pos} of '{seg}' — "
+                        "static args are cache keys and must hash (use a tuple)",
+                        scope=getattr(fn, "name", "<lambda>"),
+                    )

@@ -49,6 +49,34 @@ _logger = logging.getLogger("sheeprl_tpu.compile")
 # process-relative clock zero for ``first_call_s`` (time-to-first-step metrics)
 _T0 = time.perf_counter()
 
+# Wrapper callables whose function arguments enter a jax trace. This is the
+# root set of sheeprl_tpu.analysis's jit-reachability call graph, which reads
+# it STATICALLY (ast.literal_eval) — keep it a pure literal tuple of final
+# name segments ("jax.jit" and "jit" both match "jit"). The builtin-colliding
+# "map" (lax.map) is deliberately absent: matching every call to map() would
+# drown the graph in false entry points.
+JIT_ENTRY_WRAPPERS: Tuple[str, ...] = (
+    "jit",
+    "guarded_jit",
+    "aot_compile",
+    "shard_map",
+    "_shard_map",
+    "scan",
+    "associative_scan",
+    "fori_loop",
+    "while_loop",
+    "cond",
+    "switch",
+    "vmap",
+    "pmap",
+    "grad",
+    "value_and_grad",
+    "checkpoint",
+    "remat",
+    "custom_vjp",
+    "custom_jvp",
+)
+
 # --------------------------------------------------------------------------- #
 # Config group
 # --------------------------------------------------------------------------- #

@@ -83,6 +83,78 @@ class FailpointSpecError(ValueError):
 
 _ACTIONS = ("raise", "sleep", "hang", "kill", "signal", "truncate", "corrupt", "drop", "fire")
 
+# --------------------------------------------------------------------------- #
+# Canonical failpoint registry
+# --------------------------------------------------------------------------- #
+# Every failpoint() call site in the tree, keyed by name, with the plane that
+# owns it and what firing there simulates. This is DOCUMENTATION + DRIFT
+# PROTECTION, not an allowlist: failpoint()/configure() accept any name (unit
+# tests mint throwaway ones), but spec_entry() below and the SA005 rule in
+# sheeprl_tpu.analysis (which reads this dict statically) both resolve names
+# against it, so a typo'd drill fails loudly instead of silently injecting
+# nothing. Keep the literal dict parseable by ast: str keys, dict values.
+KNOWN_FAILPOINTS: Dict[str, Dict[str, str]] = {
+    "ckpt.pre_fsync": {"plane": "checkpoint", "doc": "crash before the manifest fsync (torn write)"},
+    "ckpt.finalize": {"plane": "checkpoint", "doc": "crash between payload write and manifest rename"},
+    "ckpt.load": {"plane": "checkpoint", "doc": "corrupt/failed restore on the resume path"},
+    "transport.kv_set": {"plane": "transport", "doc": "weight-push KV write fails"},
+    "transport.kv_get": {"plane": "transport", "doc": "weight-pull KV read fails"},
+    "transport.player_crash": {"plane": "transport", "doc": "player process dies mid-stream"},
+    "control.kv_set": {"plane": "control", "doc": "control-plane KV write fails"},
+    "control.kv_get": {"plane": "control", "doc": "control-plane KV read fails"},
+    "control.chunk_send": {"plane": "control", "doc": "outbound control chunk dropped/corrupted"},
+    "control.chunk_recv": {"plane": "control", "doc": "inbound control chunk dropped/corrupted"},
+    "reload.canary": {"plane": "serve", "doc": "canary model fails during a hot reload"},
+    "orchestrate.journal": {"plane": "orchestrate", "doc": "journal append fails (torn orchestrator state)"},
+    "orchestrate.spawn": {"plane": "orchestrate", "doc": "member spawn fails at process start"},
+    "orchestrate.inject": {"plane": "orchestrate", "doc": "periodic orchestrator-driven member fault"},
+    "env.step": {"plane": "env", "doc": "environment step raises/hangs"},
+    "env.reset": {"plane": "env", "doc": "environment reset raises/hangs"},
+    "env.autoreset": {"plane": "env", "doc": "autoreset path misbehaves after episode end"},
+    "preempt.iteration": {"plane": "train", "doc": "preemption signal at a training-iteration boundary"},
+    "train.fused_update": {"plane": "train", "doc": "fused in-graph update step fails"},
+}
+
+
+def register(name: str, plane: str, doc: str = "") -> None:
+    """Add a failpoint to the canonical registry at runtime (plugins/tests that
+    ship their own sites and still want spec_entry() validation)."""
+    KNOWN_FAILPOINTS[name] = {"plane": plane, "doc": doc}
+
+
+def known() -> Dict[str, Dict[str, str]]:
+    """Snapshot of the canonical registry (name -> {plane, doc})."""
+    return {k: dict(v) for k, v in KNOWN_FAILPOINTS.items()}
+
+
+def spec_entry(name: str, action: str, arg: str = "", trigger: str = "") -> str:
+    """Build one validated ``SHEEPRL_TPU_FAILPOINTS`` entry.
+
+    Drills that assemble spec strings by hand get no spelling protection —
+    an unknown name configures a failpoint nobody evaluates and the drill
+    "passes" without injecting anything. This helper fails fast instead::
+
+        spec = ",".join([
+            failpoints.spec_entry("control.chunk_send", "drop", trigger="every=3"),
+            failpoints.spec_entry("transport.player_crash", "kill", "9", "hit=2"),
+        ])
+    """
+    if name not in KNOWN_FAILPOINTS:
+        raise FailpointSpecError(
+            f"unknown failpoint name {name!r}; known: {', '.join(sorted(KNOWN_FAILPOINTS))} "
+            "(register() it first for custom sites)"
+        )
+    if action not in _ACTIONS:
+        raise FailpointSpecError(
+            f"unknown failpoint action {action!r}; known: {', '.join(_ACTIONS)}"
+        )
+    fields = [name, action]
+    if arg:
+        fields.append(arg)
+    if trigger:
+        fields.append(trigger)
+    return ":".join(fields)
+
 
 @dataclass
 class _Spec:

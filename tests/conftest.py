@@ -64,6 +64,13 @@ def pytest_configure(config):
         "metrics fabric, device introspection, trace-id propagation; select with "
         "`-m telemetry` before touching telemetry/ or its instrumentation seams",
     )
+    config.addinivalue_line(
+        "markers",
+        "analysis: the JAX-invariant static analyzer (sheeprl_tpu/analysis/) — rule "
+        "fixtures, call-graph reachability, baseline round-trips, and the tree-wide "
+        "self-lint; select with `-m analysis` (or run scripts/lint.sh) before "
+        "touching analysis/ or code the self-lint covers",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

@@ -1,0 +1,33 @@
+import os
+
+import pytest
+
+FIXTURE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+PACKAGE_DIR = os.path.join(REPO_ROOT, "sheeprl_tpu")
+
+
+def collect_markers(path):
+    """(line, rule) pairs for every `# VIOLATION:SA00x` marker in a fixture."""
+    expected = []
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, line in enumerate(f, start=1):
+            if "# VIOLATION:" in line:
+                rule = line.split("# VIOLATION:", 1)[1].split()[0].strip()
+                expected.append((lineno, rule))
+    return expected
+
+
+@pytest.fixture(scope="session")
+def fixture_dir():
+    return FIXTURE_DIR
+
+
+@pytest.fixture(scope="session")
+def package_dir():
+    return PACKAGE_DIR
+
+
+@pytest.fixture(scope="session")
+def repo_root():
+    return REPO_ROOT

@@ -1,0 +1,16 @@
+"""SA001 fixture — host syncs inside a jit-traced function (all flagged)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def traced_step(x, y):
+    val = x.item()  # VIOLATION:SA001
+    jax.device_get(x)  # VIOLATION:SA001
+    print("step", val)  # VIOLATION:SA001
+    host = np.asarray(x + y)  # VIOLATION:SA001
+    flag = float(x)  # VIOLATION:SA001
+    return jnp.sum(x) + flag, host
+
+
+step = jax.jit(traced_step)

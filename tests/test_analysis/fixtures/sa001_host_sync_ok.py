@@ -1,0 +1,21 @@
+"""SA001 near-misses — none of these may flag."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_loop(x):
+    # NOT jit-reachable: host pulls are the point of this function
+    val = x.item()
+    print("logging", val)
+    return np.asarray(x)
+
+
+def traced_step(x):
+    jax.debug.print("x={x}", x=x)  # tracing-safe print
+    shape = x.shape  # static metadata, no sync
+    zeros = np.zeros((4,))  # numpy on a NON-traced value
+    return jnp.sum(x) + zeros.sum(), shape
+
+
+step = jax.jit(traced_step)

@@ -1,0 +1,17 @@
+"""SA002 fixture — PRNG key reuse (double consumption + loop reuse)."""
+import jax
+
+
+def double_use(seed):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))  # VIOLATION:SA002
+    return a + b
+
+
+def loop_reuse(seed, xs):
+    key = jax.random.PRNGKey(seed)
+    total = 0.0
+    for x in xs:
+        total = total + x * jax.random.uniform(key)  # VIOLATION:SA002
+    return total

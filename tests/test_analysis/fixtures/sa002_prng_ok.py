@@ -1,0 +1,38 @@
+"""SA002 near-misses — split-before-use discipline, none may flag."""
+import jax
+
+
+def split_before_use(seed):
+    key = jax.random.PRNGKey(seed)
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    key, sub = jax.random.split(key)
+    b = jax.random.normal(sub, (4,))
+    return a + b
+
+
+def per_iteration_fold(seed, xs):
+    key = jax.random.PRNGKey(seed)
+    total = 0.0
+    for i, x in enumerate(xs):
+        k = jax.random.fold_in(key, i)
+        total = total + x * jax.random.uniform(k)
+    return total
+
+
+def threaded(seed, player, obs_seq):
+    # `..., key = f(..., key)`: the callee returns the split successor
+    key = jax.random.PRNGKey(seed)
+    outs = []
+    for obs in obs_seq:
+        action, key = player.get_actions(obs, key)
+        outs.append(action)
+    return outs
+
+
+def branch_use(seed, flag):
+    # mutually exclusive branches each consume once: legal
+    key = jax.random.PRNGKey(seed)
+    if flag:
+        return jax.random.normal(key, (2,))
+    return jax.random.uniform(key)

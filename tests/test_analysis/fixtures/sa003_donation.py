@@ -1,0 +1,16 @@
+"""SA003 fixture — reading a buffer after passing it at a donated position."""
+import jax
+
+
+def run(train, state, batch):
+    step = jax.jit(train, donate_argnums=(0,))
+    new_state = step(state, batch)
+    loss = state["loss"]  # VIOLATION:SA003
+    return new_state, loss
+
+
+def loop_run(train, state, batches):
+    step = jax.jit(train, donate_argnums=(0,))
+    for batch in batches:
+        out = step(state, batch)  # VIOLATION:SA003 (iteration 2 reads donated state)
+    return out

@@ -1,0 +1,21 @@
+"""SA003 near-misses — donated carries rebound in the same statement."""
+import jax
+
+
+def run(train, state, batch):
+    step = jax.jit(train, donate_argnums=(0,))
+    state = step(state, batch)  # rebound from the result: alive again
+    return state["loss"]
+
+
+def loop_run(train, state, batches):
+    step = jax.jit(train, donate_argnums=(0,))
+    for batch in batches:
+        state = step(state, batch)  # carry threads through the loop
+    return state
+
+
+def no_donation(train, state, batch):
+    step = jax.jit(train)  # nothing donated
+    out = step(state, batch)
+    return out, state["loss"]

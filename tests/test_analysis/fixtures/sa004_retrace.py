@@ -1,0 +1,24 @@
+"""SA004 fixture — retrace hazards (traced branch, jit-in-loop, unhashable static)."""
+import jax
+import jax.numpy as jnp
+
+
+def traced_branch(x):
+    if x > 0:  # VIOLATION:SA004
+        return jnp.log(x)
+    return jnp.log(-x)
+
+
+branchy = jax.jit(traced_branch)
+
+
+def loopy(f, xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(f)(x))  # VIOLATION:SA004
+    return out
+
+
+def static_list(f):
+    g = jax.jit(f, static_argnums=(1,))
+    return g(1.0, [4, 5])  # VIOLATION:SA004

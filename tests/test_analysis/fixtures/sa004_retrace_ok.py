@@ -1,0 +1,29 @@
+"""SA004 near-misses — static branches, hoisted jit, hashable statics."""
+import jax
+import jax.numpy as jnp
+
+
+def traced_branch_ok(x, n: int, reduction: str = "mean"):
+    if x is None:  # identity check: static
+        return jnp.zeros(())
+    if n > 3:  # annotated python int: static under trace
+        x = x * 2.0
+    if reduction == "mean":  # string dispatch: static
+        return jnp.mean(x)
+    return jnp.where(x > 0, jnp.log(jnp.abs(x)), 0.0)  # traced select, no branch
+
+
+branchy = jax.jit(traced_branch_ok, static_argnums=(1, 2))
+
+
+def loop_ok(f, xs):
+    g = jax.jit(f)  # hoisted out of the loop
+    out = []
+    for x in xs:
+        out.append(g(x))
+    return out
+
+
+def static_ok(f):
+    g = jax.jit(f, static_argnums=(1,))
+    return g(1.0, (4, 5))  # tuple: hashable

@@ -1,0 +1,16 @@
+"""SA005 fixture — failpoint name/action drift vs KNOWN_FAILPOINTS."""
+import os
+
+from sheeprl_tpu.core import failpoints
+
+
+def drill():
+    failpoints.failpoint("ckpt.pre_fsnyc")  # VIOLATION:SA005 (typo'd name)
+    failpoints.configure("no.such_point:raise")  # VIOLATION:SA005 (unknown name)
+    failpoints.configure("transport.player_crash:explode")  # VIOLATION:SA005 (unknown action)
+
+
+def env_drill():
+    env = dict(os.environ)
+    env["SHEEPRL_TPU_FAILPOINTS"] = "reload.canray:raise:hit=1"  # VIOLATION:SA005
+    return env

@@ -1,0 +1,20 @@
+"""SA005 near-misses — registered names, valid actions, dynamic specs."""
+import os
+
+from sheeprl_tpu.core import failpoints
+
+
+def drill(n, name):
+    failpoints.failpoint("ckpt.pre_fsync")
+    failpoints.configure(f"preempt.iteration:signal:SIGTERM:hit={n}")
+    failpoints.failpoint(name)  # dynamic name: not statically checkable
+    with failpoints.active("env.step:raise:boom:hit=2"):
+        pass
+
+
+def env_drill():
+    env = dict(os.environ)
+    env["SHEEPRL_TPU_FAILPOINTS"] = failpoints.spec_entry(
+        "orchestrate.inject", "fire", trigger="every=10"
+    )
+    return env

@@ -1,0 +1,9 @@
+"""SA006 fixture — cfg key drift (must be placed under sheeprl_tpu/algos/)."""
+
+
+def train(cfg):
+    lr = cfg.algo.optimizer.lr
+    steps = cfg.algo.total_steps
+    bad = cfg.algo.rolout_steps  # VIOLATION:SA006 (typo'd key)
+    worse = cfg.checkpoint.evrey  # VIOLATION:SA006 (typo'd key)
+    return lr, steps, bad, worse

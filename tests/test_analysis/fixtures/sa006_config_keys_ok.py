@@ -1,0 +1,11 @@
+"""SA006 near-misses — valid keys, sub-config aliases, method/underscore stops."""
+
+
+def train(cfg, sub_cfg):
+    a = cfg.algo.name
+    b = cfg.env.id
+    c = cfg.mlp_layers  # unknown ROOT child: sub-config alias, skipped
+    d = sub_cfg.whatever.deep.chain  # not `cfg`: skipped
+    e = cfg.algo.get("total_steps")  # dict method: validation stops
+    f = cfg.algo._target_  # underscore segment: validation stops
+    return a, b, c, d, e, f

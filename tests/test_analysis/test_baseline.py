@@ -1,0 +1,98 @@
+"""Baseline file: round-trip, justification preservation, apply split."""
+
+import pytest
+
+from sheeprl_tpu.analysis import baseline
+from sheeprl_tpu.analysis.engine import Finding
+
+pytestmark = pytest.mark.analysis
+
+
+def _finding(rule="SA001", path="pkg/a.py", scope="train", match="x.item()", line=7):
+    return Finding(
+        rule=rule,
+        path=path,
+        line=line,
+        col=4,
+        message="host sync in traced code",
+        scope=scope,
+        match=match,
+    )
+
+
+def test_write_load_round_trip(tmp_path):
+    path = str(tmp_path / "baseline.txt")
+    findings = [_finding(), _finding(rule="SA004", scope="loopy", match="jax.jit(f)(x)")]
+    written = baseline.write(findings, path=path)
+    assert [e.justification for e in written] == [baseline.TODO_JUSTIFICATION] * 2
+
+    loaded = baseline.load(path)
+    assert [e.fingerprint for e in loaded] == [f.fingerprint() for f in sorted(
+        findings, key=lambda f: (f.path, f.line, f.rule)
+    )]
+
+
+def test_write_preserves_justifications(tmp_path):
+    path = str(tmp_path / "baseline.txt")
+    f = _finding()
+    baseline.write([f], path=path)
+    justified = [
+        baseline.BaselineEntry(
+            rule=e.rule, path=e.path, scope=e.scope, match=e.match,
+            justification="reviewed: the one unavoidable host sync",
+        )
+        for e in baseline.load(path)
+    ]
+    # regenerate from the same finding at a DIFFERENT line: fingerprint is
+    # line-free, so the justification must survive
+    moved = _finding(line=99)
+    rewritten = baseline.write([moved], path=path, previous=justified)
+    assert rewritten[0].justification == "reviewed: the one unavoidable host sync"
+    assert baseline.load(path)[0].justification == "reviewed: the one unavoidable host sync"
+
+
+def test_write_dedupes_same_fingerprint(tmp_path):
+    path = str(tmp_path / "baseline.txt")
+    entries = baseline.write([_finding(line=7), _finding(line=42)], path=path)
+    assert len(entries) == 1
+
+
+def test_apply_splits_unsuppressed_suppressed_stale():
+    covered = _finding()
+    uncovered = _finding(rule="SA002", scope="roll", match="jax.random.normal(key)")
+    entries = [
+        baseline.BaselineEntry(
+            rule=covered.rule, path=covered.path, scope=covered.scope,
+            match=covered.match, justification="ok",
+        ),
+        baseline.BaselineEntry(
+            rule="SA003", path="gone.py", scope="x", match="y", justification="stale",
+        ),
+    ]
+    unsuppressed, suppressed, stale = baseline.apply([covered, uncovered], entries)
+    assert unsuppressed == [uncovered]
+    assert suppressed == [covered]
+    assert [e.match for e in stale] == ["y"]
+
+
+def test_load_skips_comments_and_rejects_malformed(tmp_path):
+    path = tmp_path / "baseline.txt"
+    path.write_text("# comment\n\nSA001 | a.py | fn | x.item() | why\n")
+    entries = baseline.load(str(path))
+    assert len(entries) == 1 and entries[0].justification == "why"
+
+    path.write_text("SA001 | a.py | fn\n")
+    with pytest.raises(ValueError):
+        baseline.load(str(path))
+
+
+def test_missing_file_loads_empty(tmp_path):
+    assert baseline.load(str(tmp_path / "nope.txt")) == []
+
+
+def test_checked_in_baseline_is_fully_justified():
+    entries = baseline.load()
+    for e in entries:
+        assert e.justification and e.justification != baseline.TODO_JUSTIFICATION, (
+            f"baseline row without a real justification: {e.to_line()}"
+        )

@@ -1,0 +1,140 @@
+"""jit-reachability call graph: entry detection, edge resolution, closure."""
+
+import os
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.analysis import Analyzer
+from sheeprl_tpu.analysis.callgraph import (
+    FALLBACK_JIT_ENTRY_WRAPPERS,
+    load_jit_entry_wrappers,
+)
+
+from tests.test_analysis.conftest import PACKAGE_DIR
+
+pytestmark = pytest.mark.analysis
+
+
+def test_wrappers_load_statically_from_compile_py():
+    wrappers = load_jit_entry_wrappers(PACKAGE_DIR)
+    assert "jit" in wrappers and "guarded_jit" in wrappers and "shard_map" in wrappers
+    # the fallback mirrors core/compile.py's exported list; drift between the
+    # two means one side was edited without the other
+    assert set(wrappers) == set(FALLBACK_JIT_ENTRY_WRAPPERS)
+
+
+def test_wrappers_fall_back_without_compile_py(tmp_path):
+    assert load_jit_entry_wrappers(str(tmp_path)) == FALLBACK_JIT_ENTRY_WRAPPERS
+
+
+def _write_tree(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "a.py").write_text(
+        textwrap.dedent(
+            """\
+            import jax
+            from pkg.b import helper
+
+
+            def train(x):
+                return helper(x)
+
+
+            def never_jitted(x):
+                return helper(x) + 1
+
+
+            step = jax.jit(train, donate_argnums=(0,))
+            """
+        )
+    )
+    (pkg / "b.py").write_text(
+        textwrap.dedent(
+            """\
+            import jax
+            from functools import partial
+
+
+            def helper(x):
+                return inner(x)
+
+
+            def inner(x):
+                return x
+
+
+            def cold(x):
+                return x
+
+
+            @jax.jit
+            def dec_entry(x):
+                return cold_callee(x)
+
+
+            def cold_callee(x):
+                return x
+
+
+            @partial(jax.jit, static_argnums=(1,))
+            def partial_entry(x, n):
+                return x
+
+
+            class Stepper:
+                @jax.jit
+                def step(self, x):
+                    return self.helper_m(x)
+
+                def helper_m(self, x):
+                    return x
+            """
+        )
+    )
+    return tmp_path
+
+
+def test_entry_points_and_closure(tmp_path):
+    root = _write_tree(tmp_path)
+    cg = Analyzer([str(root)], root=str(root), package_dir=PACKAGE_DIR).callgraph
+
+    # entry via wrapper call argument: jax.jit(train)
+    assert cg.is_traced("pkg/a.py", "train")
+    # cross-module edge train -> pkg.b.helper -> inner
+    assert cg.is_traced("pkg/b.py", "helper")
+    assert cg.is_traced("pkg/b.py", "inner")
+    # entry via decorator / @partial(jax.jit, ...)
+    assert cg.is_traced("pkg/b.py", "dec_entry")
+    assert cg.is_traced("pkg/b.py", "cold_callee")
+    assert cg.is_traced("pkg/b.py", "partial_entry")
+    # decorated method, qualified by class
+    assert cg.is_traced("pkg/b.py", "Stepper.step")
+
+    # not reachable from any jit entry
+    assert not cg.is_traced("pkg/a.py", "never_jitted")
+    assert not cg.is_traced("pkg/b.py", "cold")
+
+    entries = cg.entry_points
+    assert ("pkg/a.py", "train") in entries
+    assert ("pkg/b.py", "dec_entry") in entries
+    assert ("pkg/a.py", "never_jitted") not in entries
+
+
+def test_traced_functions_per_module(tmp_path):
+    root = _write_tree(tmp_path)
+    cg = Analyzer([str(root)], root=str(root), package_dir=PACKAGE_DIR).callgraph
+    names = {fi.qualname for fi in cg.traced_functions("pkg/b.py")}
+    assert {"helper", "inner", "dec_entry"} <= names
+    assert "cold" not in names
+    for fi in cg.traced_functions("pkg/b.py"):
+        assert fi.module_rel == "pkg/b.py"
+        assert fi.simple_name == fi.qualname.rsplit(".", 1)[-1]
+
+
+def test_real_tree_has_traced_entry_points():
+    repo_root = os.path.dirname(PACKAGE_DIR)
+    cg = Analyzer([PACKAGE_DIR], root=repo_root, package_dir=PACKAGE_DIR).callgraph
+    assert cg.entry_points, "real tree should expose at least one jit entry"

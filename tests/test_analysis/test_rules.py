@@ -1,0 +1,71 @@
+"""Per-rule fixture tests: every rule catches its violation fixture at the
+exact marked lines, and stays silent on the paired near-miss fixture."""
+
+import os
+import shutil
+
+import pytest
+
+from sheeprl_tpu.analysis import Analyzer
+
+from tests.test_analysis.conftest import FIXTURE_DIR, PACKAGE_DIR, collect_markers
+
+pytestmark = pytest.mark.analysis
+
+# rule id -> (violation fixture, ok fixture, target rel-path dir inside tmp).
+# SA006 only checks algos/serve/orchestrate paths; SA005 skips test-ish paths —
+# fixtures are copied to a neutral (or rule-required) location before analyzing.
+CASES = {
+    "SA001": ("sa001_host_sync.py", "sa001_host_sync_ok.py", "pkg"),
+    "SA002": ("sa002_prng.py", "sa002_prng_ok.py", "pkg"),
+    "SA003": ("sa003_donation.py", "sa003_donation_ok.py", "pkg"),
+    "SA004": ("sa004_retrace.py", "sa004_retrace_ok.py", "pkg"),
+    "SA005": ("sa005_failpoints.py", "sa005_failpoints_ok.py", "pkg"),
+    "SA006": ("sa006_config_keys.py", "sa006_config_keys_ok.py", "sheeprl_tpu/algos"),
+}
+
+
+def _analyze_fixture(tmp_path, fixture_name, target_dir, rule_id):
+    src = os.path.join(FIXTURE_DIR, fixture_name)
+    dst_dir = os.path.join(str(tmp_path), target_dir)
+    os.makedirs(dst_dir, exist_ok=True)
+    dst = os.path.join(dst_dir, fixture_name)
+    shutil.copyfile(src, dst)
+    analyzer = Analyzer([str(tmp_path)], root=str(tmp_path), package_dir=PACKAGE_DIR)
+    return analyzer.run(rule_ids=[rule_id])
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_rule_flags_violation_fixture(tmp_path, rule_id):
+    violation, _, target_dir = CASES[rule_id]
+    expected = collect_markers(os.path.join(FIXTURE_DIR, violation))
+    assert expected, f"fixture {violation} has no VIOLATION markers"
+    findings = _analyze_fixture(tmp_path, violation, target_dir, rule_id)
+    got = sorted((f.line, f.rule) for f in findings)
+    assert got == sorted(expected), (
+        f"{rule_id} findings {got} != expected markers {sorted(expected)}; "
+        f"messages: {[f.message for f in findings]}"
+    )
+    # every finding anchors path:line to the analyzed file
+    for f in findings:
+        assert f.path.endswith(violation)
+        assert f.rule == rule_id
+        assert f.line > 0 and f.message
+
+
+@pytest.mark.parametrize("rule_id", sorted(CASES))
+def test_rule_silent_on_near_miss_fixture(tmp_path, rule_id):
+    _, ok, target_dir = CASES[rule_id]
+    findings = _analyze_fixture(tmp_path, ok, target_dir, rule_id)
+    assert findings == [], (
+        f"{rule_id} false positives on {ok}: "
+        f"{[(f.line, f.message) for f in findings]}"
+    )
+
+
+def test_findings_sorted_and_fingerprint_stable(tmp_path):
+    violation, _, target_dir = CASES["SA001"]
+    f1 = _analyze_fixture(tmp_path, violation, target_dir, "SA001")
+    f2 = _analyze_fixture(tmp_path, violation, target_dir, "SA001")
+    assert [f.fingerprint() for f in f1] == [f.fingerprint() for f in f2]
+    assert f1 == sorted(f1, key=lambda f: (f.path, f.line, f.rule))

@@ -1,0 +1,112 @@
+"""Tier-1 self-lint: the real tree carries zero unsuppressed findings, and a
+seeded violation in a real module is caught with the right rule and line."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from sheeprl_tpu.analysis import Analyzer, baseline
+
+from tests.test_analysis.conftest import PACKAGE_DIR, REPO_ROOT, collect_markers
+
+pytestmark = pytest.mark.analysis
+
+_SELF_LINT_BUDGET_S = 20.0
+
+# one statement per rule, each tagged with the marker collect_markers reads
+_SEEDED_SOURCE = textwrap.dedent(
+    """\
+    import os
+
+    import jax
+    from jax import random
+
+
+    def traced_step(x, y):
+        bad = x.item()  # VIOLATION:SA001
+        key = random.PRNGKey(0)
+        a = random.normal(key)
+        b = random.normal(key)  # VIOLATION:SA002
+        if x > 0:  # VIOLATION:SA004
+            y = y + 1
+        return bad + a + b + y
+
+
+    step = jax.jit(traced_step, donate_argnums=(0,))
+
+
+    def driver(state, batch, cfg):
+        out = step(state, batch)
+        loss = state.mean()  # VIOLATION:SA003
+        os.environ["SHEEPRL_TPU_FAILPOINTS"] = "ckpt.pre_fsnyc:raise"  # VIOLATION:SA005
+        return out, loss, cfg.algo.rolout_steps  # VIOLATION:SA006
+    """
+)
+_SEEDED_REL = "sheeprl_tpu/algos/ppo/_seeded_violation.py"
+
+
+def _lint(paths, root, package_dir):
+    findings = Analyzer(paths, root=root, package_dir=package_dir).run()
+    unsuppressed, suppressed, stale = baseline.apply(findings, baseline.load())
+    return unsuppressed, suppressed, stale
+
+
+def test_real_tree_is_clean_within_budget():
+    t0 = time.monotonic()
+    unsuppressed, _, stale = _lint(
+        [PACKAGE_DIR, os.path.join(REPO_ROOT, "scripts")],
+        root=REPO_ROOT,
+        package_dir=PACKAGE_DIR,
+    )
+    elapsed = time.monotonic() - t0
+    assert unsuppressed == [], "unsuppressed findings:\n" + "\n".join(
+        f"  {f.location()}: {f.rule} {f.message}" for f in unsuppressed
+    )
+    assert stale == [], "stale baseline rows (fix was landed — delete them):\n" + "\n".join(
+        f"  {e.to_line()}" for e in stale
+    )
+    assert elapsed < _SELF_LINT_BUDGET_S, f"self-lint took {elapsed:.1f}s"
+
+
+def test_seeded_violations_fail_the_lint(tmp_path):
+    # copy the real package so baseline fingerprints (rooted at sheeprl_tpu/)
+    # still apply, then plant one violation per rule in a real algo dir
+    copy_pkg = str(tmp_path / "sheeprl_tpu")
+    shutil.copytree(
+        PACKAGE_DIR, copy_pkg, ignore=shutil.ignore_patterns("__pycache__")
+    )
+    seeded_path = os.path.join(str(tmp_path), _SEEDED_REL)
+    with open(seeded_path, "w", encoding="utf-8") as f:
+        f.write(_SEEDED_SOURCE)
+
+    unsuppressed, _, _ = _lint([copy_pkg], root=str(tmp_path), package_dir=copy_pkg)
+    seeded = sorted((f.line, f.rule) for f in unsuppressed if f.path == _SEEDED_REL)
+    expected = sorted(collect_markers(seeded_path))
+    assert seeded == expected, (
+        f"seeded violations {expected} vs detected {seeded}; all unsuppressed: "
+        f"{[(f.path, f.line, f.rule) for f in unsuppressed]}"
+    )
+    # nothing else in the copied tree may surface: the seeds are the only delta
+    assert all(f.path == _SEEDED_REL for f in unsuppressed)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "sheeprl_tpu.analysis", "--format", "json"],
+        cwd=REPO_ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert payload["suppressed"], "baseline suppressions should be reported"
