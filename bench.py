@@ -10,6 +10,13 @@ Two workloads, both on the real chip:
    (XLA-estimated FLOPs per step / elapsed / chip peak). Reference anchor:
    ~14 h for Atari-100K on an RTX 3080 (README.md:44-51) ≈ 1 g-step/s at
    replay_ratio 1 — reported as ``dv3_vs_baseline``.
+
+Every record is also appended to the persistent cross-run ledger
+(``benchmarks/ledger.jsonl`` or ``--ledger``/``$SHEEPRL_TPU_BENCH_LEDGER``),
+and ``bench.py --check-regressions`` runs the regression sentinel over it:
+the newest round's SPS/MFU/p99/peak-HBM metrics against the median of prior
+same-status rounds with direction-aware per-metric thresholds, exiting 4 (and
+emitting ``Regress/*`` rows) on a breach. See howto/observability.md.
 """
 
 from __future__ import annotations
@@ -1094,6 +1101,165 @@ _METRIC_UNITS = {
 }
 
 
+# ---------------------------------------------------------------------------
+# Cross-run regression sentinel (persistent ledger + --check-regressions)
+# ---------------------------------------------------------------------------
+
+_LEDGER_ENV = "SHEEPRL_TPU_BENCH_LEDGER"
+
+# Direction-aware sentinel classes: key-substring -> (direction, default
+# threshold fraction vs the median of prior rounds). Throughput and MFU must
+# not fall; latencies, peak HBM, and overhead must not grow. Thresholds are
+# per-class because the metrics' noise floors differ by an order of magnitude
+# (SPS medians are stable to ~10%; p99 latency on a shared host is not).
+_SENTINEL_CLASSES = (
+    ("_per_sec", "higher", 0.10),
+    ("mfu", "higher", 0.10),
+    ("_p99_ms", "lower", 0.25),
+    ("_p50_ms", "lower", 0.25),
+    ("hbm_peak", "lower", 0.05),
+    ("overhead_pct", "lower", 0.50),
+)
+
+
+def _ledger_path(override=None) -> str:
+    import os
+
+    return (
+        override
+        or os.environ.get(_LEDGER_ENV)
+        or os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "ledger.jsonl")
+    )
+
+
+def _append_ledger(result: dict, path=None) -> None:
+    """Append this round's record to the persistent cross-run ledger. Never
+    raises — losing a history row must not cost the measurement or the
+    one-JSON-line stdout contract."""
+    import os
+
+    from sheeprl_tpu.core import failpoints
+
+    path = _ledger_path(path)
+    try:
+        if failpoints.failpoint("bench.ledger_append", path=path) is failpoints.DROPPED:
+            return
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(result) + "\n")
+    except Exception:
+        pass
+
+
+def _read_bench_ledger(path: str) -> list:
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict):
+                    rows.append(row)
+    except OSError:
+        pass
+    return rows
+
+
+def check_regressions(ledger: str, thresholds: dict | None = None) -> tuple:
+    """The cross-run sentinel: compare the NEWEST ledger round's sentinel
+    metrics (SPS/MFU/p99/peak-HBM classes above) against the median of every
+    prior round that carries the same ``status`` (an ``ok`` round is never
+    judged against ``cpu_fallback`` history). Returns ``(report, rc)`` where
+    the report carries one ``Regress/<metric>`` row per checked metric and rc
+    is 4 on any breach — the CI-gate contract."""
+    import statistics
+
+    thresholds = thresholds or {}
+    rows = _read_bench_ledger(ledger)
+    report = {
+        "metric": "bench_regression_sentinel",
+        "ledger": ledger,
+        "rounds_total": len(rows),
+        "checked": 0,
+        "regressions": [],
+        "status": "ok",
+    }
+    if len(rows) < 2:
+        report["status"] = "skipped"
+        report["skip_reason"] = f"need >= 2 ledger rounds to compare, have {len(rows)}"
+        report["value"] = 0
+        return report, 0
+    current = rows[-1]
+    status = current.get("status", "ok")
+    prior = [r for r in rows[:-1] if r.get("status", "ok") == status]
+    if not prior:
+        report["status"] = "skipped"
+        report["skip_reason"] = f"no prior rounds with status={status!r} to compare against"
+        report["value"] = 0
+        return report, 0
+    report["rounds_prior"] = len(prior)
+    report["current_run_id"] = current.get("run_id")
+    for key in sorted(current):
+        val = current[key]
+        if isinstance(val, bool) or not isinstance(val, (int, float)):
+            continue
+        cls = next(((d, t) for sub, d, t in _SENTINEL_CLASSES if sub in key), None)
+        if cls is None:
+            continue
+        direction, thr = cls
+        thr = float(thresholds.get(key, thr))
+        hist = [
+            float(r[key])
+            for r in prior
+            if isinstance(r.get(key), (int, float)) and not isinstance(r.get(key), bool)
+        ]
+        if not hist:
+            continue
+        med = statistics.median(hist)
+        if med == 0:
+            continue
+        delta_pct = (float(val) - med) / abs(med) * 100.0
+        if direction == "higher":
+            breach = float(val) < med * (1.0 - thr)
+        else:
+            breach = float(val) > med * (1.0 + thr)
+        report["checked"] += 1
+        report[f"Regress/{key}"] = {
+            "current": float(val),
+            "median_prior": med,
+            "n_prior": len(hist),
+            "delta_pct": round(delta_pct, 2),
+            "threshold_pct": round(thr * 100.0, 2),
+            "direction": direction,
+            "breach": bool(breach),
+        }
+        if breach:
+            report["regressions"].append(key)
+    report["value"] = len(report["regressions"])
+    report["unit"] = "regressions"
+    if report["regressions"]:
+        report["status"] = "regressed"
+    return report, (4 if report["regressions"] else 0)
+
+
+def _parse_thresholds(entries) -> dict:
+    out = {}
+    for entry in entries or []:
+        key, _, frac = entry.partition("=")
+        try:
+            out[key.strip()] = float(frac)
+        except ValueError:
+            raise SystemExit(f"--threshold expects KEY=FRACTION, got {entry!r}")
+    return out
+
+
 def _regression_check(result: dict) -> None:
     """Compare this run's PPO median against the newest BENCH_r*.json on disk.
 
@@ -1173,7 +1339,35 @@ if __name__ == "__main__":
         help="pin JAX_PLATFORMS instead of backend auto-discovery (auto keeps jax's "
         "own probing; cpu skips the accelerator tunnel entirely)",
     )
+    parser.add_argument(
+        "--check-regressions",
+        action="store_true",
+        help="run NO workload: compare the newest ledger round's SPS/MFU/p99/peak-HBM "
+        "against the median of prior rounds and exit 4 on a breach (the CI gate)",
+    )
+    parser.add_argument(
+        "--ledger",
+        default=None,
+        help=f"persistent cross-run ledger path (default: benchmarks/ledger.jsonl next "
+        f"to bench.py, or ${_LEDGER_ENV})",
+    )
+    parser.add_argument(
+        "--threshold",
+        action="append",
+        default=[],
+        metavar="METRIC=FRACTION",
+        help="per-metric sentinel threshold override for --check-regressions "
+        "(repeatable; e.g. --threshold serve_p99_ms=0.5)",
+    )
     cli_args = parser.parse_args()
+
+    if cli_args.check_regressions:
+        # a pure ledger read: no backend discovery, no watchdog, no jax import
+        report, rc = check_regressions(
+            _ledger_path(cli_args.ledger), _parse_thresholds(cli_args.threshold)
+        )
+        print(json.dumps(report))
+        sys.exit(rc)
     headline_metric = _target_metric("smoke" if cli_args.smoke else cli_args.target)
 
     if cli_args.platform != "auto":
@@ -1341,4 +1535,15 @@ if __name__ == "__main__":
     # (the watchdog's double-timeout record above — no measurement at all)
     result.setdefault("status", "ok")
     result.update(_provenance())
+    try:
+        # peak HBM across devices (null on backends without memory_stats, i.e.
+        # CPU): the regression sentinel's memory-footprint signal
+        from sheeprl_tpu.telemetry.device import hbm_gauges
+
+        _peak = hbm_gauges().get("Device/hbm_peak_bytes_max")
+        if _peak is not None:
+            result["device_hbm_peak_bytes"] = _peak
+    except Exception:
+        pass
+    _append_ledger(dict(result), cli_args.ledger)
     print(json.dumps(result))

@@ -31,6 +31,8 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
+from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import programs as tel_programs
 from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -185,6 +187,9 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
+    if runtime.is_global_zero and log_dir:
+        # compiled-program ledger for this run (parent-pinned env path wins)
+        tel_programs.configure_default(os.path.join(log_dir, "telemetry", "programs.jsonl"))
 
     ft = resilience.resolve(cfg)
     sentinel = health_mod.HealthSentinel(
@@ -628,6 +633,18 @@ def main(runtime, cfg: Dict[str, Any]):
                                 {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
                                 policy_step,
                             )
+                            # MFU from the compiler's own cost model (ppo.py
+                            # scheme): per-call FLOPs captured off
+                            # cost_analysis() when the fused/split train
+                            # executable AOT-compiled
+                            _train_gfn = fused_trainer.step_fn if fused_trainer is not None else train_fn
+                            _mfu = tel_device.mfu(
+                                getattr(_train_gfn, "last_step_flops", None),
+                                timer_metrics["Time/train_time"] / max(train_step - last_train, 1),
+                                runtime.device,
+                            )
+                            if _mfu is not None:
+                                logger.log_metrics({"Time/mfu": _mfu}, policy_step)
                         if timer_metrics.get("Time/env_interaction_time", 0) > 0:
                             logger.log_metrics(
                                 {

@@ -39,6 +39,7 @@ from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
 from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import programs as tel_programs
 from sheeprl_tpu.telemetry import trace
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -217,6 +218,11 @@ def main(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
+    if runtime.is_global_zero and log_dir:
+        # compiled-program observatory: every AOT compile below (act step,
+        # fused trainer, split train fn) lands a ledger row here — unless a
+        # parent pinned SHEEPRL_TPU_PROGRAMS, which wins (one ledger per tree)
+        tel_programs.configure_default(os.path.join(log_dir, "telemetry", "programs.jsonl"))
 
     # Environment setup: one process drives world_size * num_envs envs (per-rank
     # semantics of the reference are per-device here).

@@ -41,6 +41,8 @@ from sheeprl_tpu.core.pipeline import AsyncEnvStepper, pipeline_enabled
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.factory import make_replay_ring
 from sheeprl_tpu.data.prefetch import DevicePrefetcher
+from sheeprl_tpu.telemetry import device as tel_device
+from sheeprl_tpu.telemetry import programs as tel_programs
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -349,6 +351,9 @@ def _main_ingraph(runtime, cfg: Dict[str, Any]):
     log_dir = get_log_dir(runtime, cfg.root_dir, cfg.run_name, logger=logger)
     runtime.logger = logger
     runtime.print(f"Log dir: {log_dir}")
+    if runtime.is_global_zero and log_dir:
+        # compiled-program ledger for this run (parent-pinned env path wins)
+        tel_programs.configure_default(os.path.join(log_dir, "telemetry", "programs.jsonl"))
 
     sentinel = health_mod.HealthSentinel(
         cfg, log_dir=log_dir if runtime.is_global_zero else None, world_size=1
@@ -437,6 +442,10 @@ def _main_ingraph(runtime, cfg: Dict[str, Any]):
     last_checkpoint = state["last_checkpoint"] if state else 0
     last_train = 0
     train_step = 0
+    # grad-steps (train_step) advance by the ratio grant, so MFU needs the
+    # number of fused-program invocations to recover the per-call wall time
+    train_calls = 0
+    last_train_calls = 0
     cumulative_grad_steps = 0
     # the ring is not checkpointed: a resumed run re-warms it with
     # prefill_iters of uniform-action transitions before training resumes
@@ -540,6 +549,7 @@ def _main_ingraph(runtime, cfg: Dict[str, Any]):
                 if not timer.disabled:
                     jax.block_until_ready(flat_actor)
             train_step += g
+            train_calls += 1
             cumulative_grad_steps += g
 
         venv.fire_autoreset_failpoints(roll_metrics["dones"])
@@ -563,9 +573,18 @@ def _main_ingraph(runtime, cfg: Dict[str, Any]):
                             {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
                             policy_step,
                         )
+                        _mfu = tel_device.mfu(
+                            getattr(train_fn, "last_step_flops", None),
+                            timer_metrics["Time/train_time"]
+                            / max(train_calls - last_train_calls, 1),
+                            runtime.device,
+                        )
+                        if _mfu is not None:
+                            logger.log_metrics({"Time/mfu": _mfu}, policy_step)
                     timer.reset()
                 last_log = policy_step
                 last_train = train_step
+                last_train_calls = train_calls
 
         env_deltas = resilience.drain_env_counters(venv, aggregator)
         jax_compile.drain_compile_counters(aggregator)

@@ -167,6 +167,13 @@ def _apply_global_flags(cfg: dotdict, plane: str = "train") -> None:
     if tel_cfg and bool(tel_cfg.get("trace", False)) and not os.environ.get(trace.ENV_VAR):
         trace.configure(plane=plane, capacity=int(tel_cfg.get("capacity", 16384)))
 
+    # Compiled-program ledger: same env-wins contract as the tracer. With no
+    # explicit path the train loops default it into the run's log dir.
+    if tel_cfg and tel_cfg.get("programs"):
+        from sheeprl_tpu.telemetry import programs as tel_programs
+
+        tel_programs.configure_default(str(tel_cfg["programs"]))
+
     # Reference cli.py:161. Critical on remote accelerators: the train loops fence
     # device work ONLY when timing (block_until_ready costs a full round-trip per
     # train call through a tunnel), so a miswired flag serializes every iteration.

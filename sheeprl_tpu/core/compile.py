@@ -380,9 +380,11 @@ class GuardedFn:
         the specs' abstract signature; matching calls then never trace."""
         sig = abstract_signature(specs, kwspecs)
         t0 = time.perf_counter()
-        exe = jax.jit(self.fun, **self._jit_kwargs).lower(*specs, **kwspecs).compile()
+        lowered = jax.jit(self.fun, **self._jit_kwargs).lower(*specs, **kwspecs)
+        exe = lowered.compile()
         dt = time.perf_counter() - t0
         flops = _cost_flops(exe)
+        _record_program(self, lowered, exe, dt)
         with _LOCK:
             self._aot[_routing_key(sig)] = exe
             if flops is not None:
@@ -479,6 +481,32 @@ class GuardedFn:
         _logger.warning(msg)
         if steady and policy == "halt":
             raise RetraceError(msg)
+
+
+def _record_program(gfn: "GuardedFn", lowered: Any, exe: Any, dt: float) -> None:
+    """Feed the compiled-program observatory (telemetry/programs.py) with the
+    (lowered, compiled) pair of an AOT compile: HLO fingerprint, cost/memory
+    analyses, sharding specs, donation map, compile wall-time. Lazily imported
+    and failure-proof — the ledger is telemetry and must never take down (or
+    even slow past compile time) a compile that succeeded."""
+    try:
+        from sheeprl_tpu.core.failpoints import FailpointError
+        from sheeprl_tpu.telemetry import programs as tel_programs
+    except Exception:  # pragma: no cover - a broken telemetry install
+        return
+    try:
+        tel_programs.record(
+            gfn.name,
+            lowered=lowered,
+            compiled=exe,
+            compile_seconds=dt,
+            jit_kwargs=gfn._jit_kwargs,
+        )
+    except FailpointError:
+        raise  # a chaos drill injected here on purpose; let the caller's
+        # hardening (AOTWarmup's best-effort job loop) absorb it
+    except Exception:
+        pass
 
 
 def _cost_flops(exe: Any) -> Optional[float]:

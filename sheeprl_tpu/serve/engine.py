@@ -256,6 +256,34 @@ class PolicyEngine:
     def ready(self) -> bool:
         return all(self._gfn.aot_ready(*self._batch_specs(b)) for b in self.buckets)
 
+    def program_footprint(self) -> Dict[str, Any]:
+        """Compiled-program ledger summary for THIS engine's act programs: how
+        many bucket executables exist and the worst-case peak-HBM / compile
+        cost among them (the ``stats`` op surfaces it per server)."""
+        from sheeprl_tpu.telemetry import programs as tel_programs
+
+        # every bucket compiles under the same GuardedFn name, so dedupe by
+        # HLO fingerprint (one entry per bucket executable) from the run
+        # ledger when one is configured; the in-memory newest-per-name
+        # snapshot is the fallback
+        path = tel_programs.ledger_path()
+        try:
+            source = tel_programs.read_ledger(path) if path else tel_programs.snapshot()
+        except OSError:
+            source = tel_programs.snapshot()
+        by_fp: Dict[Any, Dict[str, Any]] = {}
+        for r in source:
+            if r.get("name") == self._gfn.name:
+                by_fp[r.get("fingerprint")] = r
+        rows = list(by_fp.values())
+        peaks = [r["memory"]["peak_bytes"] for r in rows if r.get("memory")]
+        secs = [r["compile_seconds"] for r in rows if r.get("compile_seconds") is not None]
+        return {
+            "programs": len(rows),
+            "peak_hbm_bytes_max": max(peaks) if peaks else None,
+            "compile_seconds_total": sum(secs) if secs else 0.0,
+        }
+
     # ----- inference -----------------------------------------------------------------
     def act(self, params: Any, obs_rows: List[Dict[str, np.ndarray]]) -> np.ndarray:
         """Batched act: stack rows, pad to the pow-2 bucket, one fused dispatch,

@@ -250,6 +250,13 @@ class PolicyServer:
         compile_totals = jax_compile.process_stats()
         payload["Compile/retraces"] = compile_totals["retraces"]
         payload["Compile/aot_compiles"] = compile_totals["aot_compiles"]
+        try:
+            fp = self.engine.program_footprint()
+            payload["Programs/act_executables"] = fp["programs"]
+            payload["Programs/act_peak_hbm_bytes_max"] = fp["peak_hbm_bytes_max"]
+            payload["Programs/act_compile_seconds_total"] = fp["compile_seconds_total"]
+        except Exception:  # the ledger is observability; stats must stay up
+            pass
         return payload
 
     def metrics_payload(self) -> Dict[str, Any]:

@@ -18,6 +18,11 @@ sub-modules, one trace id:
   — one process-wide provider registry the existing Serve / Health / Compile
   / Resilience counters plug into, rendered as a Prometheus text-exposition
   op on the serve frontend or a periodic JSONL sink for headless runs.
+- :mod:`sheeprl_tpu.telemetry.programs` — the compiled-program observatory:
+  every AOT compile's HLO fingerprint, cost/memory analysis, sharding specs
+  and compile wall-time appended to a per-run ``programs.jsonl`` (trace-id +
+  git-SHA stamped), with a ``diff`` CLI for cross-run comparison and
+  per-program footprint gauges in the fabric.
 
 Enable spans with ``SHEEPRL_TPU_TRACE=1`` (inherited by subprocesses) or
 ``metric.telemetry.enabled=True`` through any CLI entry point. See
@@ -26,6 +31,6 @@ Enable spans with ``SHEEPRL_TPU_TRACE=1`` (inherited by subprocesses) or
 
 from __future__ import annotations
 
-from sheeprl_tpu.telemetry import device, export, registry, trace
+from sheeprl_tpu.telemetry import device, export, programs, registry, trace
 
-__all__ = ["trace", "device", "registry", "export"]
+__all__ = ["trace", "device", "registry", "export", "programs"]

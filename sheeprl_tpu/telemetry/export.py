@@ -54,6 +54,13 @@ def to_prometheus(
     label_body = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()) if v)
     lines.append(f"# TYPE {_PREFIX}_run_info gauge")
     lines.append(f"{_PREFIX}_run_info{{{label_body}}} 1")
+    # Sanitization is lossy ("Plane/a.b" and "Plane/a_b" both land on
+    # sheeprl_plane_a_b): a duplicate series name is invalid exposition and a
+    # scraper keeps whichever it parses last — a silent overwrite. Dedupe
+    # deterministically instead: first key in sorted order wins the name, later
+    # colliders are dropped and counted so the loss is visible in the scrape.
+    seen: Dict[str, str] = {f"{_PREFIX}_run_info": "<run_info>"}
+    dropped = 0
     for key in sorted(metrics):
         val = metrics[key]
         if isinstance(val, bool):
@@ -61,8 +68,15 @@ def to_prometheus(
         if not isinstance(val, (int, float)):
             continue
         name = sanitize_name(key)
+        if name in seen:
+            dropped += 1
+            continue
+        seen[name] = key
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {float(val):g}")
+    if dropped:
+        lines.append(f"# TYPE {_PREFIX}_export_series_dropped gauge")
+        lines.append(f"{_PREFIX}_export_series_dropped {dropped}")
     return "\n".join(lines) + "\n"
 
 

@@ -79,7 +79,7 @@ def register_default_providers() -> None:
     tracer counters, device memory). Plane-local providers (serve stats,
     health counters) register themselves where their objects are built."""
     from sheeprl_tpu.core import compile as jax_compile
-    from sheeprl_tpu.telemetry import device, trace
+    from sheeprl_tpu.telemetry import device, programs, trace
 
     def _compile_totals() -> Dict[str, Any]:
         totals = jax_compile.process_stats()
@@ -92,3 +92,4 @@ def register_default_providers() -> None:
     register("compile", _compile_totals)
     register("trace", trace.stats)
     register("device", device.hbm_gauges)
+    register("programs", programs.gauges)

@@ -26,31 +26,7 @@ def _free_port() -> int:
 
 @pytest.mark.timeout(300)
 def test_two_process_distributed(tmp_path):
-    port, nproc = _free_port(), 2
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _CHILD, str(port), str(pid), str(nproc), str(tmp_path)],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-        )
-        for pid in range(nproc)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            raise
-        assert p.returncode == 0, f"child failed:\n--- stdout ---\n{out}\n--- stderr ---\n{err}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-
-    by_pid = {o["pid"]: o for o in outs}
+    by_pid, workdir = _run_children(_free_port(), 2, tmp_path)
     assert set(by_pid) == {0, 1}
 
     # rank-0's versioned log dir reached every process
@@ -65,16 +41,33 @@ def test_two_process_distributed(tmp_path):
 
     # checkpoint written exactly once (global-zero only), visible to both
     assert by_pid[0]["ckpt_exists"] and by_pid[1]["ckpt_exists"]
-    ckpts = [f for f in os.listdir(tmp_path) if f.startswith("ckpt_")]
+    ckpts = [f for f in os.listdir(workdir) if f.startswith("ckpt_")]
     assert len(ckpts) == 1
 
 
-def _run_children(port, nproc, tmp_path, mode=None, extra_args=None, timeout=240, child=_CHILD):
+# XLA's CPU-Gloo collective runtime occasionally aborts a rank mid-collective
+# (``gloo::EnforceNotMet ... op.preamble.length <= op.nbytes``) or wedges the
+# world when concurrent collectives race on one TCP pair; the peers then die on
+# the coordination-service fatal. The race lives in jaxlib's C++ runtime (it
+# reproduces at every commit of this repo, CPU backend only) — so a world whose
+# failure matches these signatures is retried on a fresh port + workdir, while
+# a rank that fails for any other reason (assertion, traceback, bad exit) still
+# fails the test on the first attempt.
+_INFRA_RACE_SIGNATURES = (
+    "gloo::EnforceNotMet",
+    "Gloo all-reduce failed",
+    "JAX distributed service detected fatal errors",
+    "Connection reset by peer",
+    "heartbeat timeout",
+)
+
+
+def _spawn_world(port, nproc, workdir, mode, extra_args, timeout, child):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, child, str(port), str(pid), str(nproc), str(tmp_path)]
+            [sys.executable, child, str(port), str(pid), str(nproc), str(workdir)]
             + ([mode] if mode else [])
             + (extra_args[pid] if extra_args else []),
             env=env,
@@ -84,17 +77,52 @@ def _run_children(port, nproc, tmp_path, mode=None, extra_args=None, timeout=240
         )
         for pid in range(nproc)
     ]
-    outs = []
+    results, timed_out = [], False
     for p in procs:
         try:
             out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
+            timed_out = True
             for q in procs:
                 q.kill()
-            raise
-        assert p.returncode == 0, f"child failed:\n--- stdout ---\n{out}\n--- stderr ---\n{err}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
-    return {o["pid"]: o for o in outs}
+            out, err = p.communicate()
+        results.append((p, out, err))
+    return results, timed_out
+
+
+def _run_children(port, nproc, tmp_path, mode=None, extra_args=None, timeout=240, child=_CHILD, attempts=3):
+    per_attempt = max(120, timeout // attempts)
+    last_report = ""
+    for attempt in range(attempts):
+        # fresh workdir per attempt: a crashed world may leave partial run dirs
+        # and checkpoints behind, which would corrupt version-numbering and
+        # write-once assertions on the retry
+        workdir = os.path.join(str(tmp_path), f"attempt{attempt}")
+        os.makedirs(workdir, exist_ok=True)
+        world_port = port if attempt == 0 else _free_port()
+        results, timed_out = _spawn_world(
+            world_port, nproc, workdir, mode, extra_args, per_attempt, child
+        )
+        # report every rank, not just the first nonzero one: when one rank dies
+        # its peers abort on the coordination fatal, and the peer's stderr only
+        # ever says "another task died" — the root cause is in the rank that
+        # exited first
+        report = "\n".join(
+            f"--- rank {i} rc={p.returncode} stdout ---\n{out}\n--- rank {i} stderr ---\n{err}"
+            for i, (p, out, err) in enumerate(results)
+        )
+        if not timed_out and all(p.returncode == 0 for p, _, _ in results):
+            outs = [json.loads(out.strip().splitlines()[-1]) for _, out, _ in results]
+            return {o["pid"]: o for o in outs}, workdir
+        kind = "world timed out" if timed_out else "child failed"
+        last_report = f"{kind} (attempt {attempt + 1}/{attempts}):\n{report}"
+        if not (timed_out or any(sig in report for sig in _INFRA_RACE_SIGNATURES)):
+            break
+        print(
+            f"[multihost] transient collective-runtime failure, retrying on a fresh world\n{last_report}",
+            file=sys.stderr,
+        )
+    pytest.fail(last_report)
 
 
 @pytest.mark.timeout(120)
@@ -134,7 +162,7 @@ def test_coordinator_absent_times_out_fast(tmp_path):
 def test_mismatched_device_counts_rejected(tmp_path):
     """Processes with different local device counts must fail fast with a clear
     error (DP meshes need equal per-rank shards), not die later in sharding."""
-    by_pid = _run_children(
+    by_pid, _ = _run_children(
         _free_port(), 2, tmp_path, "mismatch", extra_args={0: ["2"], 1: ["4"]}
     )
     for pid in (0, 1):
@@ -149,7 +177,7 @@ def test_crosshost_decoupled_ppo_step(tmp_path):
     jitted PPO optimization ran (params changed), stayed bit-identical across
     processes (the XLA allreduce), and the player refresh matches exactly."""
     child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_child.py")
-    by_pid = _run_children(_free_port(), 2, tmp_path, timeout=540, child=child)
+    by_pid, _ = _run_children(_free_port(), 2, tmp_path, timeout=540, child=child)
     for pid in (0, 1):
         assert by_pid[pid]["changed"], "optimization must actually update params"
         assert by_pid[pid]["player_matches"]
@@ -165,7 +193,7 @@ def test_crosshost_decoupled_ppo_cli(tmp_path):
     end-to-end over the cross-process trainer mesh and write the final
     checkpoint (reference multi-node launch, ppo_decoupled.py:623-670)."""
     child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_cli_child.py")
-    by_pid = _run_children(_free_port(), 2, tmp_path, "ppo_decoupled", timeout=540, child=child)
+    by_pid, _ = _run_children(_free_port(), 2, tmp_path, "ppo_decoupled", timeout=540, child=child)
     for pid in (0, 1):
         assert by_pid[pid]["done"]
     assert by_pid[0]["n_ckpts"] >= 1, "the player process must write the final checkpoint"
@@ -177,7 +205,7 @@ def test_crosshost_decoupled_sac_cli(tmp_path):
     samples, trainer processes join on spec-shaped zero templates (reference
     sac_decoupled.py:548-588)."""
     child = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "decoupled_cli_child.py")
-    by_pid = _run_children(_free_port(), 2, tmp_path, "sac_decoupled", timeout=540, child=child)
+    by_pid, _ = _run_children(_free_port(), 2, tmp_path, "sac_decoupled", timeout=540, child=child)
     for pid in (0, 1):
         assert by_pid[pid]["done"]
     assert by_pid[0]["n_ckpts"] >= 1, "the player process must write the final checkpoint"
@@ -187,7 +215,7 @@ def test_crosshost_decoupled_sac_cli(tmp_path):
 def test_resume_under_multihost(tmp_path):
     """Write-once checkpoint -> every process reloads identical state, and the
     resumed run's log dir version-bumps consistently on all processes."""
-    by_pid = _run_children(_free_port(), 2, tmp_path, "resume")
+    by_pid, _ = _run_children(_free_port(), 2, tmp_path, "resume")
     for pid in (0, 1):
         assert by_pid[pid]["iter_num"] == 123
         np.testing.assert_array_equal(

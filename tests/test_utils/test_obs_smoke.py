@@ -1,0 +1,35 @@
+"""Satellite registration of scripts/obs_smoke.py as a tier-1 test: a fresh
+fused-PPO run must land every AOT compile in the trace-id-stamped programs
+ledger, the diff CLI must flag a doctored copy (and pass the self-diff), and
+``bench.py --check-regressions`` must gate a doctored bench ledger — the
+end-to-end proof that the compiled-program observatory stays wired through the
+env, compile, telemetry, and bench layers."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.telemetry
+@pytest.mark.timeout(600)
+def test_obs_smoke(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "obs_smoke.py"),
+            "--workdir",
+            str(tmp_path),
+            "--timeout",
+            "420",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-1500:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "obs smoke OK" in out.stdout
