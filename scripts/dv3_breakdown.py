@@ -5,7 +5,17 @@ achieved MFU, so the slow parts are identified by DATA rather than guesswork.
 Fusion across phases is lost in the per-part jits, so the parts need not sum to
 the fused step — the point is each part's distance from the roofline.
 
-Usage: python scripts/dv3_breakdown.py [batch] [seq]
+Every timed window is also recorded as a span in the unified telemetry tracer
+(telemetry/trace.py): the closing per-phase table is segmented FROM the
+recorded spans (the tracer is the source of truth, not script-local floats),
+and the whole run exports as one Chrome/Perfetto trace whose trace id
+correlates with any enclosing run's telemetry.
+
+Usage: python scripts/dv3_breakdown.py [batch] [seq] [kernels]
+
+``kernels`` feeds ``algo.world_model.kernels`` (off/auto/pallas/interpret/
+reference) — run the script twice (off vs auto) to see what the fused RSSM
+step kernels do to the dynamic-scan and world-model fwd+bwd phases.
 """
 
 from __future__ import annotations
@@ -25,10 +35,12 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_train_fn
 from sheeprl_tpu.algos.dreamer_v3.utils import init_moments
 from sheeprl_tpu.config.loader import load_config
 from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.telemetry import trace
 
 from bench import _chip_peak_flops  # per-chip bf16 peak table (repo root)
 
 _PEAK = None  # resolved from the live device in main(); NaN MFU on unknown chips
+_PHASE = "dv3.phase/"  # span-name prefix the closing table aggregates on
 
 
 def _fence(out):
@@ -57,15 +69,43 @@ def timeit(label, fn, *args, iters=10):
     for _ in range(iters):
         out = jitted(*args)
     _fence(out)
-    dt = (time.perf_counter() - t0) / iters
+    t1 = time.perf_counter()
+    dt = (t1 - t0) / iters
+    trace.add_span(
+        f"{_PHASE}{label}", t0, t1, clock="perf", plane="bench", iters=iters, flops=fl
+    )
     mfu = fl / dt / _PEAK if fl else float("nan")
     print(f"{label:>28}: {dt*1e3:8.1f} ms  {fl/1e12 if fl else 0:7.3f} TFLOP  MFU={mfu:6.3f}")
     return dt
 
 
+def _phase_report():
+    """Segment per-phase time from the recorded spans — the tracer's ring is
+    the single source of truth for what the script just measured."""
+    t = trace.get_tracer()
+    if t is None:
+        return
+    rows = [
+        (ev[trace._EV_NAME][len(_PHASE):], ev[trace._EV_DUR] / 1e6, (ev[trace._EV_ARGS] or {}))
+        for ev in t.events()
+        if ev[trace._EV_PH] == "X" and ev[trace._EV_NAME].startswith(_PHASE)
+    ]
+    if not rows:
+        return
+    total = sum(dur for _, dur, _ in rows)
+    print(f"\nper-phase share (from {len(rows)} tracer spans, trace {t.trace_id}):")
+    for name, dur, args in sorted(rows, key=lambda r: -r[1]):
+        iters = int(args.get("iters") or 1)
+        print(f"{name:>28}: {dur / iters * 1e3:8.1f} ms/iter  {dur / total * 100:5.1f}% of timed wall")
+    print(f"trace exported to: {t.export()}")
+
+
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
     seq = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    kernels = sys.argv[3] if len(sys.argv) > 3 else "off"
+    if trace.get_tracer() is None:
+        trace.configure(plane="bench", export_path=f"logs/telemetry/dv3_breakdown_b{batch}.trace.json")
     cfg = load_config(
         overrides=[
             "exp=dreamer_v3",
@@ -79,6 +119,7 @@ def main():
             "algo.mlp_keys.encoder=[]",
             "algo.mlp_keys.decoder=[]",
             "algo.imagination_scan_unroll=15",
+            f"algo.world_model.kernels={kernels}",
         ]
     )
     runtime = Runtime(accelerator="auto", devices=1, precision=cfg.fabric.precision)
@@ -123,7 +164,11 @@ def main():
     for _ in range(10):
         full(batches, key)
     _fence(state[3])
-    dt = (time.perf_counter() - t0) / 10
+    t1 = time.perf_counter()
+    trace.add_span(
+        f"{_PHASE}FULL fused train step", t0, t1, clock="perf", plane="bench", iters=10, flops=fl
+    )
+    dt = (t1 - t0) / 10
     mfu = fl / dt / _PEAK if fl else float("nan")
     print(f"{'FULL fused train step':>28}: {dt*1e3:8.1f} ms  {fl/1e12 if fl else 0:7.3f} TFLOP  MFU={mfu:6.3f}")
     print("  (NOTE: XLA cost analysis does not scale lax.scan body flops by trip")
@@ -192,6 +237,8 @@ def main():
         return jax.lax.scan(step, (sp, sr), jax.random.split(k, H), unroll=H)[1]
 
     timeit(f"imagination scan (H={H} fwd)", jax.jit(imagine), wm, params["actor"], start_prior, start_rec, key)
+
+    _phase_report()
 
 
 if __name__ == "__main__":

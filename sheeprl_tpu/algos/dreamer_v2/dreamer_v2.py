@@ -36,6 +36,7 @@ from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_episode_replay, make_sequential_replay
 from sheeprl_tpu.ops.distributions import Bernoulli, Independent, Normal, OneHotCategorical
+from sheeprl_tpu.telemetry import device as tel_device
 from sheeprl_tpu.utils.env import finished_episodes, final_observations, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -43,7 +44,7 @@ from sheeprl_tpu.utils.optim import with_clipping
 from sheeprl_tpu.utils.profiler import TraceProfiler
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import DreamerPlayerSync, Ratio, save_configs
+from sheeprl_tpu.utils.utils import NUMPY_TO_JAX_DTYPE, DreamerPlayerSync, Ratio, save_configs
 
 # Obs->latent->action world-model subset the rollout player needs (see
 # PlayerDV2._raw_step); shipped to the player device by DreamerPlayerSync.
@@ -475,6 +476,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
     train_step = 0
     last_train = 0
+    train_calls = 0
+    last_train_calls = 0
     start_iter = (state["iter_num"] // world_size) + 1 if state else 1
     policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
     last_log = state["last_log"] if state else 0
@@ -534,6 +537,56 @@ def main(runtime, cfg: Dict[str, Any]):
     base_expl_amount = float(cfg.algo.actor.get("expl_amount", 0.0))
     expl_decay = float(cfg.algo.actor.get("expl_decay", 0.0))
     expl_min = float(cfg.algo.actor.get("expl_min", 0.0))
+
+    # AOT-compile the train program off the hot path (same recipe as dv3): the
+    # Ratio clone predicts the per-iteration gradient-step counts G, and each
+    # [G, L, B, *feat] signature compiles in a background thread during prefill.
+    # Besides hiding the compile, this is what lands the dv2.train cost-analysis
+    # ledger row and `last_step_flops` for the Time/mfu metric below.
+    warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
+    if warmup.enabled:
+        clone = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+        clone.load_state_dict(ratio.state_dict())
+        unique_g = []
+        sim_policy_step = policy_step
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for sim_iter in range(start_iter, min(total_iters, start_iter + 1024) + 1):
+                sim_policy_step += policy_steps_per_iter
+                if sim_iter >= learning_starts:
+                    g = clone((sim_policy_step - prefill_steps * policy_steps_per_iter) / world_size)
+                    if g > 0 and g not in unique_g:
+                        unique_g.append(g)
+                        if len(unique_g) >= 4:
+                            break
+        seq_len = int(cfg.algo.per_rank_sequence_length)
+        bsz = int(cfg.algo.per_rank_batch_size) * world_size
+        batch_sharding = NamedSharding(runtime.mesh, P(None, None, "data"))
+        feat = {k: tuple(step_data[k].shape[2:]) for k in obs_keys}
+        store_dtype = {k: step_data[k].dtype for k in obs_keys}
+        for k in ("rewards", "truncated", "terminated", "is_first"):
+            feat[k] = (1,)
+            store_dtype[k] = step_data[k].dtype
+        feat["actions"] = (int(np.sum(actions_dim)),)
+        store_dtype["actions"] = np.dtype(np.float32)
+        for g in unique_g:
+            batches_spec = {
+                k: jax.ShapeDtypeStruct(
+                    (g, seq_len, bsz, *feat[k]),
+                    NUMPY_TO_JAX_DTYPE.get(np.dtype(store_dtype[k]), jnp.float32),
+                    sharding=batch_sharding,
+                )
+                for k in feat
+            }
+            warmup.add(
+                train_fn,
+                jax_compile.specs_of(params),
+                jax_compile.specs_of(opt_states),
+                jax_compile.spec_like(counter),
+                batches_spec,
+                jax_compile.spec_like(rng),
+            )
+        warmup.start()
 
     cumulative_per_rank_gradient_steps = 0
     trained_once = False
@@ -656,6 +709,9 @@ def main(runtime, cfg: Dict[str, Any]):
                     n_samples=per_rank_gradient_steps,
                 )
                 with timer("Time/train_time", SumMetric()):
+                    # no-op once the warmup thread finished (first train call at
+                    # the latest; usually hidden behind prefill)
+                    warmup.wait()
                     rng, train_key = jax.random.split(rng)
                     params, opt_states, counter, flat_player, train_metrics = train_fn(
                         params, opt_states, counter, batches, train_key
@@ -667,6 +723,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     psync.push(player, params, flat=flat_player)
                     cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                     train_step += world_size * per_rank_gradient_steps
+                    train_calls += 1
                 if aggregator:
                     aggregator.update_from_device(train_metrics)
 
@@ -696,6 +753,15 @@ def main(runtime, cfg: Dict[str, Any]):
                         {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
                         policy_step,
                     )
+                    # model FLOPs utilization from the AOT cost analysis of the
+                    # G-step train program (same contract as ppo/a2c/sac/dv3)
+                    _mfu = tel_device.mfu(
+                        getattr(train_fn, "last_step_flops", None),
+                        timer_metrics["Time/train_time"] / max(train_calls - last_train_calls, 1),
+                        runtime.device,
+                    )
+                    if _mfu is not None:
+                        logger.log_metrics({"Time/mfu": _mfu}, policy_step)
                 if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
                     logger.log_metrics(
                         {
@@ -709,6 +775,7 @@ def main(runtime, cfg: Dict[str, Any]):
                 timer.reset()
             last_log = policy_step
             last_train = train_step
+            last_train_calls = train_calls
 
         # ---- checkpoint
         env_deltas = resilience.drain_env_counters(envs, aggregator)

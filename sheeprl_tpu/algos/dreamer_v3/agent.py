@@ -613,6 +613,7 @@ class RSSM:
         learnable_initial_recurrent_state: bool = True,
         decoupled: bool = False,
         dynamic_scan_unroll: int = 1,
+        kernels: str = "off",
     ):
         self.recurrent_model = recurrent_model
         self.representation_model = representation_model
@@ -626,6 +627,42 @@ class RSSM:
         # ([B,~1.5k]x[~1.5k,512] at the S preset) are small for the MXU, so unrolling
         # lets XLA overlap/pipeline consecutive steps' HBM reads and MXU work
         self.dynamic_scan_unroll = int(dynamic_scan_unroll)
+        # world_model.kernels knob: off/auto/pallas/interpret/reference. Anything
+        # but "off" routes the dynamic/imagination steps through the fused Pallas
+        # subsystem (ops/pallas/rssm_step.py); "off" is the bitwise flax reference.
+        self.kernels = str(kernels).lower()
+
+    def _fused_spec(self, embed_size: int, action_size: int):
+        """Build the static step spec, or raise KernelUnsupported when this RSSM
+        falls outside the fused-step contract (dispatch then stays on flax)."""
+        from sheeprl_tpu.ops.pallas import rssm_step as _fk
+
+        if self.decoupled:
+            raise _fk.KernelUnsupported("decoupled RSSM has no sequential posterior step")
+        if not (self.recurrent_model.layer_norm and self.representation_model.layer_norm
+                and self.transition_model.layer_norm):
+            raise _fk.KernelUnsupported("fused step requires layer_norm on all RSSM trunks")
+        for m in (self.representation_model, self.transition_model):
+            if str(m.activation) != "silu":
+                raise _fk.KernelUnsupported(f"fused step expects silu trunks, got {m.activation!r}")
+            if len(m.hidden_sizes) != 1:
+                raise _fk.KernelUnsupported("fused step expects single-hidden-layer trunks")
+        return _fk.RSSMStepSpec(
+            action_size=int(action_size),
+            embed_size=int(embed_size),
+            dense_units=int(self.recurrent_model.dense_units),
+            recurrent_size=int(self.recurrent_model.recurrent_state_size),
+            trans_hidden=int(self.transition_model.hidden_sizes[0]),
+            repr_hidden=int(self.representation_model.hidden_sizes[0]),
+            stochastic=self.stochastic_size,
+            discrete=self.discrete_size,
+            unimix=float(self.unimix),
+            eps_in=float(self.recurrent_model.layer_norm_eps),
+            eps_gru=float(self.recurrent_model.layer_norm_eps),
+            eps_trans=float(self.transition_model.layer_norm_eps),
+            eps_repr=float(self.representation_model.layer_norm_eps),
+            dtype=jnp.dtype(self.recurrent_model.dtype).name,
+        )
 
     @property
     def stoch_state_size(self) -> int:
@@ -695,7 +732,18 @@ class RSSM:
         is_first: jax.Array,  # [T, B, 1]
         key: jax.Array,
     ):
-        """lax.scan over the sequence dim: the hot loop of world-model learning."""
+        """lax.scan over the sequence dim: the hot loop of world-model learning.
+
+        With ``kernels != off`` the non-decoupled path dispatches to the fused
+        Pallas step (ops/pallas/rssm_step.py): same return contract, logits in
+        f32, sampling distribution-equivalent (not bitwise) to this path. Any
+        structural mismatch or an active ``train.kernel_dispatch`` failpoint
+        degrades back to the flax scan below.
+        """
+        if self.kernels != "off" and not self.decoupled:
+            fused = self._fused_dynamic_scan(wm_params, embedded_obs, actions, is_first, key)
+            if fused is not None:
+                return fused
         T, B = embedded_obs.shape[0], embedded_obs.shape[1]
         keys = jax.random.split(key, T)
         init_rec = jnp.zeros((B, self.recurrent_model.recurrent_state_size), dtype=embedded_obs.dtype)
@@ -750,8 +798,46 @@ class RSSM:
         posteriors_logits = posteriors_logits.reshape(T, B, self.stochastic_size, self.discrete_size)
         return recurrent_states, posteriors, priors_logits, posteriors_logits
 
+    def _fused_dynamic_scan(self, wm_params, embedded_obs, actions, is_first, key):
+        """Fused-path dispatch; None means fall back to the flax scan."""
+        from sheeprl_tpu.ops.pallas import rssm_step as _fk
+
+        try:
+            spec = self._fused_spec(embedded_obs.shape[-1], actions.shape[-1])
+            impl = _fk.select_impl(self.kernels, spec, embedded_obs.shape[1])
+            if impl is None:
+                return None
+            p = _fk.extract_step_params(wm_params, self.stoch_state_size)
+        except _fk.KernelUnsupported:
+            return None
+        return _fk.fused_dynamic_scan(
+            p,
+            spec.with_impl(impl),
+            wm_params["initial_recurrent_state"],
+            embedded_obs,
+            actions,
+            is_first,
+            key,
+            learnable_init=self.learnable_initial_recurrent_state,
+            unroll=self.dynamic_scan_unroll,
+        )
+
     def imagination_step(self, wm_params, prior_flat: jax.Array, recurrent_state: jax.Array, actions: jax.Array, key):
-        """One-step latent imagination (reference agent.py:482-498)."""
+        """One-step latent imagination (reference agent.py:482-498); dispatches
+        to the fused step under the same ``kernels`` knob as dynamic_scan."""
+        if self.kernels != "off" and not self.decoupled:
+            from sheeprl_tpu.ops.pallas import rssm_step as _fk
+
+            try:
+                spec = self._fused_spec(0, actions.shape[-1])
+                impl = _fk.select_impl(self.kernels, spec, recurrent_state.shape[0])
+                if impl is not None:
+                    p = _fk.extract_step_params(wm_params, self.stoch_state_size)
+                    return _fk.fused_imagination_step(
+                        p, spec.with_impl(impl), prior_flat, recurrent_state, actions, key
+                    )
+            except _fk.KernelUnsupported:
+                pass
         recurrent_state = self._recurrent(wm_params, prior_flat, actions, recurrent_state)
         _, imagined_prior = self._transition(wm_params, recurrent_state, key)
         return imagined_prior.reshape(*prior_flat.shape), recurrent_state
@@ -1006,6 +1092,7 @@ def build_agent(
         learnable_initial_recurrent_state=bool(world_model_cfg.get("learnable_initial_recurrent_state", True)),
         decoupled=decoupled,
         dynamic_scan_unroll=int(world_model_cfg.get("dynamic_scan_unroll", 1)),
+        kernels=str(world_model_cfg.get("kernels", "off")),
     )
 
     cnn_keys_dec = list(cfg.algo.cnn_keys.decoder)

@@ -41,6 +41,7 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_sequential_replay
 from sheeprl_tpu.envs.wrappers import RestartOnException
+from sheeprl_tpu.telemetry import device as tel_device
 from sheeprl_tpu.ops.distributions import (
     BernoulliSafeMode,
     Independent,
@@ -142,8 +143,15 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         )
 
         # ---- batch prep (in-graph: uint8 pixels stay uint8 until HBM)
+        # batch_obs stays f32: these are the reconstruction-loss TARGETS (an f32
+        # island of the precision audit). The encoder gets a compute-dtype view
+        # below — its first layer casts anyway, so the values reaching the first
+        # matmul are bitwise identical, but casting at the batch boundary stops
+        # XLA from materializing the [T,B,C,H,W] normalization in f32 under
+        # bf16-mixed (pure HBM-traffic win, audited in howto/performance.md).
         batch_obs = {k: data[k].astype(jnp.float32) / 255.0 - 0.5 for k in cnn_keys}
         batch_obs.update({k: data[k].astype(jnp.float32) for k in mlp_keys})
+        encoder_obs = {k: v.astype(runtime.compute_dtype) for k, v in batch_obs.items()}
         is_first = data["is_first"].astype(jnp.float32).at[0].set(1.0)
         actions = data["actions"].astype(jnp.float32)
         batch_actions = jnp.concatenate([jnp.zeros_like(actions[:1]), actions[:-1]], axis=0)
@@ -152,7 +160,7 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
 
         # ---- world-model update (Eq. 4)
         def world_loss_fn(wm_params):
-            embedded = modules.encoder.apply(wm_params["encoder"], batch_obs)
+            embedded = modules.encoder.apply(wm_params["encoder"], encoder_obs)
             recurrent_states, posteriors, priors_logits, posteriors_logits = rssm.dynamic_scan(
                 wm_params, embedded, batch_actions, is_first, k_wm
             )
@@ -358,8 +366,19 @@ def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, action
         else:
             critic_skipped = jnp.float32(0.0)
 
-        post_ent = Independent(OneHotCategorical(logits=aux["posteriors_logits"]), 1).entropy().mean()
-        prior_ent = Independent(OneHotCategorical(logits=aux["priors_logits"]), 1).entropy().mean()
+        # f32 island: entropy is a sum of p*log p terms over discrete*stoch
+        # categories — accumulate in f32 even when the RSSM emits bf16 logits
+        # (no-op for f32 runs; the fused kernel path already returns f32 logits)
+        post_ent = (
+            Independent(OneHotCategorical(logits=aux["posteriors_logits"].astype(jnp.float32)), 1)
+            .entropy()
+            .mean()
+        )
+        prior_ent = (
+            Independent(OneHotCategorical(logits=aux["priors_logits"].astype(jnp.float32)), 1)
+            .entropy()
+            .mean()
+        )
         new_params = {
             "world_model": new_wm,
             "actor": new_actor,
@@ -563,6 +582,8 @@ def main(runtime, cfg: Dict[str, Any]):
 
     train_step = 0
     last_train = 0
+    train_calls = 0
+    last_train_calls = 0
     start_iter = (state["iter_num"] // world_size) + 1 if state else 1
     policy_step = state["iter_num"] * cfg.env.num_envs if state else 0
     last_log = state["last_log"] if state else 0
@@ -896,6 +917,7 @@ def main(runtime, cfg: Dict[str, Any]):
                         psync.push(player, params, flat=flat_player)
                         cumulative_per_rank_gradient_steps += per_rank_gradient_steps
                         train_step += world_size * per_rank_gradient_steps
+                        train_calls += 1
                     if aggregator:
                         aggregator.update_from_device(train_metrics)
                     resilience.enforce_nonfinite_policy(ft, train_metrics)
@@ -965,6 +987,16 @@ def main(runtime, cfg: Dict[str, Any]):
                             {"Time/sps_train": (train_step - last_train) / timer_metrics["Time/train_time"]},
                             policy_step,
                         )
+                        # model FLOPs utilization from the AOT cost analysis of the
+                        # G-step train program (same contract as ppo/a2c/sac)
+                        _mfu = tel_device.mfu(
+                            getattr(train_fn, "last_step_flops", None),
+                            timer_metrics["Time/train_time"]
+                            / max(train_calls - last_train_calls, 1),
+                            runtime.device,
+                        )
+                        if _mfu is not None:
+                            logger.log_metrics({"Time/mfu": _mfu}, policy_step)
                     if logger and timer_metrics.get("Time/env_interaction_time", 0) > 0:
                         logger.log_metrics(
                             {
@@ -978,6 +1010,7 @@ def main(runtime, cfg: Dict[str, Any]):
                     timer.reset()
                 last_log = policy_step
                 last_train = train_step
+                last_train_calls = train_calls
 
             # ---- checkpoint
             if (cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every) or (

@@ -15,9 +15,17 @@ import jax.numpy as jnp
 
 def categorical_kl(p_logits: jax.Array, q_logits: jax.Array) -> jax.Array:
     """KL(p || q) for factorized categoricals; inputs ``[..., stoch, discrete]``,
-    output summed over the stoch dim -> ``[...]``."""
-    p_log = jax.nn.log_softmax(p_logits, axis=-1)
-    q_log = jax.nn.log_softmax(q_logits, axis=-1)
+    output summed over the stoch dim -> ``[...]``.
+
+    f32 island (precision audit, ROADMAP 3a): under bf16-mixed the RSSM hands
+    over bf16 logits and the KL is a difference of near-equal log-sum-exps
+    accumulated over stoch*discrete terms — bf16's 8 mantissa bits lose the
+    free-nats comparison long before the loss does. Pinning the logits here is
+    a no-op for f32 runs (health-off bit-parity preserved) and the fused-kernel
+    path already emits f32 logits.
+    """
+    p_log = jax.nn.log_softmax(p_logits.astype(jnp.float32), axis=-1)
+    q_log = jax.nn.log_softmax(q_logits.astype(jnp.float32), axis=-1)
     p = jnp.exp(p_log)
     return jnp.sum(p * (p_log - q_log), axis=(-2, -1))
 

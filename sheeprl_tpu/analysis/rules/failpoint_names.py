@@ -105,12 +105,17 @@ class FailpointNameRule(Rule):
     ) -> Iterator[Finding]:
         for node in ast.walk(module.tree):
             if isinstance(node, ast.Call):
-                seg = last_segment(call_name(node)) or ""
+                dotted = call_name(node) or ""
+                seg = last_segment(dotted) or ""
+                # `configure`/`active` are common method names (trace.configure,
+                # logging handlers, ...): only the failpoints module's — or a
+                # bare `from ... import configure` — consumes spec strings
+                qualifier = dotted.rsplit(".", 2)[-2] if "." in dotted else "failpoints"
                 if seg in _NAME_SINKS and node.args:
                     name = literal_str(node.args[0])
                     if name is not None and name not in known:
                         yield self._unknown_name(module, node.args[0], name, known, seg)
-                elif seg in _SPEC_SINKS:
+                elif seg in _SPEC_SINKS and qualifier == "failpoints":
                     for arg in list(node.args) + [kw.value for kw in node.keywords]:
                         yield from self._check_spec_expr(module, arg, known, actions)
             elif isinstance(node, (ast.Assign, ast.AnnAssign)):

@@ -323,6 +323,9 @@ class GuardedFn:
         # hand-derived). Keyed like _aot; last_step_flops is the newest.
         self._aot_flops: Dict[Tuple, float] = {}
         self.last_step_flops: Optional[float] = None
+        # bytes accessed per call, same provenance — the bench.py rssm target
+        # reads these to compare flax-vs-fused memory traffic per scan step
+        self.last_step_bytes: Optional[float] = None
         self.flops_dispatched = 0.0
         # warmup jobs queued for this fn but not yet compiled (threading.Events,
         # set by the AOTWarmup thread): callers racing the warmup wait for them
@@ -372,6 +375,7 @@ class GuardedFn:
             "first_call_s": self.first_call_s,
             "flops_dispatched": self.flops_dispatched,
             "step_flops": self.last_step_flops,
+            "step_bytes": self.last_step_bytes,
         }
 
     # ----- AOT ------------------------------------------------------------------
@@ -384,12 +388,15 @@ class GuardedFn:
         exe = lowered.compile()
         dt = time.perf_counter() - t0
         flops = _cost_flops(exe)
+        bytes_accessed = _cost_bytes(exe)
         _record_program(self, lowered, exe, dt)
         with _LOCK:
             self._aot[_routing_key(sig)] = exe
             if flops is not None:
                 self._aot_flops[_routing_key(sig)] = flops
                 self.last_step_flops = flops
+            if bytes_accessed is not None:
+                self.last_step_bytes = bytes_accessed
             self.aot_compiles += 1
             self.compile_seconds += dt
             self._had_any_compile = True
@@ -528,6 +535,24 @@ def _cost_flops(exe: Any) -> Optional[float]:
     return flops if flops > 0 else None
 
 
+def _cost_bytes(exe: Any) -> Optional[float]:
+    """``bytes accessed`` from a compiled executable's cost model, or None.
+    Same never-raise contract as :func:`_cost_flops`."""
+    try:
+        cost = exe.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not cost:
+        return None
+    try:
+        nbytes = float(cost.get("bytes accessed", 0.0))
+    except (AttributeError, TypeError, ValueError):
+        return None
+    return nbytes if nbytes > 0 else None
+
+
 def guarded_jit(fun: Callable, name: Optional[str] = None, **jit_kwargs: Any) -> GuardedFn:
     """Drop-in ``jax.jit`` replacement returning a :class:`GuardedFn`."""
     return GuardedFn(fun, name=name, **jit_kwargs)
@@ -539,6 +564,13 @@ def step_flops(name: str) -> Optional[float]:
     the lookup Time/mfu rows are computed from."""
     gfn = find(name)
     return gfn.last_step_flops if gfn is not None else None
+
+
+def step_bytes(name: str) -> Optional[float]:
+    """Per-call ``bytes accessed`` of the newest AOT executable warmed for
+    ``name``, or None when it never AOT-compiled."""
+    gfn = find(name)
+    return gfn.last_step_bytes if gfn is not None else None
 
 
 def find(name: str) -> Optional[GuardedFn]:

@@ -113,6 +113,7 @@ KNOWN_FAILPOINTS: Dict[str, Dict[str, str]] = {
     "env.autoreset": {"plane": "env", "doc": "autoreset path misbehaves after episode end"},
     "preempt.iteration": {"plane": "train", "doc": "preemption signal at a training-iteration boundary"},
     "train.fused_update": {"plane": "train", "doc": "fused in-graph update step fails"},
+    "train.kernel_dispatch": {"plane": "train", "doc": "Pallas RSSM kernel dispatch fails; scan degrades to the flax path"},
     "telemetry.program_record": {"plane": "telemetry", "doc": "compiled-program ledger capture fails"},
     "bench.ledger_append": {"plane": "telemetry", "doc": "bench record append to the persistent ledger fails"},
 }

@@ -1,0 +1,33 @@
+"""Pallas kernel subsystem (ROADMAP item 3b: fused scan-step kernels).
+
+Headline: the fused LayerNorm-GRU + prior/posterior-head RSSM step
+(:mod:`sheeprl_tpu.ops.pallas.rssm_step`) — one kernel launch per dynamic-scan
+step that keeps the recurrent state and gate activations in VMEM and carries a
+hand-written ``custom_vjp`` so the backward scan stores only the step *inputs*
+(carries + xs) instead of XLA autodiff's per-step stacked intermediates.
+
+Dispatch is config + platform driven (``world_model.kernels``):
+
+- ``off``   — the flax path, untouched (the bitwise parity reference);
+- ``auto``  — real Pallas kernel on TPU when the step fits VMEM, otherwise the
+  fused reference formulation (same math, same custom_vjp, plain XLA);
+- ``pallas`` / ``interpret`` / ``reference`` — force one implementation
+  (``interpret`` runs the Pallas kernel in interpreter mode on CPU — the
+  bit-parity test harness).
+
+The ``train.kernel_dispatch`` failpoint (core/failpoints.py) forces the flax
+fallback at dispatch time, proving a kernel failure degrades instead of
+crashing. See howto/performance.md ("Fused RSSM kernels") and
+benchmarks/PALLAS_GRU_NOTES.md for why the kernel fuses the *whole* step — the
+single-op GRU kernel this subsystem supersedes lost to XLA.
+"""
+
+from sheeprl_tpu.ops.pallas.rssm_step import (  # noqa: F401
+    KernelUnsupported,
+    RSSMStepSpec,
+    extract_step_params,
+    fused_dynamic_scan,
+    fused_imagination_step,
+    select_impl,
+    step_vmem_bytes,
+)
