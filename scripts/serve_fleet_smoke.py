@@ -1,0 +1,555 @@
+#!/usr/bin/env python
+"""Fleet smoke: chaos drill for the replica-fleet serving plane.
+
+1. synthesize a tiny certified checkpoint (serve_smoke's fixture), then launch
+   the fleet supervisor (``python -m sheeprl_tpu.serve.fleet``) with 3 serve
+   replicas behind the failover router. The SUPERVISOR carries a one-shot
+   ``fleet.deploy:raise`` failpoint so the first rolling deploy below
+   deterministically fails its canary and must roll back fleet-wide;
+2. drive sustained mixed-priority closed-loop load (priority-0 best-effort +
+   priority-1 clients) through the router;
+3. priority proof: with the background clients quiesced, pipeline a burst of
+   priority-0 requests plus a handful of priority-1 through the router against
+   tiny replica queues (depth 8, ``shed_oldest``). The p1 population is kept
+   strictly below one queue's depth, so a p1 shed is IMPOSSIBLE if the policy
+   is right: every shed id must be p0-tagged and every shed response must
+   carry the ``retry_after_ms`` hint;
+4. SIGKILL one replica mid-load: the router fails the in-flight relays over to
+   the survivors (zero client-visible errors), the supervisor classifies the
+   exit and respawns the slot under a NEW fenced epoch
+   (``Fleet/replica_restarts >= 1``, epoch bumped in the membership file);
+5. rolling certified deploy under load: certify a step-200 generation; the
+   injected canary failure must roll the fleet back
+   (``Fleet/deploy_rollbacks >= 1``) before the retry lands it
+   (``Fleet/deploys >= 1``, every member re-stamped with the new artifact);
+6. forged zombie write: append a duplicate member for slot 0 with epoch 0
+   pointing at a trap listener directly into the membership file. The router
+   must fence it (``Fleet/fenced_writes >= 1``) and the trap must see ZERO
+   connections — a stale epoch never answers anything;
+7. SIGTERM the supervisor with clients still in flight: router drains
+   (rejected/draining is still a response), every replica drains to rc 0, the
+   fleet stats file reports a clean fleet-wide drain, and the router counters
+   satisfy ``requests_total == ok + shed + rejected + deadline_missed +
+   errors`` at shutdown — every request that ever reached the fleet got
+   exactly one answer.
+
+Run directly (``python scripts/serve_fleet_smoke.py``) or through the
+registered slow-marked test (tests/test_utils/test_serve_fleet_smoke.py;
+the tier-1 `-m fleet` tests cover the same contracts against stub replicas).
+``bench.py --target serve_fleet`` reuses :func:`launch_fleet` for its
+SLO-gated kill+deploy QPS sweep.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.core import failpoints  # noqa: E402
+
+
+def _load_serve_smoke():
+    spec = importlib.util.spec_from_file_location(
+        "serve_smoke", os.path.join(REPO_ROOT, "scripts", "serve_smoke.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+serve_smoke = _load_serve_smoke()
+
+# Per-replica serve knobs: queues small enough that the priority burst below
+# overflows them deterministically, deadlines long enough that nothing times
+# out on a slow CPU box.
+REPLICA_OVERRIDES = [
+    "serve.batch.max_size=4",
+    "serve.batch.max_wait_ms=4.0",
+    "serve.queue.max_depth=8",
+    "serve.queue.admission=shed_oldest",
+    "serve.queue.deadline_ms=30000",
+]
+
+FLEET_OVERRIDES = [
+    "fleet.replicas=3",
+    "fleet.heartbeat_s=0.2",
+    "fleet.restart_backoff_s=0.2",
+    "fleet.restart_backoff_max_s=0.5",
+    "fleet.deploy_poll_s=0.25",
+    "fleet.deploy_retry_s=0.5",
+    "fleet.drain_timeout_s=90",
+    "router.membership_poll_s=0.05",
+]
+
+
+# --------------------------------------------------------------------------- fleet
+def launch_fleet(
+    fixture: dict,
+    workdir: str,
+    ready_file: str,
+    stats_file: str,
+    log_file: str,
+    extra=(),
+    env_extra=None,
+) -> subprocess.Popen:
+    cmd = [
+        sys.executable,
+        "-m",
+        "sheeprl_tpu.serve.fleet",
+        f"checkpoint_path={fixture['ckpt']}",
+        f"workdir={workdir}",
+        f"ready_file={ready_file}",
+        f"stats_file={stats_file}",
+        *FLEET_OVERRIDES,
+        *REPLICA_OVERRIDES,
+        *extra,
+    ]
+    log = open(log_file, "a")
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+        PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    )
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)  # drills opt in via env_extra
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        cmd,
+        cwd=os.path.dirname(fixture["run_dir"]),
+        env=env,
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+
+
+def read_membership(path: str) -> list:
+    try:
+        with open(path) as f:
+            return json.load(f).get("members", [])
+    except (OSError, ValueError):
+        return []
+
+
+# --------------------------------------------------------------------------- load
+class PriorityLoadClient(threading.Thread):
+    """Closed-loop client with a priority class and a pause gate.
+
+    Same contract as serve_smoke's LoadClient — one outstanding request,
+    unique ids, retries the SAME id through backpressure and connection loss —
+    plus: every request carries ``priority``, and while ``pause`` is set the
+    client goes idle BETWEEN requests (``idle`` flips True only once nothing
+    is in flight, so drill phases can quiesce the fleet deterministically)."""
+
+    def __init__(
+        self,
+        name: str,
+        holder: dict,
+        obs: dict,
+        stop: threading.Event,
+        pause: threading.Event,
+        priority: int,
+        pace_s: float = 0.002,
+    ):
+        super().__init__(name=name, daemon=True)
+        self.client = name
+        self.holder = holder
+        self.obs = obs
+        self.stop_event = stop
+        self.pause = pause
+        self.priority = int(priority)
+        self.pace_s = pace_s
+        self.results: dict = {}
+        self.unresolved: set = set()
+        self.retries = 0
+        self.idle = True
+        self._sock = None
+        self._file = None
+
+    def _disconnect(self) -> None:
+        for closable in (self._file, self._sock):
+            if closable is not None:
+                try:
+                    closable.close()
+                except OSError:
+                    pass
+        self._sock = self._file = None
+
+    def _connect(self) -> None:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.holder["addr"], timeout=10.0)
+            self._file = self._sock.makefile("rwb")
+
+    def _resolve(self, rid: str):
+        while not self.stop_event.is_set():
+            try:
+                self._connect()
+                payload = {"id": rid, "obs": self.obs, "priority": self.priority}
+                self._file.write((json.dumps(payload) + "\n").encode())
+                self._file.flush()
+                line = self._file.readline()
+                if not line:
+                    raise ConnectionError("eof")
+                resp = json.loads(line)
+            except (OSError, ValueError, ConnectionError):
+                self._disconnect()
+                self.retries += 1
+                time.sleep(0.1)
+                continue
+            if resp.get("status") == "rejected":
+                self.retries += 1
+                time.sleep(max(resp.get("retry_after_ms", 50.0), 50.0) / 1000.0)
+                continue
+            return resp
+        return None
+
+    def run(self) -> None:
+        n = 0
+        while not self.stop_event.is_set():
+            if self.pause.is_set():
+                self.idle = True
+                time.sleep(0.02)
+                continue
+            self.idle = False
+            rid = f"{self.client}-{n}"
+            self.unresolved.add(rid)
+            resp = self._resolve(rid)
+            if resp is None:
+                break
+            self.unresolved.discard(rid)
+            self.results[rid] = resp
+            n += 1
+            time.sleep(self.pace_s)
+        self.idle = True
+        self._disconnect()
+
+
+def priority_burst(addr, obs: dict, n_p0: int = 240, n_p1: int = 4) -> dict:
+    """Pipeline ``n_p0`` priority-0 then ``n_p1`` priority-1 requests over one
+    router connection and collect every terminal response. ``n_p1`` MUST stay
+    strictly below one replica queue's depth: then an all-p1 full queue is
+    impossible and a correct shed policy can never shed a p1."""
+    payloads = [
+        {"id": f"burst-p0-{i}", "obs": obs, "priority": 0} for i in range(n_p0)
+    ] + [{"id": f"burst-p1-{i}", "obs": obs, "priority": 1} for i in range(n_p1)]
+    responses: dict = {}
+    with socket.create_connection(addr, timeout=60.0) as sock:
+        f = sock.makefile("rwb")
+
+        def reader():
+            for _ in range(len(payloads)):
+                line = f.readline()
+                if not line:
+                    return
+                resp = json.loads(line)
+                responses[resp.get("id")] = resp
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        for p in payloads:
+            f.write((json.dumps(p) + "\n").encode())
+        f.flush()
+        t.join(timeout=120.0)
+    missing = [p["id"] for p in payloads if p["id"] not in responses]
+    if missing:
+        raise SystemExit(f"priority burst lost {len(missing)} responses: {missing[:5]}...")
+    return responses
+
+
+# --------------------------------------------------------------------------- audit
+def audit_fleet_stats(stats: dict, label: str) -> None:
+    total = stats["Fleet/requests_total"]
+    parts = (
+        stats["Fleet/ok"]
+        + stats["Fleet/shed"]
+        + stats["Fleet/rejected"]
+        + stats["Fleet/deadline_missed"]
+        + stats["Fleet/errors"]
+    )
+    if total != parts:
+        raise SystemExit(
+            f"{label}: accounting broken — Fleet/requests_total={total} but terminal sum={parts}"
+        )
+
+
+class TrapListener(threading.Thread):
+    """A listening socket that only counts connections — the forged zombie
+    membership entry points here, and the count must stay 0."""
+
+    def __init__(self):
+        super().__init__(name="fleet-smoke-trap", daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.sock.settimeout(0.2)
+        self.port = self.sock.getsockname()[1]
+        self.accepts = 0
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            self.accepts += 1
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------- drill
+def main(workdir: str | None = None, timeout: float = 600.0) -> dict:
+    workdir = workdir or tempfile.mkdtemp(prefix="serve_fleet_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    started = time.monotonic()
+    fixture = serve_smoke.build_fixture(workdir)
+
+    fleet_dir = os.path.join(workdir, "fleet")
+    membership_file = os.path.join(fleet_dir, "membership.json")
+    ready_file = os.path.join(workdir, "router_ready.json")
+    stats_file = os.path.join(workdir, "fleet_stats.json")
+    log_file = os.path.join(workdir, "fleet.log")
+    # one-shot canary failure: the FIRST rolling deploy must roll back
+    proc = launch_fleet(
+        fixture,
+        fleet_dir,
+        ready_file,
+        stats_file,
+        log_file,
+        env_extra={
+            "SHEEPRL_TPU_FAILPOINTS": failpoints.spec_entry(
+                "fleet.deploy", "raise", "injected-canary-drill", "hit=1"
+            )
+        },
+    )
+    holder = {"addr": None}
+    stop = threading.Event()
+    pause = threading.Event()
+    clients: list = []
+    trap = TrapListener()
+    try:
+        info = serve_smoke.wait_ready(ready_file, proc, log_file, timeout=min(300.0, timeout))
+        holder["addr"] = (info["host"], info["port"])
+
+        def router_stats() -> dict:
+            return serve_smoke.rpc(holder["addr"], {"op": "stats"})
+
+        members0 = read_membership(membership_file)
+        if len(members0) != 3:
+            raise SystemExit(f"expected 3 members at boot, membership={members0}")
+
+        clients = [
+            PriorityLoadClient(f"c{i}p{p}", holder, fixture["obs"], stop, pause, priority=p)
+            for i, p in enumerate([0, 0, 1, 1])
+        ]
+        for c in clients:
+            c.start()
+
+        def ok_count():
+            return sum(1 for c in clients for r in c.results.values() if r.get("status") == "ok")
+
+        # phase 1: steady mixed-priority traffic through the router
+        serve_smoke._wait_until(lambda: ok_count() >= 30, 90, "30 ok responses via router", log_file)
+
+        # phase 2: priority proof — quiesce the background clients so the p1
+        # population is EXACTLY the burst's, then overflow the tiny queues
+        pause.set()
+        serve_smoke._wait_until(
+            lambda: all(c.idle for c in clients), 60, "clients to quiesce for the burst", log_file
+        )
+        burst = priority_burst(holder["addr"], fixture["obs"], n_p0=240, n_p1=4)
+        shed = {rid: r for rid, r in burst.items() if r.get("status") == "shed"}
+        if not shed:
+            raise SystemExit("priority burst produced no sheds — queues never overflowed")
+        p1_shed = [rid for rid in shed if "-p1-" in rid]
+        if p1_shed:
+            raise SystemExit(f"priority-1 requests were shed before priority-0: {p1_shed}")
+        no_hint = [rid for rid, r in shed.items() if "retry_after_ms" not in r]
+        if no_hint:
+            raise SystemExit(f"shed responses missing the retry_after_ms hint: {no_hint[:5]}")
+        errors = [r for r in burst.values() if r.get("status") == "error"]
+        if errors:
+            raise SystemExit(f"burst saw {len(errors)} errors: {errors[:3]}")
+        pause.clear()
+
+        # phase 3: SIGKILL a replica mid-load — failover + supervised respawn
+        victim = members0[-1]
+        restarts_before = router_stats().get("Fleet/replica_restarts", 0)
+        os.kill(victim["pid"], signal.SIGKILL)
+        serve_smoke._wait_until(
+            lambda: router_stats().get("Fleet/replica_restarts", 0) >= restarts_before + 1,
+            120,
+            "supervisor to respawn the SIGKILLed replica",
+            log_file,
+        )
+        respawned = [m for m in read_membership(membership_file) if m["slot"] == victim["slot"]]
+        if not respawned or respawned[0]["epoch"] <= victim["epoch"]:
+            raise SystemExit(
+                f"respawned slot {victim['slot']} did not bump its fenced epoch: "
+                f"{victim} -> {respawned}"
+            )
+
+        # phase 4: rolling certified deploy under load. The injected canary
+        # failure forces rollback-then-retry: both counters must move, and the
+        # whole fleet must land on the step-200 artifact.
+        serve_smoke.write_generation(
+            fixture["ckpt_dir"], serve_smoke.perturb(fixture["state"]), step=200
+        )
+        serve_smoke._wait_until(
+            lambda: router_stats().get("Fleet/deploy_rollbacks", 0) >= 1,
+            180,
+            "injected canary failure to roll the deploy back",
+            log_file,
+        )
+        serve_smoke._wait_until(
+            lambda: router_stats().get("Fleet/deploys", 0) >= 1,
+            240,
+            "rolling deploy to complete on retry",
+            log_file,
+        )
+        members_deployed = read_membership(membership_file)
+        stale = [m for m in members_deployed if m.get("step") != 200]
+        if len(members_deployed) != 3 or stale:
+            raise SystemExit(f"deploy left stale members: {members_deployed}")
+
+        # phase 5: forged zombie write — a stale epoch must answer NOTHING
+        trap.start()
+        fenced_before = router_stats().get("Fleet/fenced_writes", 0)
+        doc = {"members": list(members_deployed)}
+        doc["members"].append(
+            {
+                "slot": members_deployed[0]["slot"],
+                "epoch": 0,  # long-fenced generation
+                "host": "127.0.0.1",
+                "port": trap.port,
+                "pid": 0,
+            }
+        )
+        tmp = membership_file + ".forged"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, membership_file)
+        serve_smoke._wait_until(
+            lambda: router_stats().get("Fleet/fenced_writes", 0) > fenced_before,
+            60,
+            "router to fence the forged membership write",
+            log_file,
+        )
+        time.sleep(0.5)  # a few more poll cycles: the trap must STAY silent
+        if trap.accepts != 0:
+            raise SystemExit(
+                f"fencing failed: the router dialed the zombie trap {trap.accepts} time(s)"
+            )
+
+        # phase 6: audit the live router counters at a quiescent point, then
+        # SIGTERM the supervisor with clients back in flight
+        pause.set()
+        serve_smoke._wait_until(
+            lambda: all(c.idle for c in clients), 60, "clients to quiesce for the audit", log_file
+        )
+        live = router_stats()
+        audit_fleet_stats(live, "router live stats")
+        if live.get("Fleet/failovers", 0) < 1:
+            raise SystemExit(
+                f"router never failed over despite the SIGKILL "
+                f"(Fleet/failovers={live.get('Fleet/failovers')})"
+            )
+        pause.clear()
+        time.sleep(0.5)  # clients back in flight: the drain happens under load
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        if rc != 0:
+            with open(log_file) as f:
+                raise SystemExit(f"fleet exited rc={rc} on SIGTERM; log tail:\n{f.read()[-3000:]}")
+    finally:
+        stop.set()
+        pause.clear()
+        trap.stop()
+        if proc.poll() is None:
+            proc.kill()
+    for c in clients:
+        c.join(timeout=30)
+
+    # fleet-side audit: clean fleet-wide drain, every FINAL replica drained to
+    # rc 0 with sane per-replica counters and zero steady-state retraces
+    with open(stats_file) as f:
+        fleet_stats = json.load(f)
+    if not fleet_stats.get("drained"):
+        raise SystemExit(f"fleet did not report a clean drain: {json.dumps(fleet_stats)[:2000]}")
+    audit_fleet_stats(fleet_stats, "fleet shutdown stats")
+    finals = [r for r in fleet_stats.get("replicas", []) if r.get("final")]
+    if len(finals) != 3:
+        raise SystemExit(f"expected 3 final replicas, got {len(finals)}")
+    for row in finals:
+        if row["rc"] != 0:
+            raise SystemExit(f"final replica slot={row['slot']} exited rc={row['rc']}")
+        rs = row.get("stats") or {}
+        serve_smoke._audit_stats(rs, f"replica slot={row['slot']} shutdown stats")
+    if fleet_stats.get("Fleet/deploy_rollbacks", 0) < 1 or fleet_stats.get("Fleet/deploys", 0) < 1:
+        raise SystemExit(f"deploy counters did not move: {fleet_stats}")
+    if fleet_stats.get("Fleet/replica_restarts", 0) < 1:
+        raise SystemExit("supervisor never recorded the chaos respawn")
+
+    # client-side audit: zero non-shed losses, zero errors, no p1 ever shed
+    unresolved = [rid for c in clients for rid in c.unresolved]
+    if any(len(c.unresolved) > 1 for c in clients):
+        raise SystemExit(f"non-shed request losses: {unresolved}")
+    statuses: dict = {}
+    for c in clients:
+        for r in c.results.values():
+            statuses[r["status"]] = statuses.get(r["status"], 0) + 1
+    if statuses.get("error"):
+        raise SystemExit(f"clients saw {statuses['error']} error responses: statuses={statuses}")
+    p1_client_shed = [
+        rid
+        for c in clients
+        if c.priority == 1
+        for rid, r in c.results.items()
+        if r.get("status") == "shed"
+    ]
+
+    return {
+        "workdir": workdir,
+        "wall_s": round(time.monotonic() - started, 2),
+        "client_statuses": statuses,
+        "client_retries": sum(c.retries for c in clients),
+        "burst_sheds": len(shed),
+        "p1_client_sheds": len(p1_client_shed),
+        "fleet_stats": {k: v for k, v in fleet_stats.items() if k.startswith("Fleet/")},
+        "unresolved_at_stop": unresolved,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None, help="drill directory (default: fresh tempdir)")
+    parser.add_argument("--timeout", type=float, default=600.0, help="overall budget in seconds")
+    cli = parser.parse_args()
+    result = main(cli.workdir, cli.timeout)
+    print(
+        "fleet smoke OK: "
+        f"{result['client_statuses'].get('ok', 0)} client requests served, "
+        f"{result['burst_sheds']} priority-0 sheds (0 priority-1), a mid-load SIGKILL, "
+        f"a rolled-back-then-landed rolling deploy, a fenced zombie, "
+        f"{result['client_retries']} client retries, zero losses "
+        f"({result['wall_s']}s)"
+    )

@@ -105,6 +105,11 @@ KNOWN_FAILPOINTS: Dict[str, Dict[str, str]] = {
     "control.chunk_send": {"plane": "control", "doc": "outbound control chunk dropped/corrupted"},
     "control.chunk_recv": {"plane": "control", "doc": "inbound control chunk dropped/corrupted"},
     "reload.canary": {"plane": "serve", "doc": "canary model fails during a hot reload"},
+    "fleet.spawn": {"plane": "serve", "doc": "serve replica spawn fails at process start"},
+    "fleet.heartbeat": {"plane": "serve", "doc": "supervisor heartbeat probe of a replica disrupted"},
+    "fleet.deploy": {"plane": "serve", "doc": "rolling-deploy canary fails on the first replica"},
+    "router.dial": {"plane": "serve", "doc": "router connect to a backend replica fails"},
+    "router.relay": {"plane": "serve", "doc": "router relay to a replica dies mid-flight"},
     "orchestrate.journal": {"plane": "orchestrate", "doc": "journal append fails (torn orchestrator state)"},
     "orchestrate.spawn": {"plane": "orchestrate", "doc": "member spawn fails at process start"},
     "orchestrate.inject": {"plane": "orchestrate", "doc": "periodic orchestrator-driven member fault"},

@@ -19,6 +19,14 @@ throughput:
 - :mod:`sheeprl_tpu.serve.server` — the TCP frontend: JSON-lines protocol,
   ``Serve/*`` stats, readiness/liveness surface, graceful SIGTERM drain under
   :class:`~sheeprl_tpu.core.resilience.PreemptionGuard`.
+- :mod:`sheeprl_tpu.serve.fleet` — the replica-fleet supervisor: N serve
+  subprocesses with ready-file handshakes, control-plane heartbeat liveness,
+  epoch-stamped membership, budgeted restart backoff and rolling certified
+  deploys (canary + fleet-wide rollback).
+- :mod:`sheeprl_tpu.serve.router` — the failover frontend: same JSON-lines
+  protocol outward, health-probed epoch-fenced membership, least-outstanding
+  replica pick, bounded deadline-aware retry to a different replica, and
+  request priority classes threaded down to the batcher's shed policy.
 
 Config group: ``sheeprl_tpu/configs/serve/default.yaml``; :func:`resolve`
 fills defaults so sidecar configs recorded before this subsystem existed still
@@ -40,6 +48,32 @@ _DEFAULTS: Dict[str, Dict[str, Any]] = {
         "deadline_ms": 1000.0,
     },
     "reload": {"enabled": True, "poll_s": 1.0, "canary": True, "degraded_after": 3},
+    # replica-fleet supervisor (serve/fleet.py): spawn/heartbeat/restart/deploy
+    # knobs. Replicas run with reload DISABLED — the supervisor owns weight
+    # changes via rolling certified deploys, so every replica's generation is
+    # an explicit, epoch-stamped supervisor decision.
+    "fleet": {
+        "replicas": 3,
+        "heartbeat_s": 0.25,
+        "heartbeat_timeout_s": 10.0,
+        "restart_backoff_s": 0.25,
+        "restart_backoff_max_s": 2.0,
+        "max_restarts": 8,
+        "drain_timeout_s": 45.0,
+        "deploy_poll_s": 0.5,
+        "deploy_retry_s": 1.0,
+    },
+    # failover router (serve/router.py): the outward-facing frontend
+    "router": {
+        "host": "127.0.0.1",
+        "port": 0,
+        "retry_budget": 3,
+        "retry_backoff_ms": 25.0,
+        "membership_poll_s": 0.1,
+        "dial_timeout_s": 5.0,
+        "default_priority": 1,
+        "max_workers": 64,
+    },
 }
 
 

@@ -10,9 +10,12 @@ reject before crashing):
 
 - the queue is bounded (``queue.max_depth``); past it, admission either
   rejects the NEW request with a retry-after hint (``admission: reject``) or
-  evicts the OLDEST queued request (``admission: shed_oldest`` — freshest
-  observations win, the natural policy for control loops where a stale obs is
-  worth less than a fresh one);
+  evicts the oldest request of the LOWEST priority class in sight
+  (``admission: shed_oldest`` — freshest observations win within a class, but
+  priority-0 traffic is always shed before priority-1; a newcomer of strictly
+  lower priority than everything queued sheds itself). Shed responses carry
+  the same ``retry_after_ms`` hint as rejects, so a fleet router can back off
+  intelligently either way;
 - every request carries a deadline budget; work already past its deadline is
   dropped at batch-assembly time instead of computing a dead answer.
 
@@ -44,14 +47,27 @@ _STATUS_COUNTER = {
 
 
 class PendingRequest:
-    __slots__ = ("rid", "obs", "future", "enqueued_at", "deadline_at", "span_id", "batched_at")
+    __slots__ = (
+        "rid",
+        "obs",
+        "future",
+        "enqueued_at",
+        "deadline_at",
+        "span_id",
+        "batched_at",
+        "priority",
+    )
 
-    def __init__(self, rid: Any, obs: Any, deadline_s: Optional[float]):
+    def __init__(self, rid: Any, obs: Any, deadline_s: Optional[float], priority: int = 1):
         self.rid = rid
         self.obs = obs
         self.future: Future = Future()
         self.enqueued_at = time.monotonic()
         self.deadline_at = None if deadline_s is None else self.enqueued_at + deadline_s
+        # request priority class (0 = best-effort, higher = more important):
+        # only consulted by shed_oldest victim selection — scheduling within
+        # the queue stays strictly FIFO so batches keep coalescing untouched
+        self.priority = int(priority)
         # telemetry: the request span's id is allocated at ADMIT so the
         # queue-wait child recorded at batch-assembly time can point at its
         # parent before the parent closes ("" while tracing is disabled —
@@ -120,13 +136,19 @@ class MicroBatcher:
             self._thread.join(timeout=5.0)
 
     # ----- admission ------------------------------------------------------------
-    def submit(self, obs: Any, deadline_s: Optional[float] = None, rid: Any = None) -> Future:
+    def submit(
+        self,
+        obs: Any,
+        deadline_s: Optional[float] = None,
+        rid: Any = None,
+        priority: int = 1,
+    ) -> Future:
         """Admit one request; ALWAYS returns a future that resolves to a
         terminal response dict — backpressure answers arrive through the same
         channel as actions, so clients need exactly one code path."""
         if deadline_s is None:
             deadline_s = self.default_deadline_s
-        req = PendingRequest(rid, obs, deadline_s)
+        req = PendingRequest(rid, obs, deadline_s, priority=priority)
         self.stats.inc("requests_total")
         shed: Optional[PendingRequest] = None
         with self._cond:
@@ -137,12 +159,25 @@ class MicroBatcher:
                 if self.admission == "reject":
                     self._resolve_locked(req, "rejected", retry_after_ms=self.retry_after_ms)
                     return req.future
-                shed = self._queue.popleft()
-            self._queue.append(req)
+                # shed the oldest request of the LOWEST priority class in
+                # sight. A newcomer of strictly lower priority than everything
+                # queued is the victim itself — evicting queued higher-priority
+                # work for it would invert the policy.
+                victim = min(self._queue, key=lambda r: (r.priority, r.enqueued_at))
+                if victim.priority <= req.priority:
+                    self._queue.remove(victim)
+                    self._queue.append(req)
+                    shed = victim
+                else:
+                    shed = req
+            else:
+                self._queue.append(req)
             self.stats.observe_queue_depth(len(self._queue))
             self._cond.notify_all()
         if shed is not None:
-            self._finish(shed, "shed")
+            # the shed answer carries the same backoff hint as a reject: the
+            # fleet router (and any client) backs off identically either way
+            self._finish(shed, "shed", retry_after_ms=self.retry_after_ms)
         return req.future
 
     # ----- worker ---------------------------------------------------------------

@@ -14,7 +14,9 @@ swaps the PREVIOUS generation back (``Serve/reload_rollbacks``); a failure
 anywhere earlier leaves the current generation untouched
 (``Serve/reload_failures``). ``reload.degraded_after`` consecutive failures
 latch the degraded gauge: the server keeps answering from the last-known-good
-generation and says so in its health surface.
+generation and says so in its health surface. The latch is NOT forever — the
+next successful reload clears it and emits a ``serve_reload_recovered`` event
+row, so the incident that raised the gauge has an explicit close.
 """
 
 from __future__ import annotations
@@ -123,11 +125,26 @@ class HotReloader(threading.Thread):
                     self._record_failure(path, e)
                     return None
             self._loaded = (path, info.get("crc32"))
+            # a success after the degraded gauge latched is an INCIDENT
+            # RECOVERY, not just another reload: clear the latch and say so in
+            # the event stream (operators page on the latch — the recovery row
+            # is what closes the incident)
+            was_degraded = self.consecutive_failures >= self.degraded_after
+            failures_cleared = self.consecutive_failures
             self.consecutive_failures = 0
             self.stats.inc("reload_generations")
             self.stats.set_gauge("generation", gen.gen_id)
             self.stats.set_gauge("degraded", 0)
             sp.set(gen_id=gen.gen_id)
+        if was_degraded:
+            append_event(
+                self.events_dir,
+                "serve_reload_recovered",
+                int(gen.step or 0),
+                path=path,
+                gen_id=gen.gen_id,
+                failures_cleared=failures_cleared,
+            )
         append_event(
             self.events_dir, "serve_reload", int(gen.step or 0), path=path, gen_id=gen.gen_id
         )

@@ -1,0 +1,409 @@
+"""Failover router: one JSON-lines frontend over N serve replicas.
+
+Speaks the same protocol outward as a single :mod:`sheeprl_tpu.serve.server`
+replica (``infer`` with optional ``priority``/``deadline_ms``, plus ``stats``
+and ``health`` ops), so a client cannot tell a fleet from one server — except
+that replicas dying under it stop mattering.
+
+Membership is FILE-driven and epoch-fenced: the fleet supervisor publishes
+``{"members": [{"slot", "epoch", "host", "port", ...}]}`` (atomic replace) and
+a watcher thread folds it in. For every slot the router remembers the highest
+epoch it has EVER seen; an entry carrying a lower epoch is a zombie write — a
+stale incarnation (or a forged file) trying to re-join after the supervisor
+fenced it — and is dropped with ``Fleet/fenced_writes`` instead of routed to.
+A fenced zombie replica therefore never sees a single request, which is what
+makes the supervisor's epoch stamp an actual guarantee about stale weights.
+
+Request path: pick the healthy member with the fewest outstanding requests,
+relay over a per-request connection, and on a dial or mid-flight transport
+failure retry on a DIFFERENT replica with jittered backoff — bounded by
+``retry_budget`` and by the request's own deadline, so the router never turns
+a dead replica into an unbounded client stall. Exactly one terminal response
+per request, end to end: transport failures that exhaust the budget resolve to
+``status: error``; a deadline that expires between retries resolves to
+``deadline_expired``; backpressure answers from the replica (``shed`` /
+``rejected``, both carrying ``retry_after_ms``) pass through verbatim.
+
+Every terminal bumps exactly one of the ``Fleet/*`` terminal counters, so
+``requests_total == ok + shed + rejected + deadline_missed + errors`` holds at
+the router exactly like it does at each replica — the fleet drill audits both.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import socketserver
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.core.resilience import jittered_backoff
+from sheeprl_tpu.serve.stats import FleetStats
+from sheeprl_tpu.telemetry import trace
+
+_logger = logging.getLogger(__name__)
+
+# terminal status -> Fleet/* counter (same mapping as the replica batcher)
+_STATUS_COUNTER = {
+    "ok": "ok",
+    "shed": "shed",
+    "rejected": "rejected",
+    "deadline_expired": "deadline_missed",
+    "error": "errors",
+}
+
+
+class Member:
+    """One live replica as the router sees it."""
+
+    __slots__ = ("slot", "epoch", "host", "port", "outstanding", "meta")
+
+    def __init__(self, slot: int, epoch: int, host: str, port: int, meta: Dict[str, Any]):
+        self.slot = int(slot)
+        self.epoch = int(epoch)
+        self.host = str(host)
+        self.port = int(port)
+        self.outstanding = 0
+        self.meta = meta
+
+    @property
+    def addr(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+
+def read_membership(path: str) -> Optional[List[Dict[str, Any]]]:
+    """Best-effort read of a membership file (None on missing/torn)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    members = doc.get("members") if isinstance(doc, dict) else None
+    return members if isinstance(members, list) else None
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        router: "FailoverRouter" = self.server.router  # type: ignore[attr-defined]
+        wlock = threading.Lock()
+
+        def send(obj: Dict[str, Any]) -> None:
+            data = (json.dumps(obj) + "\n").encode()
+            with wlock:
+                try:
+                    self.wfile.write(data)
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass  # client went away; the request still resolved in the stats
+
+        while True:
+            try:
+                line = self.rfile.readline()
+            except (ConnectionResetError, OSError):
+                return
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                send({"status": "error", "error": "malformed json"})
+                continue
+            op = msg.get("op", "infer")
+            if op == "stats":
+                send(router.stats_payload())
+            elif op == "health":
+                send(router.health_payload())
+            elif op == "infer":
+                router.submit(msg, send)
+            else:
+                send({"status": "error", "error": f"unknown op '{op}'"})
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class FailoverRouter:
+    def __init__(
+        self,
+        membership_file: str,
+        stats: Optional[FleetStats] = None,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        retry_budget: int = 3,
+        retry_backoff_ms: float = 25.0,
+        membership_poll_s: float = 0.1,
+        dial_timeout_s: float = 5.0,
+        default_priority: int = 1,
+        max_workers: int = 64,
+    ):
+        self.membership_file = membership_file
+        self.stats = stats or FleetStats()
+        self.host = str(host)
+        self.port = int(port)
+        self.retry_budget = int(retry_budget)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.membership_poll_s = float(membership_poll_s)
+        self.dial_timeout_s = float(dial_timeout_s)
+        self.default_priority = int(default_priority)
+        self._members: Dict[int, Member] = {}
+        # highest epoch ever seen per slot — the fence. Survives a member's
+        # removal on purpose: a zombie re-appearing AFTER its replacement died
+        # is still a zombie.
+        self._fence: Dict[int, int] = {}
+        self._lock = threading.Lock()
+        self._draining = False
+        self._outstanding = 0
+        self._pool = ThreadPoolExecutor(max_workers=int(max_workers), thread_name_prefix="sheeprl-router")
+        self._tcp: Optional[_TCPServer] = None
+        self._tcp_thread: Optional[threading.Thread] = None
+        self._watch_stop = threading.Event()
+        self._watch_thread: Optional[threading.Thread] = None
+
+    # ----- lifecycle ------------------------------------------------------------
+    def start(self) -> "FailoverRouter":
+        self.refresh_membership()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="sheeprl-router-membership", daemon=True
+        )
+        self._watch_thread.start()
+        self._tcp = _TCPServer((self.host, self.port), _Handler)
+        self._tcp.router = self  # type: ignore[attr-defined]
+        self.port = self._tcp.server_address[1]
+        self._tcp_thread = threading.Thread(
+            target=self._tcp.serve_forever, name="sheeprl-router-tcp", daemon=True
+        )
+        self._tcp_thread.start()
+        self.stats.set_gauge("ready", 1)
+        _logger.info("[router] listening on %s:%d", self.host, self.port)
+        return self
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Refuse new work (still answered: ``rejected/draining``), then wait
+        for every in-flight relay to resolve. True if it emptied in time."""
+        with self._lock:
+            self._draining = True
+        self.stats.set_gauge("draining", 1)
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._outstanding == 0:
+                    return True
+            time.sleep(0.02)
+        with self._lock:
+            return self._outstanding == 0
+
+    def close(self) -> None:
+        self.stats.set_gauge("ready", 0)
+        self._watch_stop.set()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=2.0)
+        if self._tcp is not None:
+            self._tcp.shutdown()
+            self._tcp.server_close()
+        self._pool.shutdown(wait=False)
+
+    # ----- membership -----------------------------------------------------------
+    def _watch_loop(self) -> None:
+        while not self._watch_stop.wait(self.membership_poll_s):
+            try:
+                self.refresh_membership()
+            except Exception:  # membership churn must never kill the frontend
+                _logger.exception("[router] membership refresh crashed")
+
+    def refresh_membership(self) -> None:
+        entries = read_membership(self.membership_file)
+        if entries is None:
+            return
+        self.apply_membership(entries)
+
+    def apply_membership(self, entries: List[Dict[str, Any]]) -> None:
+        """Fold one membership view in: max-epoch-per-slot wins, anything
+        below a slot's high-water epoch is a fenced zombie write."""
+        best: Dict[int, Dict[str, Any]] = {}
+        fenced = 0
+        for e in entries:
+            try:
+                slot, epoch = int(e["slot"]), int(e["epoch"])
+            except (KeyError, TypeError, ValueError):
+                fenced += 1  # an unparseable entry routes nowhere either
+                continue
+            prev = best.get(slot)
+            if prev is not None:
+                fenced += 1  # duplicate slot: one of the two is stale
+                if int(prev["epoch"]) >= epoch:
+                    continue
+            best[slot] = e
+        with self._lock:
+            changed = False
+            for slot, e in best.items():
+                epoch = int(e["epoch"])
+                if epoch < self._fence.get(slot, 0):
+                    fenced += 1
+                    continue
+                self._fence[slot] = epoch
+                cur = self._members.get(slot)
+                if cur is not None and cur.epoch == epoch and cur.addr == (e["host"], int(e["port"])):
+                    cur.meta = e
+                    continue
+                self._members[slot] = Member(slot, epoch, e["host"], e["port"], dict(e))
+                changed = True
+            for slot in [s for s in self._members if s not in best]:
+                del self._members[slot]  # absent from the authoritative view: drained/dead
+                changed = True
+            n = len(self._members)
+            epoch_max = max(self._fence.values(), default=0)
+        if fenced:
+            self.stats.inc("fenced_writes", fenced)
+            trace.instant("router/fenced_write", count=fenced)
+        if changed:
+            self.stats.inc("membership_updates")
+        self.stats.set_gauge("members", n)
+        self.stats.set_gauge("epoch_max", epoch_max)
+
+    def members(self) -> List[Member]:
+        with self._lock:
+            return list(self._members.values())
+
+    def _pick(self, exclude: Tuple[int, ...]) -> Optional[Member]:
+        """Least-outstanding-requests pick among live members, preferring ones
+        not already tried for this request; falls back to retried members when
+        the fleet is smaller than the retry budget (one replica left is still
+        a fleet)."""
+        with self._lock:
+            pool = [m for m in self._members.values() if m.slot not in exclude]
+            if not pool:
+                pool = list(self._members.values())
+            if not pool:
+                return None
+            m = min(pool, key=lambda x: (x.outstanding, x.slot))
+            m.outstanding += 1
+            self._outstanding += 1
+            self.stats.set_gauge("outstanding", self._outstanding)
+            return m
+
+    def _release(self, m: Member) -> None:
+        with self._lock:
+            m.outstanding = max(0, m.outstanding - 1)
+            self._outstanding = max(0, self._outstanding - 1)
+            self.stats.set_gauge("outstanding", self._outstanding)
+
+    # ----- request path ---------------------------------------------------------
+    def submit(self, msg: Dict[str, Any], send: Callable[[Dict[str, Any]], None]) -> None:
+        """Admit one infer request; the relay (with retries) runs on the pool
+        so one slow replica never serializes the frontend's read loop."""
+        self.stats.inc("requests_total")
+        rid = msg.get("id")
+        with self._lock:
+            draining = self._draining
+        if draining:
+            self._terminal(send, {"id": rid, "status": "rejected", "reason": "draining"})
+            return
+        try:
+            self._pool.submit(self._relay_with_retries, dict(msg), send)
+        except RuntimeError:  # pool shut down under us: still exactly one answer
+            self._terminal(send, {"id": rid, "status": "rejected", "reason": "draining"})
+
+    def _terminal(self, send: Callable[[Dict[str, Any]], None], resp: Dict[str, Any]) -> None:
+        self.stats.inc(_STATUS_COUNTER.get(resp.get("status"), "errors"))
+        send(resp)
+
+    def _relay_once(self, member: Member, payload: bytes) -> Dict[str, Any]:
+        # Drill sites: `router.dial:raise` = connect refused (replica just
+        # died), `router.relay:raise` = connection torn mid-flight (replica
+        # SIGKILLed with the request on its wire).
+        failpoints.failpoint("router.dial", slot=member.slot)
+        with socket.create_connection(member.addr, timeout=self.dial_timeout_s) as sock:
+            f = sock.makefile("rwb")
+            f.write(payload)
+            f.flush()
+            failpoints.failpoint("router.relay", slot=member.slot)
+            line = f.readline()
+        if not line:
+            raise ConnectionError("replica closed the connection mid-flight")
+        return json.loads(line)
+
+    def _relay_with_retries(self, msg: Dict[str, Any], send: Callable[[Dict[str, Any]], None]) -> None:
+        rid = msg.get("id")
+        msg.setdefault("priority", self.default_priority)
+        deadline_ms = msg.get("deadline_ms")
+        t0 = time.monotonic()
+        deadline_at = None if deadline_ms is None else t0 + float(deadline_ms) / 1000.0
+        payload = (json.dumps(msg) + "\n").encode()
+        tried: List[int] = []
+        last_err = "no live replica in the fleet"
+        with trace.span("router/request", plane="fleet", rid=str(rid)) as sp:
+            for attempt in range(self.retry_budget + 1):
+                if deadline_at is not None and time.monotonic() >= deadline_at:
+                    sp.set(status="deadline_expired", attempts=attempt)
+                    self._terminal(send, {"id": rid, "status": "deadline_expired"})
+                    return
+                member = self._pick(tuple(tried))
+                if member is None:
+                    break  # empty fleet: no point burning the backoff schedule
+                if attempt:
+                    self.stats.inc("retries")
+                try:
+                    try:
+                        with trace.span("router/relay", plane="fleet", slot=member.slot, attempt=attempt):
+                            resp = self._relay_once(member, payload)
+                    finally:
+                        self._release(member)
+                except (OSError, ValueError, ConnectionError) as e:
+                    # transport failure, not a replica answer: the request is
+                    # retryable (inference is pure), on a different replica
+                    tried.append(member.slot)
+                    last_err = f"{type(e).__name__}: {e}"
+                    self.stats.inc("dial_failures")
+                    trace.instant("router/failover", slot=member.slot, attempt=attempt, error=last_err)
+                    sleep_s = jittered_backoff(self.retry_backoff_ms / 1000.0, attempt + 1, 1.0)
+                    if deadline_at is not None:
+                        sleep_s = min(sleep_s, max(0.0, deadline_at - time.monotonic()))
+                    time.sleep(sleep_s)
+                    continue
+                if attempt:
+                    self.stats.inc("failovers")
+                self.stats.observe_latency(time.monotonic() - t0)
+                sp.set(status=str(resp.get("status")), slot=member.slot, attempts=attempt + 1)
+                self._terminal(send, dict(resp, id=rid))
+                return
+            sp.set(status="error", attempts=len(tried))
+            self._terminal(
+                send,
+                {
+                    "id": rid,
+                    "status": "error",
+                    "error": f"no replica answered after {len(tried)} attempt(s): {last_err}",
+                },
+            )
+
+    # ----- observability --------------------------------------------------------
+    def stats_payload(self) -> Dict[str, Any]:
+        payload = self.stats.snapshot()
+        with self._lock:
+            payload["Fleet/member_outstanding"] = {
+                str(m.slot): m.outstanding for m in self._members.values()
+            }
+            payload["Fleet/member_epochs"] = {str(s): e for s, e in self._fence.items()}
+        return payload
+
+    def health_payload(self) -> Dict[str, Any]:
+        with self._lock:
+            n = len(self._members)
+            draining = self._draining
+        return {
+            "ready": n > 0 and not draining,
+            "draining": draining,
+            "members": n,
+            "pid": os.getpid(),
+        }

@@ -3,10 +3,13 @@
 Protocol (one JSON object per line, either direction; responses carry the
 request ``id`` and may arrive out of order on a pipelined connection):
 
-- ``{"id": ..., "obs": {...}, "deadline_ms": 50}`` ->
+- ``{"id": ..., "obs": {...}, "deadline_ms": 50, "priority": 1}`` ->
   ``{"id": ..., "status": "ok", "action": [...], "gen": 2}`` or a terminal
   backpressure answer: ``status`` in ``rejected`` (with ``retry_after_ms`` or
-  ``reason: draining``), ``shed``, ``deadline_expired``, ``error``.
+  ``reason: draining``), ``shed`` (with ``retry_after_ms``),
+  ``deadline_expired``, ``error``. ``priority`` (optional, default 1; 0 =
+  best-effort) selects the shed class under ``admission: shed_oldest`` —
+  priority-0 traffic is shed before priority-1.
 - ``{"op": "stats"}`` -> the ``Serve/*`` snapshot (plus compile totals).
 - ``{"op": "health"}`` -> ``{"ready", "live", "degraded", "draining", "gen"}``.
 - ``{"op": "metrics"}`` -> the whole metrics fabric as a Prometheus
@@ -241,7 +244,11 @@ class PolicyServer:
             return
         deadline_ms = msg.get("deadline_ms")
         deadline_s = None if deadline_ms is None else float(deadline_ms) / 1000.0
-        fut = self.batcher.submit(obs, deadline_s=deadline_s, rid=rid)
+        try:
+            priority = max(0, int(msg.get("priority", 1)))
+        except (TypeError, ValueError):
+            priority = 1  # a malformed class must not cost the request
+        fut = self.batcher.submit(obs, deadline_s=deadline_s, rid=rid, priority=priority)
         fut.add_done_callback(lambda f: send(f.result()))
 
     # ----- observability --------------------------------------------------------------

@@ -29,12 +29,43 @@ COUNTERS = (
 
 GAUGES = ("queue_depth", "queue_peak", "generation", "degraded", "ready", "draining")
 
+# ``Fleet/*`` series: the router + fleet supervisor share ONE of these, so the
+# drill's single ``stats`` op sees request accounting, failover activity and
+# supervision events in the same snapshot. The terminal subset obeys the same
+# invariant as Serve/*: requests_total == ok+shed+rejected+deadline_missed+errors.
+FLEET_COUNTERS = (
+    "requests_total",
+    "ok",
+    "shed",
+    "rejected",
+    "deadline_missed",
+    "errors",
+    "retries",
+    "failovers",
+    "dial_failures",
+    "fenced_writes",
+    "membership_updates",
+    "heartbeats",
+    "replica_restarts",
+    "replica_preemptions",
+    "replica_failures",
+    "replica_kills",
+    "deploys",
+    "deploy_rollbacks",
+)
+
+FLEET_GAUGES = ("members", "outstanding", "ready", "draining", "epoch_max", "replicas_live")
+
 
 class ServeStats:
+    _COUNTERS = COUNTERS
+    _GAUGES = GAUGES
+    _PREFIX = "Serve"
+
     def __init__(self, latency_window: int = 4096):
         self._lock = threading.Lock()
-        self._counts: Dict[str, int] = {k: 0 for k in COUNTERS}
-        self._gauges: Dict[str, float] = {k: 0.0 for k in GAUGES}
+        self._counts: Dict[str, int] = {k: 0 for k in self._COUNTERS}
+        self._gauges: Dict[str, float] = {k: 0.0 for k in self._GAUGES}
         # windowed reservoir: p50/p99 over the LAST N served requests, not the
         # lifetime mean — load tests care about current-tail behaviour. The
         # maxlen bound is what keeps a long-running server's memory flat; the
@@ -83,7 +114,8 @@ class ServeStats:
         return sorted_vals[idx]
 
     def snapshot(self) -> Dict[str, Any]:
-        """``Serve/*``-keyed dict (counters, gauges, occupancy, p50/p99 ms)."""
+        """Prefix-keyed dict (counters, gauges, occupancy, p50/p99 ms)."""
+        p = self._PREFIX
         with self._lock:
             counts = dict(self._counts)
             gauges = dict(self._gauges)
@@ -92,11 +124,22 @@ class ServeStats:
                 self._lat_dirty = False
             lat = self._lat_sorted
             occ = self._occupancy_sum / self._occupancy_n if self._occupancy_n else 0.0
-        out: Dict[str, Any] = {f"Serve/{k}": v for k, v in counts.items()}
-        out.update({f"Serve/{k}": v for k, v in gauges.items()})
-        out["Serve/batch_occupancy"] = occ
-        out["Serve/latency_p50_ms"] = self._percentile(lat, 0.50) * 1000.0
-        out["Serve/latency_p99_ms"] = self._percentile(lat, 0.99) * 1000.0
-        out["Serve/latency_window_size"] = len(lat)
-        out["Serve/latency_window_cap"] = self._latency_cap
+        out: Dict[str, Any] = {f"{p}/{k}": v for k, v in counts.items()}
+        out.update({f"{p}/{k}": v for k, v in gauges.items()})
+        out[f"{p}/batch_occupancy"] = occ
+        out[f"{p}/latency_p50_ms"] = self._percentile(lat, 0.50) * 1000.0
+        out[f"{p}/latency_p99_ms"] = self._percentile(lat, 0.99) * 1000.0
+        out[f"{p}/latency_window_size"] = len(lat)
+        out[f"{p}/latency_window_cap"] = self._latency_cap
         return out
+
+
+class FleetStats(ServeStats):
+    """``Fleet/*`` accounting shared by the failover router and the fleet
+    supervisor. The latency window records ROUTER-side end-to-end latency
+    (admit at the router to terminal response), i.e. what a fleet client
+    actually experiences across failover retries."""
+
+    _COUNTERS = FLEET_COUNTERS
+    _GAUGES = FLEET_GAUGES
+    _PREFIX = "Fleet"

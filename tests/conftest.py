@@ -78,6 +78,18 @@ def pytest_configure(config):
         "self-lint; select with `-m analysis` (or run scripts/lint.sh) before "
         "touching analysis/ or code the self-lint covers",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: the replica-fleet serving plane (serve/fleet.py + serve/router.py) — "
+        "supervisor respawns and epoch fencing, failover/deadline relays, rolling "
+        "certified deploys, and the preemption fan-out drill; select with `-m fleet` "
+        "before touching the fleet supervisor, the router, or their drain contracts",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running end-to-end smokes excluded from the tier-1 `-m 'not slow'` "
+        "sweep; run explicitly (e.g. `-m slow`) before shipping changes they cover",
+    )
 
 
 @pytest.hookimpl(wrapper=True)

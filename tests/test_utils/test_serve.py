@@ -111,6 +111,9 @@ def test_batcher_shed_oldest_admission():
         futs = [b.submit({"i": i}, rid=i) for i in range(8)]
         shed = [f.result(timeout=1) for f in futs if f.done() and f.result()["status"] == "shed"]
         assert shed, "expected oldest-queued requests to be shed"
+        # a shed carries the same back-off hint as a reject: a fleet router
+        # (or any client) can schedule the retry instead of hammering
+        assert all(r["retry_after_ms"] > 0 for r in shed)
         # freshest observations win: the shed ids are strictly older than the
         # ids still waiting in the queue
         hold.set()
@@ -124,6 +127,49 @@ def test_batcher_shed_oldest_admission():
     snap = stats.snapshot()
     assert snap["Serve/shed"] > 0
     assert _counter_sum(snap) == snap["Serve/requests_total"] == 8
+
+
+def test_batcher_shed_oldest_prefers_lowest_priority_class():
+    stats = ServeStats()
+    hold = threading.Event()
+
+    def slow_compute(requests):
+        hold.wait(5)
+        return [{} for _ in requests]
+
+    b = MicroBatcher(
+        slow_compute, max_batch=1, max_wait_s=0.0, max_depth=2, admission="shed_oldest", stats=stats
+    ).start()
+    try:
+        f_busy = b.submit({"i": "busy"}, rid="busy", priority=1)
+        deadline = time.monotonic() + 5
+        while b._queue and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert not b._queue, "compute never picked up the in-flight request"
+        f_p0 = b.submit({}, rid="p0-old", priority=0)
+        f_p1a = b.submit({}, rid="p1-a", priority=1)
+        # queue full at [p0-old, p1-a]: a priority-1 newcomer evicts the
+        # best-effort request, NOT the oldest overall and NOT itself
+        f_p1b = b.submit({}, rid="p1-b", priority=1)
+        r = f_p0.result(timeout=5)
+        assert r["status"] == "shed"
+        assert r["retry_after_ms"] > 0
+        assert not f_p1a.done() and not f_p1b.done()
+        # queue full at [p1-a, p1-b]: a best-effort newcomer is strictly lower
+        # priority than everything queued, so it sheds ITSELF
+        f_p0b = b.submit({}, rid="p0-new", priority=0)
+        r = f_p0b.result(timeout=5)
+        assert r["status"] == "shed"
+        assert r["retry_after_ms"] > 0
+        hold.set()
+        for f in (f_busy, f_p1a, f_p1b):
+            assert f.result(timeout=5)["status"] == "ok"
+    finally:
+        hold.set()
+        b.close()
+    snap = stats.snapshot()
+    assert snap["Serve/shed"] == 2
+    assert _counter_sum(snap) == snap["Serve/requests_total"] == 5
 
 
 def test_batcher_expired_deadline_dropped_before_compute():
@@ -385,6 +431,40 @@ def test_reloader_recovers_after_failures(tmp_path):
     snap = stats.snapshot()
     assert snap["Serve/degraded"] == 0.0  # cleared on success
     assert store.get().step == 200
+
+
+def test_reloader_recovery_emits_incident_close_event(tmp_path):
+    """The success that clears the degraded latch writes a
+    ``serve_reload_recovered`` event row (with the failure streak it cleared);
+    an ordinary healthy reload does not — recovery rows close incidents."""
+    import json
+
+    # keep the reloader's events dir (``dirname(ckpt_dir)/health``) inside
+    # tmp_path by scanning a subdirectory
+    ckpt_dir = tmp_path / "checkpoint"
+    ckpt_dir.mkdir()
+    engine = _FakeEngine(fail_canary=True)
+    r, store, stats = _reloader(ckpt_dir, engine, degraded_after=1)
+    _write_certified(ckpt_dir, 100)
+    assert r.scan_once() is None
+    assert stats.snapshot()["Serve/degraded"] == 1.0
+    engine.fail_canary = False
+    _write_certified(ckpt_dir, 200)
+    assert r.scan_once() == 2
+
+    events_path = os.path.join(r.events_dir, "events.jsonl")
+    rows = [json.loads(line) for line in open(events_path)]
+    recovered = [e for e in rows if e["event"] == "serve_reload_recovered"]
+    assert len(recovered) == 1
+    assert recovered[0]["failures_cleared"] == 1
+    assert recovered[0]["step"] == 200
+    assert recovered[0]["gen_id"] == 2
+
+    # a further healthy reload (no latch to clear) must NOT re-emit
+    _write_certified(ckpt_dir, 300)
+    assert r.scan_once() == 3
+    rows = [json.loads(line) for line in open(events_path)]
+    assert len([e for e in rows if e["event"] == "serve_reload_recovered"]) == 1
 
 
 def test_reloader_skips_boot_artifact(tmp_path):

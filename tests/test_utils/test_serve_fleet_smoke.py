@@ -1,0 +1,83 @@
+"""Registration of scripts/serve_fleet_smoke.py: the replica-fleet chaos
+drill — 3 supervised serve replicas behind the failover router under sustained
+mixed-priority closed-loop load, through a priority-aware shed burst, a
+mid-load SIGKILL (failover + epoch-bumped respawn), a rolled-back-then-landed
+rolling certified deploy, a forged zombie-generation membership write that the
+router fences without dialing, and a fleet-wide SIGTERM drain — with every
+request id resolving to exactly one terminal status and zero non-shed losses.
+
+Marked ``slow``: the drill boots ~9 real serve replica incarnations (one JAX
+interpreter each) and runs ~70 s, which does not fit the tier-1 wall-clock
+budget. The tier-1 `-m fleet` tests in test_serve_fleet.py cover the same
+supervisor/router/drain contracts against stub replicas; run this drill
+explicitly (`-m slow`, or the script directly) before touching the fleet
+plane's process-management or deploy seams."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+@pytest.mark.faults
+@pytest.mark.fleet
+@pytest.mark.timeout(600)
+def test_serve_fleet_smoke_chaos_drill(tmp_path):
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "serve_fleet_smoke.py"),
+            "--workdir",
+            str(tmp_path),
+            "--timeout",
+            "520",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=560,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-2500:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "fleet smoke OK" in out.stdout
+    # the drill's own assertions already ran; independently re-audit the
+    # shutdown snapshot it leaves behind
+    with open(tmp_path / "fleet_stats.json") as f:
+        stats = json.load(f)
+    assert stats["drained"] is True, stats
+    terminal = (
+        stats["Fleet/ok"]
+        + stats["Fleet/shed"]
+        + stats["Fleet/rejected"]
+        + stats["Fleet/deadline_missed"]
+        + stats["Fleet/errors"]
+    )
+    assert stats["Fleet/requests_total"] == terminal, stats
+    assert stats["Fleet/ok"] > 0, stats
+    assert stats["Fleet/errors"] == 0, stats
+    # the chaos actually happened: a crash-respawn, a canary rollback, a
+    # landed deploy, and at least one fenced zombie write
+    assert stats["Fleet/replica_restarts"] >= 1, stats
+    assert stats["Fleet/deploy_rollbacks"] >= 1, stats
+    assert stats["Fleet/deploys"] >= 1, stats
+    assert stats["Fleet/fenced_writes"] >= 1, stats
+    # every FINAL replica incarnation drained to rc 0 with its own clean books
+    finals = [r for r in stats["replicas"] if r["final"]]
+    assert len(finals) == 3, stats["replicas"]
+    for row in finals:
+        assert row["rc"] == 0, row
+        rstats = row["stats"]
+        assert rstats["drained"] is True, row
+        rterminal = (
+            rstats["Serve/ok"]
+            + rstats["Serve/shed"]
+            + rstats["Serve/rejected"]
+            + rstats["Serve/deadline_missed"]
+            + rstats["Serve/errors"]
+        )
+        assert rstats["Serve/requests_total"] == rterminal, row
+        assert rstats["Compile/retraces"] == 0, row
