@@ -1298,6 +1298,235 @@ def bench_rssm(
     return result
 
 
+def _fsdp_child_main(iters: int = 5) -> dict:
+    """The in-process body of ``bench.py --target fsdp`` (see :func:`bench_fsdp`).
+
+    Runs inside a subprocess pinned to an 8-device virtual CPU mesh
+    (``--xla_force_host_platform_device_count=8`` must be in XLA_FLAGS before
+    jax initializes — which is why the parent cannot run this inline). Three
+    arms over the same tiny MLP regression step:
+
+    - **handoff**: ``parallel/handoff.shard_put`` byte accounting for a
+      rollout-shaped payload vs the replicated ``device_put`` path — the
+      headline ``fsdp_handoff_bytes_per_iter`` and the strict
+      ``sharded < replicated`` acceptance gate.
+    - **ddp vs fsdp**: jitted donated-carry train step with replicated vs
+      parameter-sharded (``Runtime.place_params``) state — step time and
+      device-0 param+opt footprint.
+    - **overlap**: the same update inside the portable ``shard_map`` shim with
+      ``overlap.accumulate_grads`` at 1 vs 4 microbatches (per-bucket psum) —
+      the gradient-sync overlap arm. All programs compile through
+      ``guarded_jit`` so the pinned program ledger records their collective
+      op counts/bytes (the HLO auditor's rows come back in the result).
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from sheeprl_tpu.core import compile as jax_compile
+    from sheeprl_tpu.core.runtime import Runtime
+    from sheeprl_tpu.data.device_buffer import _shard_map
+    from sheeprl_tpu.parallel import handoff, overlap
+    from sheeprl_tpu.telemetry import programs as tel_programs
+
+    out: dict = {"fsdp_devices": jax.device_count(), "fsdp_backend": jax.default_backend()}
+    out["fsdp_xla_profile_applied"] = overlap.apply_xla_profile("overlap")
+
+    # ---- tiny MLP regression step (shared by every arm)
+    D, H, B = 256, 512, 512
+    rng = np.random.default_rng(0)
+    # master copies stay HOST numpy: on the CPU backend device_put aliases a
+    # same-process jax buffer zero-copy, so a donated placed copy would delete
+    # the master under the next arm's feet
+    params = {
+        "w1": (rng.standard_normal((D, H)) * 0.02).astype(np.float32),
+        "b1": np.zeros((H,), np.float32),
+        "w2": (rng.standard_normal((H, H)) * 0.02).astype(np.float32),
+        "b2": np.zeros((H,), np.float32),
+        "w3": (rng.standard_normal((H, D)) * 0.02).astype(np.float32),
+        "b3": np.zeros((D,), np.float32),
+    }
+    tx = optax.adam(1e-3)
+    batch = {
+        "x": rng.standard_normal((B, D)).astype(np.float32),
+        "y": rng.standard_normal((B, D)).astype(np.float32),
+    }
+
+    def loss_fn(p, b):
+        h = jax.nn.relu(b["x"] @ p["w1"] + p["b1"])
+        h = jax.nn.relu(h @ p["w2"] + p["b2"])
+        pred = h @ p["w3"] + p["b3"]
+        return jnp.mean(jnp.square(pred - b["y"])), ()
+
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+    # ---- arm 1: per-shard handoff bytes on a rollout-shaped payload
+    T, E = 16, 64
+    payload = {
+        "obs": rng.standard_normal((T, E, 128)).astype(np.float32),
+        "actions": rng.standard_normal((T, E, 6)).astype(np.float32),
+        "values": rng.standard_normal((T, E, 1)).astype(np.float32),
+        "rewards": rng.standard_normal((T, E, 1)).astype(np.float32),
+        "dones": np.zeros((T, E, 1), np.float32),
+    }
+    rt = Runtime(accelerator="cpu", devices=8, strategy="auto", precision="32-true")
+    handoff.reset_stats()
+    sharded = handoff.shard_put(payload, rt.mesh, batch_axis=1)
+    jax.block_until_ready(sharded)
+    st = handoff.stats()
+    replicated_bytes = handoff.replicated_put_bytes(payload, rt.mesh)
+    out["fsdp_handoff_bytes_per_iter"] = int(st["put_bytes"])
+    out["fsdp_handoff_puts_per_iter"] = int(st["puts"])
+    out["fsdp_handoff_replicated_bytes_per_iter"] = int(replicated_bytes)
+    out["fsdp_handoff_reduction_x"] = round(replicated_bytes / max(st["put_bytes"], 1), 2)
+    # acceptance gate: the sharded handoff must move STRICTLY fewer bytes than
+    # the replicated path it replaces
+    out["fsdp_handoff_gate_pass"] = bool(st["put_bytes"] < replicated_bytes)
+
+    # ---- arm 2: ddp vs fsdp step time + device-0 param/opt footprint
+    dev0 = rt.mesh.devices.ravel()[0]
+
+    def _dev0_mb(tree) -> float:
+        total = 0
+        for leaf in jax.tree_util.tree_leaves(tree):
+            if isinstance(leaf, jax.Array):
+                for s in leaf.addressable_shards:
+                    if s.device == dev0:
+                        total += s.data.nbytes
+        return round(total / 1e6, 3)
+
+    def step(p, o, b):
+        (loss, _), grads = grad_fn(p, b)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, loss
+
+    def _fresh(tree):
+        # defensive copy: the placed state is donated, and a zero-copy
+        # device_put must never hand the master's memory to the donation
+        return jax.tree_util.tree_map(np.array, tree)
+
+    for strategy in ("auto", "fsdp"):
+        srt = Runtime(accelerator="cpu", devices=8, strategy=strategy, precision="32-true")
+        p = srt.place_params(_fresh(params))
+        o = srt.place_params(tx.init(_fresh(params)))
+        b = handoff.shard_put(batch, srt.mesh, batch_axis=0)
+        label = "ddp" if strategy == "auto" else "fsdp"
+        gfn = jax_compile.guarded_jit(step, name=f"bench.fsdp_step_{label}", donate_argnums=(0, 1))
+        # AOT so the program lands in the pinned ledger with the HLO collective audit
+        gfn.aot_compile(jax_compile.specs_of(p), jax_compile.specs_of(o), jax_compile.specs_of(b))
+        p, o, loss = gfn(p, o, b)  # warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, loss = gfn(p, o, b)
+        jax.block_until_ready(loss)
+        out[f"fsdp_{label}_step_ms"] = round((time.perf_counter() - t0) / iters * 1e3, 3)
+        out[f"fsdp_{label}_dev0_param_opt_mb"] = _dev0_mb((p, o))
+    if out.get("fsdp_ddp_dev0_param_opt_mb"):
+        out["fsdp_vs_ddp_mem_x"] = round(
+            out["fsdp_ddp_dev0_param_opt_mb"] / max(out["fsdp_fsdp_dev0_param_opt_mb"], 1e-9), 2
+        )
+
+    # ---- arm 3: gradient-sync overlap (microbatched per-bucket psum) at
+    # 1 vs 4 microbatches inside the portable shard_map shim
+    mesh = rt.mesh
+    for m in (1, 4):
+
+        def overlap_body(p, o, b, _m=m):
+            (loss, _), grads = overlap.accumulate_grads(
+                grad_fn, p, b, microbatches=_m, axis_name="data", axis_size=8
+            )
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return p, o, jax.lax.pmean(loss, "data")
+
+        sm = _shard_map(
+            overlap_body, mesh=mesh,
+            in_specs=(P(), P(), P("data")), out_specs=(P(), P(), P()),
+        )
+        gfn = jax_compile.guarded_jit(sm, name=f"bench.fsdp_overlap_m{m}", donate_argnums=(0, 1))
+        p = rt.place_params(_fresh(params))
+        o = rt.place_params(tx.init(_fresh(params)))
+        b = handoff.shard_put(batch, mesh, batch_axis=0)
+        gfn.aot_compile(jax_compile.specs_of(p), jax_compile.specs_of(o), jax_compile.specs_of(b))
+        p, o, loss = gfn(p, o, b)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            p, o, loss = gfn(p, o, b)
+        jax.block_until_ready(loss)
+        key = "fsdp_overlap_step_ms" if m == 4 else "fsdp_overlap_m1_step_ms"
+        out[key] = round((time.perf_counter() - t0) / iters * 1e3, 3)
+
+    # ---- HLO collective audit: every mesh program above landed in the pinned
+    # program ledger (SHEEPRL_TPU_PROGRAMS, set by the parent) with the
+    # auditor's collective dict — surface the per-program summary
+    collective = {}
+    for row in tel_programs.snapshot():
+        col = row.get("collective")
+        if col and row.get("name", "").startswith("bench.fsdp"):
+            collective[row["name"]] = {
+                "op_count": col.get("op_count"),
+                "bytes": col.get("bytes"),
+                "async_pairs": col.get("async_pairs"),
+                "sync_ops": col.get("sync_ops"),
+            }
+    if collective:
+        out["fsdp_collective"] = collective
+        out["fsdp_collective_bytes_total"] = int(
+            sum(c.get("bytes") or 0 for c in collective.values())
+        )
+    return out
+
+
+def bench_fsdp(iters: int = 5, timeout_s: float = 600.0) -> dict:
+    """DDP-vs-FSDP-vs-overlap step time + per-shard handoff bytes (ISSUE 18).
+
+    Folds the retired ``scripts/fsdp_bench.py`` into the sentinel-gated bench:
+    the measurement runs in a SUBPROCESS pinned to an 8-device virtual CPU
+    mesh (``--xla_force_host_platform_device_count`` only takes effect before
+    jax initializes) with a private compiled-program ledger, so the HLO
+    collective auditor's rows come back with the timings. Headline:
+    ``fsdp_handoff_bytes_per_iter`` (sentinel class ``handoff_bytes``,
+    direction *lower*) — the bytes the donated per-shard rollout handoff
+    actually moves, vs the replicated path's ``mesh_size x`` copy.
+    """
+    import os
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        xla = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xla:
+            env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
+        env["SHEEPRL_TPU_PROGRAMS"] = os.path.join(td, "programs.jsonl")
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["_SHEEPRL_BENCH_FSDP_CHILD"] = str(int(iters))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(repo, "bench.py")],
+                env=env, capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            return {"fsdp_error": f"child exceeded {timeout_s}s"}
+        for line in proc.stdout.splitlines():
+            if line.startswith("FSDP_BENCH "):
+                try:
+                    return json.loads(line[len("FSDP_BENCH "):])
+                except ValueError:
+                    break
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        return {"fsdp_error": f"child rc={proc.returncode}: " + " | ".join(tail)}
+
+
 def _target_metric(target: str) -> str:
     """Headline metric name for a bench target — the watchdog's failure record
     must name the metric the selected target WOULD have produced, not hardcode
@@ -1316,6 +1545,7 @@ def _target_metric(target: str) -> str:
         "ingraph_train": "ingraph_fused_train_env_steps_per_sec",
         "telemetry": "telemetry_tracer_overhead_pct",
         "rssm": "rssm_fused_bytes_per_step",
+        "fsdp": "fsdp_handoff_bytes_per_iter",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
     }[target]
@@ -1337,6 +1567,7 @@ _METRIC_UNITS = {
     "ingraph_fused_train_env_steps_per_sec": "env-steps/s",
     "telemetry_tracer_overhead_pct": "%",
     "rssm_fused_bytes_per_step": "bytes/step",
+    "fsdp_handoff_bytes_per_iter": "bytes/iter",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
 
@@ -1365,6 +1596,9 @@ _SENTINEL_CLASSES = (
     # cost-model bytes are deterministic per (shape, compiler) — any growth is
     # a real fusion/residual regression, so the threshold is tight
     ("bytes_per_step", "lower", 0.02),
+    # per-shard handoff bytes are pure payload-shape arithmetic — growth means
+    # a leaf fell off the sharded path back onto the replicated one
+    ("handoff_bytes", "lower", 0.02),
 )
 
 
@@ -1553,6 +1787,12 @@ if __name__ == "__main__":
     import argparse
     import os
 
+    if os.environ.get("_SHEEPRL_BENCH_FSDP_CHILD"):
+        # subprocess body of bench_fsdp: the parent set XLA_FLAGS for the
+        # 8-device virtual mesh and a pinned program ledger before spawning us
+        print("FSDP_BENCH " + json.dumps(_fsdp_child_main(int(os.environ["_SHEEPRL_BENCH_FSDP_CHILD"]))))
+        sys.exit(0)
+
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
@@ -1569,6 +1809,7 @@ if __name__ == "__main__":
             "ingraph_train",
             "telemetry",
             "rssm",
+            "fsdp",
             "all",
         ),
         default="all",
@@ -1785,6 +2026,16 @@ if __name__ == "__main__":
                 result.setdefault("value", rs.get("rssm_fused_bytes_per_step"))
                 result.setdefault("unit", "bytes/step")
                 result.setdefault("vs_baseline", rs.get("rssm_bytes_reduction_pct"))
+            if cli_args.target == "fsdp":
+                # opt-in only: DDP-vs-FSDP-vs-overlap step time + per-shard
+                # handoff bytes on the 8-device virtual mesh (subprocess child;
+                # folds the retired scripts/fsdp_bench.py into the sentinel)
+                fs = bench_fsdp()
+                result.update(fs)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", fs.get("fsdp_handoff_bytes_per_iter"))
+                result.setdefault("unit", "bytes/iter")
+                result.setdefault("vs_baseline", fs.get("fsdp_handoff_reduction_x"))
             if cli_args.target == "transport":
                 # opt-in only: host control-plane latency/throughput drill
                 # (sockets + failpoints; no accelerator involved at all)

@@ -37,6 +37,13 @@ with contextlib.redirect_stdout(sys.stderr):
     run(overrides=overrides)
 stats = jax_compile.process_stats()
 
+# the mesh-training chaos seams must have been exercised: the per-shard
+# rollout handoff put and the microbatched grad-sync dispatch both carry
+# armed `fire` failpoints (SHEEPRL_TPU_FAILPOINTS, set by the parent)
+from sheeprl_tpu.core import failpoints
+
+fp_fires = {name: c["fires"] for name, c in failpoints.counts().items()}
+
 # random-policy drive through the debug step path: episodes must finish with
 # finite returns (auto-reset keeps every env alive the whole time)
 from sheeprl_tpu.config import load_config
@@ -59,6 +66,7 @@ print("INGRAPH_SMOKE " + json.dumps({
     "aot_compiles": stats["aot_compiles"],
     "n_episodes": len(returns),
     "mean_return": (sum(returns) / len(returns)) if returns else None,
+    "failpoint_fires": fp_fires,
 }), flush=True)
 """
 
@@ -73,6 +81,7 @@ OVERRIDES = [
     "algo.mlp_keys.encoder=[state]",
     "algo.cnn_keys.encoder=[]",
     "algo.run_test=False",
+    "algo.grad_microbatches=2",  # the accumulation scan must hold on the fused path too
     "metric.log_level=0",
     "metric.disable_timer=True",
     "checkpoint.every=999999999",
@@ -91,6 +100,11 @@ def main(workdir: str | None = None, timeout: float = 480.0) -> dict:
         PYTHONPATH=REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
         SHEEPRL_TPU_COMP_CACHE_DIR=os.path.join(workdir, "xla_cache"),
         _SHEEPRL_INGRAPH_SMOKE_OVERRIDES=json.dumps(OVERRIDES),
+        # arm the grad-sync chaos seam in benign `fire` mode: the fused run must
+        # actually pass through the microbatched update dispatch every iteration
+        # (the handoff seam has no site here — fused data never leaves the
+        # device; the decoupled FSDP tests drill handoff.shard_put instead)
+        SHEEPRL_TPU_FAILPOINTS="train.grad_sync:fire",
     )
     proc = subprocess.run(
         [sys.executable, "-c", _CHILD],
@@ -114,6 +128,12 @@ def main(workdir: str | None = None, timeout: float = 480.0) -> dict:
         raise SystemExit("no episode finished in 64 random-policy steps x 8 envs")
     if stats["mean_return"] is None or not math.isfinite(stats["mean_return"]):
         raise SystemExit(f"non-finite mean episode return: {stats['mean_return']}")
+    fires = stats.get("failpoint_fires") or {}
+    if int(fires.get("train.grad_sync", 0)) < 1:
+        raise SystemExit(
+            "failpoint 'train.grad_sync' never fired during the smoke — the run did "
+            f"not pass through the grad-sync dispatch seam (fires: {json.dumps(fires)})"
+        )
 
     print(f"ingraph smoke OK: {json.dumps(stats)}")
     return stats

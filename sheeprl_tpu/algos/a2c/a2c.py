@@ -31,6 +31,7 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
+from sheeprl_tpu.parallel import handoff, overlap
 from sheeprl_tpu.telemetry import device as tel_device
 from sheeprl_tpu.telemetry import programs as tel_programs
 from sheeprl_tpu.telemetry import trace
@@ -85,6 +86,14 @@ def make_update_impl(
         return total, (pg_loss, v_loss)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    micro = overlap.microbatches(cfg)
+    # gradient-sync overlap (parallel/overlap.py): with micro > 1 each
+    # minibatch's gradient is computed chunk-by-chunk with a per-bucket psum,
+    # so the returned per-minibatch gradient is ALREADY axis-averaged — the
+    # single post-scan pmean below must then be skipped for grads (the scalar
+    # sums still reduce once). micro == 1 keeps the op-identical reference
+    # path: local grads accumulated, ONE pmean at the end.
+    inner_axis = axis_name if micro > 1 else None
 
     def train(params, opt_state, data, next_values, key, lr_scale):
         returns, advantages = gae(
@@ -123,7 +132,10 @@ def make_update_impl(
             else:
                 # shard-local body: the rows are already this shard's block
                 batch = jax.tree_util.tree_map(lambda v: jnp.take(v, idx, axis=0), flat)
-            (_, (pg, vl)), grads = grad_fn(params, batch)
+            (_, (pg, vl)), grads = overlap.accumulate_grads(
+                grad_fn, params, batch,
+                microbatches=micro, axis_name=inner_axis, axis_size=shards,
+            )
             grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
             return (grads_acc, pg_acc + pg, v_acc + vl), None
 
@@ -135,8 +147,11 @@ def make_update_impl(
         if axis_name is not None:
             # data-parallel all-reduce of the ONE accumulated update; the loss
             # sums reduce too so the finite_or_skip decision below is
-            # replicated (a shard-local skip would fork the param replicas)
-            grads = jax.lax.pmean(grads, axis_name)
+            # replicated (a shard-local skip would fork the param replicas).
+            # With microbatching the grads already all-reduced per bucket
+            # inside accumulate_grads — only the scalars remain.
+            if inner_axis is None:
+                grads = jax.lax.pmean(grads, axis_name)
             pg_sum = jax.lax.pmean(pg_sum, axis_name)
             v_sum = jax.lax.pmean(v_sum, axis_name)
         gnorm = optax.global_norm(grads)
@@ -348,8 +363,10 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_fn,
                 jax_compile.specs_of(params),
                 jax_compile.specs_of(opt_state),
-                data_specs,
-                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+                # the handoff assembles the batch PRE-SHARDED on the mesh (env
+                # axis): warmup against that layout (see ppo.py)
+                handoff.shard_specs(data_specs, runtime.mesh, batch_axis=1),
+                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32, sharding=runtime.replicated),
                 jax_compile.spec_like(rng),
                 jax.ShapeDtypeStruct((), jnp.float32),
             )
@@ -389,7 +406,8 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_fn,
                 jax_compile.specs_of(params),
                 jax_compile.specs_of(opt_state),
-                data_specs,
+                # host rollout enters the mesh shard-at-put (env axis)
+                handoff.shard_specs(data_specs, runtime.mesh, batch_axis=1),
                 jax.ShapeDtypeStruct(val_s.shape, jnp.float32),
                 jax_compile.spec_like(rng),
                 jax.ShapeDtypeStruct((), jnp.float32),
@@ -489,6 +507,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # rollout scan, GAE, and the accumulated update run as ONE
                 # compiled donated-carry program (see ppo.py)
                 failpoints.failpoint("train.fused_update", iter=iter_num)
+                failpoints.failpoint(
+                    "train.grad_sync", iter=iter_num, microbatches=overlap.microbatches(cfg)
+                )
                 with trace.span("train/update", fused=True, iter=iter_num), timer(
                     "Time/train_time", SumMetric()
                 ):
@@ -584,25 +605,33 @@ def main(runtime, cfg: Dict[str, Any]):
                         # inside the train call (the rollout overlapped the thread)
                         warmup.wait()
                     rng, train_key = jax.random.split(rng)
+                    # ----- donated per-shard handoff (parallel/handoff.py): the
+                    # [T, B, *] rollout shards on the env axis (B) so GAE's scan
+                    # over T stays shard-local — each mesh device receives ONE
+                    # put of only its env block instead of a full replicated
+                    # copy. Bootstrap values are tiny and stay replicated.
                     if use_ingraph:
-                        # rollout and bootstrap values already on device in the
-                        # buffer layout; one collect-device -> trainer-mesh move
-                        device_data, next_values = runtime.replicate(
-                            (ingraph_data, ingraph_next_values)
+                        device_data = handoff.shard_put(
+                            ingraph_data, runtime.mesh, batch_axis=1
                         )
+                        next_values = runtime.replicate(ingraph_next_values)
                     elif device_rollout:
-                        # HBM rollout + bootstrap values: player-device -> trainer-mesh,
-                        # no host round-trip
                         jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
-                        device_data, next_values = runtime.replicate(
-                            (rb.rollout(), player.get_values(jax_obs))
+                        device_data = handoff.shard_put(
+                            rb.rollout(), runtime.mesh, batch_axis=1
                         )
+                        next_values = runtime.replicate(player.get_values(jax_obs))
                     else:
                         jax_obs = prepare_obs(runtime, next_obs, num_envs=n_envs)
                         next_values = np.asarray(player.get_values(jax_obs))
-                        device_data = {
-                            k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                        }
+                        device_data = handoff.shard_put(
+                            {k: v for k, v in local_data.items() if k not in ("returns", "advantages")},
+                            runtime.mesh,
+                            batch_axis=1,
+                        )
+                    failpoints.failpoint(
+                        "train.grad_sync", iter=iter_num, microbatches=overlap.microbatches(cfg)
+                    )
                     params, opt_state, flat_params, train_metrics = train_fn(
                         params, opt_state, device_data, next_values, train_key,
                         jnp.float32(sentinel.lr_scale),

@@ -85,6 +85,13 @@ class DV3OptStates(NamedTuple):
 
 def make_train_fn(modules: DV3Modules, cfg, runtime, is_continuous: bool, actions_dim: Sequence[int], psync=None):
     """Build (init_opt, train) where train is a single jitted scan over G gradient steps."""
+    if int(cfg.algo.get("grad_microbatches", 1) or 1) > 1:
+        # DV3's world-model/actor/critic updates chain through the latent
+        # rollout — chunking the [B, T] batch would change the sequence model's
+        # statistics, not just the reduction order
+        warnings.warn(
+            "algo.grad_microbatches > 1 is not supported by DreamerV3; falling back to 1"
+        )
     rssm = modules.rssm
     horizon = int(cfg.algo.horizon)
     gamma = float(cfg.algo.gamma)

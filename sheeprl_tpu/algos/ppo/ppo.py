@@ -38,6 +38,7 @@ from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
 from sheeprl_tpu.envs import ingraph as ingraph_envs
+from sheeprl_tpu.parallel import handoff, overlap
 from sheeprl_tpu.telemetry import device as tel_device
 from sheeprl_tpu.telemetry import programs as tel_programs
 from sheeprl_tpu.telemetry import trace
@@ -105,6 +106,7 @@ def make_update_impl(
         return total, (pg_loss, v_loss, ent_loss)
 
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    micro = overlap.microbatches(cfg)
 
     def train(params, opt_state, data, next_values, key, clip_coef, ent_coef, lr_scale):
         # ----- GAE on device (reverse lax.scan over T; reference utils.py:64-100)
@@ -148,12 +150,17 @@ def make_update_impl(
             else:
                 # shard-local body: the rows are already this shard's block
                 batch = jax.tree_util.tree_map(lambda v: jnp.take(v, idx, axis=0), flat)
-            (loss, (pg, vl, ent)), grads = grad_fn(params, batch, clip_coef, ent_coef)
+            # grad_microbatches=1 is the verbatim single-batch backward + one
+            # pmean; >1 runs the bucketed accumulation scan with a per-bucket
+            # psum (parallel/overlap.py) — grads come back already axis-averaged
+            (loss, (pg, vl, ent)), grads = overlap.accumulate_grads(
+                grad_fn, params, batch, (clip_coef, ent_coef),
+                microbatches=micro, axis_name=axis_name, axis_size=shards,
+            )
             if axis_name is not None:
-                # data-parallel all-reduce; the loss scalars reduce too so the
-                # finite_or_skip decision below is replicated across shards
-                # (a shard-local skip would silently fork the param replicas)
-                grads = jax.lax.pmean(grads, axis_name)
+                # the loss scalars reduce too so the finite_or_skip decision
+                # below is replicated across shards (a shard-local skip would
+                # silently fork the param replicas)
                 loss, pg, vl, ent = (jax.lax.pmean(x, axis_name) for x in (loss, pg, vl, ent))
             gnorm = optax.global_norm(grads)
             updates, new_opt_state = tx.update(grads, opt_state, params)
@@ -186,10 +193,18 @@ def make_update_impl(
     return train
 
 
-def make_train_fn(agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None):
-    """The jitted split-path train step (see :func:`make_update_impl`)."""
+def make_train_fn(
+    agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None, *, donate_data=False
+):
+    """The jitted split-path train step (see :func:`make_update_impl`).
+
+    ``donate_data=True`` additionally donates the rollout ``data`` tree — safe
+    when every caller hands over a freshly assembled batch it never reads
+    again (the decoupled trainer's per-shard handoff does exactly that; the
+    coupled loop keeps the default so diagnostic spies can still read it)."""
     train = make_update_impl(agent, tx, cfg, runtime, n_data, obs_keys, cnn_keys, params_sync)
-    return jax_compile.guarded_jit(train, name="ppo.train", donate_argnums=(0, 1))
+    donate = (0, 1, 2) if donate_data else (0, 1)
+    return jax_compile.guarded_jit(train, name="ppo.train", donate_argnums=donate)
 
 
 @register_algorithm()
@@ -450,8 +465,11 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_fn,
                 jax_compile.specs_of(params),
                 jax_compile.specs_of(opt_state),
-                data_specs,
-                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32),
+                # the handoff below assembles the batch PRE-SHARDED on the mesh
+                # (env axis): the warmup specs must carry that layout or the
+                # AOT executable rejects the real batch at call time
+                handoff.shard_specs(data_specs, runtime.mesh, batch_axis=1),
+                jax.ShapeDtypeStruct(nv_spec.shape, jnp.float32, sharding=runtime.replicated),
                 jax_compile.spec_like(rng),
                 jax.ShapeDtypeStruct((), jnp.float32),
                 jax.ShapeDtypeStruct((), jnp.float32),
@@ -498,7 +516,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 train_fn,
                 jax_compile.specs_of(params),
                 jax_compile.specs_of(opt_state),
-                data_specs,
+                # the host rollout enters the mesh shard-at-put (env axis) —
+                # warmup against that layout, not a replicated one
+                handoff.shard_specs(data_specs, runtime.mesh, batch_axis=1),
                 jax.ShapeDtypeStruct(val_s.shape, jnp.float32),
                 jax_compile.spec_like(rng),
                 jax.ShapeDtypeStruct((), jnp.float32),
@@ -612,6 +632,9 @@ def main(runtime, cfg: Dict[str, Any]):
                 # leaves return to the host. Chaos seam first, so drills and
                 # the sentinel's rollback ladder cover the fused path too.
                 failpoints.failpoint("train.fused_update", iter=iter_num)
+                failpoints.failpoint(
+                    "train.grad_sync", iter=iter_num, microbatches=overlap.microbatches(cfg)
+                )
                 with trace.span("train/update", fused=True, iter=iter_num), timer(
                     "Time/train_time", SumMetric()
                 ):
@@ -762,29 +785,37 @@ def main(runtime, cfg: Dict[str, Any]):
                         # rollout overlapped the warmup thread)
                         warmup.wait()
                     rng, train_key = jax.random.split(rng)
+                    # ----- per-shard rollout handoff (parallel/handoff.py): the
+                    # bulk [T, B, *] rollout is assembled mesh-sharded on the env
+                    # axis — one put per device shard, no full-batch replication,
+                    # no post-put host-side copy; only the small bootstrap values
+                    # still replicate. GAE then runs shard-local over B.
                     if use_ingraph:
-                        # rollout and bootstrap values are already on device in the
-                        # buffer layout; one collect-device -> trainer-mesh move
-                        device_data, next_values = runtime.replicate(
-                            (ingraph_data, ingraph_next_values)
-                        )
+                        device_data = handoff.shard_put(ingraph_data, runtime.mesh, batch_axis=1)
+                        next_values = runtime.replicate(ingraph_next_values)
                     elif device_rollout:
-                        # zero bulk host->device transfer: the completed HBM rollout and
-                        # the bootstrap values move player-device -> trainer-mesh directly
-                        # (ownership transfers out of the buffer, so the train fn's view
-                        # is never aliased by next iteration's donated writes)
+                        # the completed HBM rollout and the bootstrap values move
+                        # player-device -> trainer-mesh directly (ownership
+                        # transfers out of the buffer, so the train fn's view is
+                        # never aliased by next iteration's donated writes)
                         jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
-                        device_data, next_values = runtime.replicate(
-                            (rb.rollout(), player.get_values(jax_obs))
-                        )
+                        device_data = handoff.shard_put(rb.rollout(), runtime.mesh, batch_axis=1)
+                        next_values = runtime.replicate(player.get_values(jax_obs))
                     else:
-                        # bootstrap values come from the player device; re-enter the mesh
-                        # uncommitted so the jitted train step can place them freely
+                        # bootstrap values come from the player device; the host
+                        # rollout enters the mesh shard-at-put
                         jax_obs = prepare_obs(runtime, next_obs, cnn_keys=cnn_keys, num_envs=n_envs)
                         next_values = np.asarray(player.get_values(jax_obs))
-                        device_data = {
-                            k: jnp.asarray(v) for k, v in local_data.items() if k not in ("returns", "advantages")
-                        }
+                        device_data = handoff.shard_put(
+                            {k: v for k, v in local_data.items() if k not in ("returns", "advantages")},
+                            runtime.mesh,
+                            batch_axis=1,
+                        )
+                    # chaos seam for the (possibly microbatched) gradient-sync
+                    # dispatch — the split-path twin of train.fused_update above
+                    failpoints.failpoint(
+                        "train.grad_sync", iter=iter_num, microbatches=overlap.microbatches(cfg)
+                    )
                     params, opt_state, flat_params, train_metrics = train_fn(
                         params,
                         opt_state,

@@ -41,11 +41,12 @@ from sheeprl_tpu.algos.ppo.agent import build_agent
 from sheeprl_tpu.algos.ppo.ppo import make_train_fn
 from sheeprl_tpu.algos.ppo.utils import prepare_obs, test
 from sheeprl_tpu.config import instantiate
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.core.pipeline import AsyncEnvStepper, PackedObsCodec, pipeline_enabled
 from sheeprl_tpu.data.factory import make_rollout_buffer
-from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
+from sheeprl_tpu.parallel import handoff, overlap, split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -58,9 +59,6 @@ from sheeprl_tpu.utils.utils import polynomial_decay, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    if str(getattr(runtime, "strategy", "auto")).lower() == "fsdp":
-        raise ValueError("fabric.strategy=fsdp is not supported by the decoupled loops; "
-                         "use the coupled trainer")
     if "minedojo" in cfg.env.wrapper._target_.lower():
         raise ValueError(
             "MineDojo is not currently supported by PPO agent, since it does not take "
@@ -187,7 +185,9 @@ def main(runtime, cfg: Dict[str, Any]):
     opt_state = tx.init(params)
     if state:
         opt_state = jax.tree_util.tree_map(jnp.asarray, state["optimizer"])
-    opt_state = trainer_rt.replicate(opt_state)
+    # strategy-aware placement: replicated under DDP, parameter-sharded over the
+    # trainer mesh under fabric.strategy=fsdp (core/runtime.py:place_params)
+    opt_state = trainer_rt.place_params(opt_state)
 
     if runtime.is_global_zero:
         save_configs(cfg, log_dir)
@@ -228,24 +228,34 @@ def main(runtime, cfg: Dict[str, Any]):
         )
 
     # ---- trainer role: the whole optimization phase (GAE + epochs x minibatches)
-    # compiled once over the trainer mesh
-    train_fn = make_train_fn(agent, tx, cfg, trainer_rt, n_data, obs_keys, cnn_keys)
+    # compiled once over the trainer mesh. The rollout handoff below assembles
+    # the batch PRE-SHARDED on the mesh and never aliases a caller-visible
+    # buffer, so the train fn can donate it (donate_data=True) on top of the
+    # usual params/opt_state carry donation.
+    train_fn = make_train_fn(agent, tx, cfg, trainer_rt, n_data, obs_keys, cnn_keys, donate_data=True)
     trainer_state = {"params": params, "opt_state": opt_state}
 
     def trainer_step(payload):
-        # The whole payload moves onto the trainer mesh (replicated rollout —
-        # the global minibatch permutation spans it, like the reference's
-        # DistributedSampler over the scattered chunks); the per-minibatch
-        # sharding constraint inside train_fn splits work across trainers.
-        # Cross-host: one broadcast collective replaces the reference's pickled
-        # object scatter (ppo_decoupled.py:294-299).
+        # Per-shard handoff onto the trainer mesh (parallel/handoff.py): each
+        # trainer device receives ONE put of only its [T, B/n] env block — no
+        # full-rollout replication, no post-put reshard. The scalar riders
+        # (bootstrap values, key, coefs, stop flag) stay replicated; the
+        # per-minibatch sharding constraint inside train_fn keeps the global
+        # permutation semantics (like the reference's DistributedSampler over
+        # the scattered chunks). Cross-host: one broadcast collective replaces
+        # the reference's pickled object scatter (ppo_decoupled.py:294-299).
         if transport is None:
-            device_data, next_values, train_key, clip_coef, ent_coef, stop_flag = trainer_rt.replicate(payload)
+            host_data, rest = payload[0], payload[1:]
+            device_data = handoff.shard_put(host_data, trainer_rt.mesh, batch_axis=1)
+            next_values, train_key, clip_coef, ent_coef, stop_flag = trainer_rt.replicate(rest)
         else:
             device_data, next_values, train_key, clip_coef, ent_coef, stop_flag = (
                 transport.rollout_to_trainers(payload)
             )
         train_key = jnp.asarray(train_key).astype(jnp.uint32)
+        # chaos seam for the gradient-sync dispatch (the decoupled twin of the
+        # coupled loop's train.grad_sync site)
+        failpoints.failpoint("train.grad_sync", microbatches=overlap.microbatches(cfg))
         new_params, new_opt, _flat, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_state"], device_data, next_values, train_key,
             # the decoupled sentinel is warn-only (no backoff rung), so the

@@ -148,6 +148,12 @@ def make_update_core(actor, critic, cfg, runtime, action_scale, action_bias, tar
 def make_train_fn(
     actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every: int, params_sync=None
 ):
+    if int(cfg.algo.get("grad_microbatches", 1) or 1) > 1:
+        # SAC's per-gradient-step batch is already tiny (one replay sample per
+        # update in the G-step scan) — no bucketed accumulation to overlap
+        warnings.warn(
+            "algo.grad_microbatches > 1 is not supported by SAC; falling back to 1"
+        )
     init_opt, single_update = make_update_core(
         actor, critic, cfg, runtime, action_scale, action_bias, target_entropy, ema_every
     )

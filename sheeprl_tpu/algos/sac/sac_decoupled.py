@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core import health as health_mod
 from sheeprl_tpu.core import resilience
 from sheeprl_tpu.algos.sac.agent import action_scale_bias, build_agent
@@ -42,7 +43,7 @@ from sheeprl_tpu.algos.sac.sac import make_train_fn
 from sheeprl_tpu.algos.sac.utils import test
 from sheeprl_tpu.config import instantiate
 from sheeprl_tpu.data.buffers import ReplayBuffer
-from sheeprl_tpu.parallel import split_runtime, split_runtime_crosshost
+from sheeprl_tpu.parallel import handoff, overlap, split_runtime, split_runtime_crosshost
 from sheeprl_tpu.utils.env import finished_episodes, make_env
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, SumMetric
@@ -54,9 +55,6 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 @register_algorithm(decoupled=True)
 def main(runtime, cfg: Dict[str, Any]):
-    if str(getattr(runtime, "strategy", "auto")).lower() == "fsdp":
-        raise ValueError("fabric.strategy=fsdp is not supported by the decoupled loops; "
-                         "use the coupled trainer")
     if "minedojo" in cfg.env.wrapper._target_.lower():
         raise ValueError("MineDojo is not currently supported by SAC agent.")
     # Multi-process world -> the cross-host role split; single controller -> the
@@ -161,7 +159,9 @@ def main(runtime, cfg: Dict[str, Any]):
     opt_states = init_opt(params)
     if state:
         opt_states = jax.tree_util.tree_map(jnp.asarray, state["opt_states"])
-    opt_states = trainer_rt.replicate(opt_states)
+    # strategy-aware placement: replicated under DDP, parameter-sharded over the
+    # trainer mesh under fabric.strategy=fsdp (core/runtime.py:place_params)
+    opt_states = trainer_rt.place_params(opt_states)
     # trainer-mesh placement: in a multi-process world every train_fn input must
     # be a global array (a process-local scalar would fail device-assignment
     # checks alongside the cross-process params)
@@ -223,13 +223,23 @@ def main(runtime, cfg: Dict[str, Any]):
     trainer_state = {"params": params, "opt_states": opt_states, "update_counter": update_counter}
 
     def trainer_step(payload):
-        # Cross-host: one broadcast collective replaces the reference's pickled
-        # batch scatter (sac_decoupled.py:243-257).
+        # Per-shard handoff onto the trainer mesh (parallel/handoff.py): the
+        # [G, B, *] replay batches shard on the batch axis (B) — the G-step
+        # scan peels axis 0, so the per-update [B, *] slice lands exactly in
+        # the train fn's P("data") constraint with ZERO in-program reshard,
+        # and each trainer device receives one put of only its block instead
+        # of a full replicated copy. Cross-host: one broadcast collective
+        # replaces the reference's pickled batch scatter (sac_decoupled.py
+        # :243-257).
         if transport is None:
-            batches, train_key = trainer_rt.replicate(payload)
+            batches = handoff.shard_put(payload[0], trainer_rt.mesh, batch_axis=1)
+            train_key = trainer_rt.replicate(payload[1])
         else:
             batches, train_key = transport.rollout_to_trainers(payload)
         train_key = jnp.asarray(train_key).astype(jnp.uint32)
+        # chaos seam for the gradient-sync dispatch (decoupled twin of the
+        # coupled loop's train.grad_sync site)
+        failpoints.failpoint("train.grad_sync", microbatches=overlap.microbatches(cfg))
         new_params, new_opt, update_end, _flat_actor, metrics = train_fn(
             trainer_state["params"], trainer_state["opt_states"], batches, train_key,
             trainer_state["update_counter"],

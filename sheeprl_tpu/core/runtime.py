@@ -128,8 +128,16 @@ class Runtime:
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
     multihost_timeout_s: Optional[float] = None
+    # XLA scheduling profile (fabric.xla_profile; parallel/overlap.py): applied
+    # FIRST in __post_init__, before anything here can initialize the backend
+    # and freeze XLA_FLAGS.
+    xla_profile: Optional[str] = None
 
     def __post_init__(self):
+        if self.xla_profile:
+            from sheeprl_tpu.parallel import overlap
+
+            overlap.apply_xla_profile(self.xla_profile)
         if self.multihost and not _distributed_initialized():
             # The guard must NOT probe jax.process_count(): that initializes the local
             # backend, after which jax.distributed.initialize() can no longer run.
@@ -444,6 +452,7 @@ def build_runtime(cfg_fabric: Dict[str, Any], extra_callbacks: Optional[Sequence
         num_processes=cfg_fabric.get("num_processes"),
         process_id=cfg_fabric.get("process_id"),
         multihost_timeout_s=cfg_fabric.get("multihost_timeout_s"),
+        xla_profile=cfg_fabric.get("xla_profile"),
     )
 
 

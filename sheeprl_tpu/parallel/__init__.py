@@ -3,8 +3,14 @@
 - ``decoupled``: the actor-learner device split + host-side pipe (the TPU-native
   replacement of the reference's rank-0-player / DDP-trainers topology,
   sheeprl/algos/ppo/ppo_decoupled.py:623-670).
+- ``handoff``: donated per-shard rollout handoff — mesh-sharded batch assembly
+  via one ``device_put`` per device shard (no full-batch replication).
+- ``overlap``: microbatched gradient-sync overlap (per-bucket ``psum`` inside
+  the train step's accumulation scan) + the ``fabric.xla_profile`` XLA flag
+  sets for TPU latency-hiding / async-collective scheduling.
 """
 
+from sheeprl_tpu.parallel import handoff, overlap  # noqa: F401
 from sheeprl_tpu.parallel.decoupled import (  # noqa: F401
     CrossHostTransport,
     split_runtime,

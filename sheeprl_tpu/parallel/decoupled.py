@@ -28,7 +28,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from sheeprl_tpu.core import failpoints
 from sheeprl_tpu.core.runtime import Runtime
@@ -454,13 +454,20 @@ class CrossHostTransport:
 
         def put(a):
             if isinstance(a, jax.Array) and not getattr(a.sharding, "is_fully_replicated", True):
-                # addressable_data(0) of a sharded leaf would be ONE shard with the
-                # shard's shape — the player would silently run on truncated params
-                raise ValueError(
-                    "Cannot refresh the player from SHARDED trainer params; keep the "
-                    "trainer state replicated over the trainer mesh (DDP placement) "
-                    "or all-gather it before the refresh"
-                )
+                # FSDP trainer state: all-gather the leaf over the trainer mesh
+                # first — addressable_data(0) of a sharded leaf would be ONE
+                # shard with the shard's shape and the player would silently
+                # run on truncated params. The gather is a device collective
+                # (one replicated put on the same mesh), not a host round-trip.
+                try:
+                    a = jax.device_put(a, NamedSharding(self.trainer_mesh, P()))
+                except Exception as exc:  # pragma: no cover - cross-host gather unsupported
+                    raise ValueError(
+                        "Cannot refresh the player from SHARDED trainer params: the "
+                        "all-gather to a replicated layout failed. Keep the trainer "
+                        "state replicated over the trainer mesh (DDP placement) or "
+                        "gather it before the refresh"
+                    ) from exc
             return jax.device_put(a.addressable_data(0) if isinstance(a, jax.Array) else a, self.player_device)
 
         return jax.tree_util.tree_map(put, params)

@@ -1,0 +1,155 @@
+"""Donated per-shard rollout handoff: shard-at-put batch assembly.
+
+The pre-overlap handoff path was ``runtime.replicate(tree)`` — a full
+``device_put`` of every leaf to EVERY mesh device (``P()``), after which the
+train fn's ``with_sharding_constraint`` reshards on device. On an ``n``-device
+mesh that moves ``n x`` the batch bytes over PCIe/ICI and briefly materializes
+``n`` full copies in HBM. :func:`shard_put` assembles the mesh-sharded batch
+directly instead: for each leaf it picks the batch axis' ``NamedSharding``,
+asks the sharding for each device's index slice, issues exactly ONE
+``jax.device_put`` per device with only that device's shard, and stitches the
+global array with ``jax.make_array_from_single_device_arrays`` — no full-batch
+device materialization, no post-put reshard copy, and the result is safe to
+donate into the train fn (it aliases no caller-visible buffer). Works for host
+(numpy) leaves and for device-resident leaves (the per-shard slice is lazy and
+the put is a device-to-device copy of just the shard).
+
+Leaves whose target axis is not divisible by the mesh size (e.g. the 7-device
+trainer sub-mesh after ``split_runtime`` carves out the player) degrade per
+leaf: first any other divisible axis (largest first), then a replicated
+``P()`` put — never an error, so the decoupled loops can enable FSDP without
+knowing every payload shape up front.
+
+Byte accounting (``stats()``) feeds the transfer-guard tests and
+``bench.py --target fsdp``: ``put_bytes`` counts exactly what crossed to each
+device, so the replicated-vs-sharded comparison is arithmetic, not vibes. The
+``handoff.shard_put`` failpoint (core/failpoints.py) fires once per call —
+the chaos seam for "the rollout handoff put failed mid-iteration".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.core import failpoints
+
+_lock = threading.Lock()
+_stats: Dict[str, float] = {"calls": 0, "leaves": 0, "puts": 0, "put_bytes": 0, "replicated_leaves": 0}
+
+
+def reset_stats() -> None:
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def stats() -> Dict[str, float]:
+    with _lock:
+        return dict(_stats)
+
+
+def _leaf_spec(shape: tuple, n: int, batch_axis: int) -> P:
+    """Pick the partition spec for one leaf: ``batch_axis`` when divisible,
+    else any other divisible axis (largest extent wins — the cheapest
+    remaining split), else replicate."""
+    if n <= 1 or not shape:
+        return P()
+    axes: list = [None] * len(shape)
+    if 0 <= batch_axis < len(shape) and shape[batch_axis] % n == 0:
+        axes[batch_axis] = "data"
+        return P(*axes)
+    fallback = [(dim, i) for i, dim in enumerate(shape) if dim % n == 0 and dim > 0]
+    if fallback:
+        _, i = max(fallback)
+        axes[i] = "data"
+        return P(*axes)
+    return P()
+
+
+def shard_put(tree: Any, mesh: Mesh, *, batch_axis: int = 0) -> Any:
+    """Assemble ``tree``'s leaves as mesh-sharded jax Arrays, one explicit put
+    per device shard (see module docstring). The returned tree is freshly
+    allocated on the mesh and safe to donate."""
+    n = int(mesh.size)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    failpoints.failpoint("handoff.shard_put", leaves=len(leaves), devices=n)
+    out = []
+    calls_bytes = 0
+    puts = 0
+    replicated = 0
+    for x in leaves:
+        if not hasattr(x, "shape"):
+            x = np.asarray(x)
+        spec = _leaf_spec(tuple(x.shape), n, batch_axis)
+        sharding = NamedSharding(mesh, spec)
+        if isinstance(x, jax.Array) and getattr(x, "sharding", None) == sharding:
+            # already assembled on the target mesh layout (e.g. an in-graph
+            # collector emitting mesh-sharded rollouts): zero puts, zero bytes
+            out.append(x)
+            continue
+        if spec == P():
+            # indivisible leaf (or scalar): the one case that still replicates
+            out.append(jax.device_put(x, sharding))
+            nbytes = int(np.dtype(x.dtype).itemsize * np.prod(x.shape, dtype=np.int64)) if x.shape else int(np.dtype(x.dtype).itemsize)
+            calls_bytes += nbytes * n
+            puts += n
+            replicated += 1
+            continue
+        idx_map = sharding.addressable_devices_indices_map(tuple(x.shape))
+        shards = []
+        for device, index in idx_map.items():
+            piece = x[index]
+            shards.append(jax.device_put(piece, device))
+            calls_bytes += int(np.dtype(piece.dtype).itemsize * np.prod(piece.shape, dtype=np.int64))
+            puts += 1
+        out.append(
+            jax.make_array_from_single_device_arrays(tuple(x.shape), sharding, shards)
+        )
+    with _lock:
+        _stats["calls"] += 1
+        _stats["leaves"] += len(leaves)
+        _stats["puts"] += puts
+        _stats["put_bytes"] += calls_bytes
+        _stats["replicated_leaves"] += replicated
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def leaf_sharding(shape: tuple, mesh: Mesh, *, batch_axis: int = 0) -> NamedSharding:
+    """The exact ``NamedSharding`` :func:`shard_put` would pick for a leaf."""
+    return NamedSharding(mesh, _leaf_spec(tuple(shape), int(mesh.size), batch_axis))
+
+
+def shard_specs(tree: Any, mesh: Mesh, *, batch_axis: int = 0) -> Any:
+    """Mirror :func:`shard_put`'s per-leaf layout onto a tree of
+    ``ShapeDtypeStruct``s — AOT warmup specs must carry the sharded layout or
+    the background-compiled executable rejects the sharded batch at call time
+    and falls back to a foreground JIT trace."""
+
+    def _with_sharding(s):
+        return jax.ShapeDtypeStruct(
+            s.shape, s.dtype, sharding=leaf_sharding(s.shape, mesh, batch_axis=batch_axis)
+        )
+
+    return jax.tree_util.tree_map(_with_sharding, tree)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Host-side byte count of a payload tree (what ONE full copy costs — the
+    replicated path moves ``mesh.size x`` this)."""
+    total = 0
+    for x in jax.tree_util.tree_leaves(tree):
+        if not hasattr(x, "shape"):
+            x = np.asarray(x)
+        total += int(np.dtype(x.dtype).itemsize * np.prod(x.shape, dtype=np.int64)) if x.shape else int(np.dtype(x.dtype).itemsize)
+    return total
+
+
+def replicated_put_bytes(tree: Any, mesh: Mesh) -> int:
+    """Bytes the OLD ``runtime.replicate`` handoff would move for this payload
+    (one full copy per mesh device) — the bench's comparison arm."""
+    return tree_bytes(tree) * int(mesh.size)

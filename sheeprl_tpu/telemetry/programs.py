@@ -14,6 +14,12 @@ actually built for it. This module closes that gap: every AOT compile through
   generated-code / alias bytes, plus their sum as ``peak_bytes``);
 - **input/output sharding specs** and the donation map — the observables the
   mesh-aware sharding work (ROADMAP item 2) will be reviewed against;
+- the **collective audit** — the optimized HLO is scanned for collective ops
+  (``all-reduce``/``all-gather``/``reduce-scatter``/…), split into async
+  ``*-start``/``*-done`` pairs (overlappable with compute by the latency-hiding
+  scheduler) vs plain sync forms (exposed), with total and exposed bytes and a
+  nominal exposed-time estimate; the ``diff`` CLI flags a collective that
+  de-async'd (async pair -> sync op) or grew its bytes as a regression;
 - compile **wall-time**.
 
 Rows are schema-versioned JSON lines appended to a per-run ``programs.jsonl``
@@ -51,6 +57,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -81,6 +88,9 @@ _rows_recorded = 0
 _write_errors = 0
 _git_sha: Optional[str] = None
 _git_sha_resolved = False
+# ambient key/values stamped into every subsequent row (e.g. the active
+# fabric.xla_profile); process-wide like the ledger path itself
+_context: Dict[str, Any] = {}
 
 
 # --------------------------------------------------------------------------- #
@@ -129,13 +139,30 @@ def ledger_path() -> Optional[str]:
 
 def reset() -> None:
     """Drop the in-memory registry and counters and detach the ledger (tests)."""
-    global _latest, _rows_recorded, _write_errors, _path
+    global _latest, _rows_recorded, _write_errors, _path, _context
     with _lock:
         _latest = {}
         _rows_recorded = 0
         _write_errors = 0
         _path = None
+        _context = {}
     os.environ.pop(ENV_VAR, None)
+
+
+def set_context(**kv: Any) -> Dict[str, Any]:
+    """Merge ambient key/values into every row recorded from now on (``None``
+    deletes a key). The overlap layer stamps ``xla_profile`` here so a ledger
+    row says which XLA scheduling profile the program compiled under."""
+    global _context
+    with _lock:
+        merged = dict(_context)
+        for k, v in kv.items():
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        _context = merged
+        return dict(merged)
 
 
 # --------------------------------------------------------------------------- #
@@ -182,6 +209,8 @@ def _build_row(
     cost = _cost_dict(compiled)
     memory = _memory_dict(compiled)
     in_sh, out_sh = _sharding_lists(compiled)
+    with _lock:
+        ctx = dict(_context)
     row: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
         "time": time.time(),
@@ -191,6 +220,7 @@ def _build_row(
         "flops": cost.get("flops"),
         "bytes_accessed": cost.get("bytes accessed"),
         "memory": memory,
+        "collective": _collective_dict(compiled),
         "input_shardings": in_sh,
         "output_shardings": out_sh,
         "donation": _donation(jit_kwargs),
@@ -198,6 +228,7 @@ def _build_row(
         "num_devices": _device_count(),
         "trace_id": trace.current_trace_id() or None,
         "git_sha": _git_head(),
+        "context": ctx or None,
     }
     return row
 
@@ -236,6 +267,101 @@ def _cost_dict(compiled: Any) -> Dict[str, float]:
     # true 0, distinct from "cost analysis unavailable" (null)
     out.setdefault("flops", 0.0)
     return out
+
+
+# Longest-first so `all-reduce-start` wins over `all-reduce`; anchored on the
+# HLO statement position (opcode immediately followed by its operand paren, not
+# preceded by a `%`/word char, which would make it an operand *reference* like
+# `%all-reduce.5` or part of a fusion name).
+_COLLECTIVE_OPS = (
+    "all-reduce-start",
+    "all-reduce-done",
+    "all-gather-start",
+    "all-gather-done",
+    "collective-permute-start",
+    "collective-permute-done",
+    "reduce-scatter",
+    "all-to-all",
+    "collective-permute",
+    "all-reduce",
+    "all-gather",
+)
+_COLLECTIVE_RE = re.compile(
+    r"(?<![\w%.-])(" + "|".join(re.escape(op) for op in _COLLECTIVE_OPS) + r")\("
+)
+_SHAPE_RE = re.compile(
+    r"\b(pred|bf16|f16|f32|f64|s8|s16|s32|s64|u8|u16|u32|u64|c64|c128)\[([0-9,]*)\]"
+)
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4,
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "c128": 16,
+}
+#: Nominal per-link ICI bandwidth used for the *exposed-collective-time
+#: estimate* (v5e-class, ~45 GB/s/direction). A planning number, not a
+#: measurement: it turns exposed (sync, unoverlapped) collective bytes into a
+#: comparable seconds figure across rows.
+_ICI_BYTES_PER_S = 4.5e10
+
+
+def _shape_bytes(segment: str) -> float:
+    total = 0.0
+    for dtype, dims in _SHAPE_RE.findall(segment):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += _DTYPE_BYTES[dtype] * n
+    return total
+
+
+def _collective_dict(compiled: Any) -> Optional[Dict[str, Any]]:
+    """The HLO collective audit: scan the compiled program's optimized HLO for
+    collective ops, splitting them into async pairs (``*-start``/``*-done`` —
+    the latency-hiding scheduler can overlap these with compute) and plain sync
+    forms (exposed: the step stalls for the wire). Bytes are the result-shape
+    sizes of the issuing op (``-done`` ops reference the same buffer and are
+    not double-counted). Returns ``None`` when the backend can't render HLO
+    text — never raises."""
+    if compiled is None:
+        return None
+    try:
+        text = compiled.as_text()
+    except Exception:
+        return None
+    if not isinstance(text, str):
+        return None
+    by_op: Dict[str, int] = {}
+    total_bytes = 0.0
+    async_pairs = 0
+    sync_ops = 0
+    exposed_bytes = 0.0
+    for line in text.splitlines():
+        m = _COLLECTIVE_RE.search(line)
+        if m is None:
+            continue
+        op = m.group(1)
+        by_op[op] = by_op.get(op, 0) + 1
+        if op.endswith("-done"):
+            continue  # the buffer was counted at its matching -start
+        nbytes = _shape_bytes(line[: m.start()])
+        total_bytes += nbytes
+        if op.endswith("-start"):
+            async_pairs += 1
+        else:
+            sync_ops += 1
+            exposed_bytes += nbytes
+    return {
+        "op_count": sum(by_op.values()),
+        "bytes": total_bytes,
+        "async_pairs": async_pairs,
+        "sync_ops": sync_ops,
+        "exposed_bytes": exposed_bytes,
+        "exposed_time_s": exposed_bytes / _ICI_BYTES_PER_S,
+        "by_op": by_op,
+    }
 
 
 def _memory_dict(compiled: Any) -> Optional[Dict[str, float]]:
@@ -403,6 +529,13 @@ def gauges() -> Dict[str, float]:
             out[f"Program/{name}/compile_seconds"] = float(row["compile_seconds"])
         if row.get("flops") is not None:
             out[f"Program/{name}/flops"] = float(row["flops"])
+        coll = row.get("collective")
+        if coll and coll.get("bytes") is not None:
+            out[f"Program/{name}/collective_bytes"] = float(coll["bytes"])
+            out[f"Program/{name}/collective_ops"] = float(coll.get("op_count", 0))
+            out[f"Program/{name}/exposed_collective_bytes"] = float(
+                coll.get("exposed_bytes", 0.0)
+            )
     return out
 
 
@@ -477,6 +610,7 @@ def diff_ledgers(
         "hash_churn": [],
         "memory_deltas": [],
         "flops_deltas": [],
+        "collective_deltas": [],
         "sharding_changes": [],
         "regressions": [],
     }
@@ -515,6 +649,38 @@ def diff_ledgers(
                         f"{name}: flops {va:.3e} -> {vb:.3e}"
                         + (f" (+{pct * 100.0:.1f}%)" if pct is not None else "")
                     )
+        ca, cb = ra.get("collective") or {}, rb.get("collective") or {}
+        if ca and cb:
+            entry: Optional[Dict[str, Any]] = None
+            pa, pb = int(ca.get("async_pairs") or 0), int(cb.get("async_pairs") or 0)
+            sa, sb = int(ca.get("sync_ops") or 0), int(cb.get("sync_ops") or 0)
+            ba, bb = float(ca.get("bytes") or 0.0), float(cb.get("bytes") or 0.0)
+            deasync = pb < pa and sb > sa
+            bytes_grew = bb > ba * (1.0 + mem_threshold) if ba else bb > 0.0
+            if deasync or bytes_grew or ba != bb or pa != pb or sa != sb:
+                entry = {
+                    "name": name,
+                    "async_pairs": {"a": pa, "b": pb},
+                    "sync_ops": {"a": sa, "b": sb},
+                    "bytes": {"a": ba, "b": bb},
+                    "deasync": bool(deasync),
+                    "regression": bool(deasync or bytes_grew),
+                }
+                report["collective_deltas"].append(entry)
+            if deasync:
+                # the overlap regression the auditor exists for: a collective
+                # that compiled as an async start/done pair (overlappable with
+                # compute) now compiles as a plain sync op (exposed on the wire)
+                report["regressions"].append(
+                    f"{name}: collective de-async'd ({pa} -> {pb} async pair(s), "
+                    f"{sa} -> {sb} sync op(s))"
+                )
+            if bytes_grew:
+                pct = ((bb - ba) / ba * 100.0) if ba else None
+                report["regressions"].append(
+                    f"{name}: collective bytes {_fmt_bytes(ba)} -> {_fmt_bytes(bb)}"
+                    + (f" (+{pct:.1f}%)" if pct is not None else "")
+                )
         for io in ("input_shardings", "output_shardings"):
             sa, sb = ra.get(io), rb.get(io)
             if sa is not None and sb is not None and sa != sb:
@@ -553,6 +719,16 @@ def format_diff(report: Dict[str, Any]) -> str:
         pct = f" ({entry['pct'] * 100.0:+.1f}%)" if entry.get("pct") is not None else ""
         flag = "  << REGRESSION" if entry.get("regression") else ""
         lines.append(f"flops {entry['name']}: {entry['a']:.4g} -> {entry['b']:.4g}{pct}{flag}")
+    for entry in report.get("collective_deltas", []):
+        flag = "  << REGRESSION" if entry.get("regression") else ""
+        note = " (de-async'd)" if entry.get("deasync") else ""
+        lines.append(
+            f"collective {entry['name']}: "
+            f"async {entry['async_pairs']['a']} -> {entry['async_pairs']['b']}, "
+            f"sync {entry['sync_ops']['a']} -> {entry['sync_ops']['b']}, "
+            f"bytes {_fmt_bytes(entry['bytes']['a'])} -> {_fmt_bytes(entry['bytes']['b'])}"
+            f"{note}{flag}"
+        )
     for entry in report["sharding_changes"]:
         lines.append(
             f"sharding {entry['name']}.{entry['io']}: {entry['a']} -> {entry['b']}  << CHANGED"

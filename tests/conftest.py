@@ -87,6 +87,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "mesh: overlap-scheduled mesh training (parallel/handoff.py + parallel/overlap.py "
+        "+ the HLO collective auditor) — one-put-per-shard transfer-guard pins, "
+        "microbatched gradient bit-parity on the 8-device virtual mesh, collective "
+        "capture/diff gating, and the handoff/grad-sync chaos drills; select with "
+        "`-m mesh` before touching the handoff, the accumulation scan, or the auditor",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long-running end-to-end smokes excluded from the tier-1 `-m 'not slow'` "
         "sweep; run explicitly (e.g. `-m slow`) before shipping changes they cover",
     )

@@ -582,6 +582,40 @@ def test_ppo_decoupled(standard_args, env_id, tmp_path, monkeypatch):
     assert len(ckpts) >= 1
 
 
+@pytest.mark.mesh
+def test_ppo_decoupled_fsdp(standard_args, tmp_path, monkeypatch):
+    """Decoupled PPO under ``fabric.strategy=fsdp``: the player stays on its
+    own device while the trainer sub-mesh shards params/opt-state, the
+    rollout handoff arrives one put per trainer shard (its failpoint seam is
+    armed in benign fire mode and must trip), and the params flow back
+    through the all-gathering player sync."""
+    from sheeprl_tpu.core import failpoints
+
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "fabric.devices=3",
+        "fabric.strategy=fsdp",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=2",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.dense_units=8",
+        "algo.mlp_layers=1",
+        "algo.encoder.cnn_features_dim=16",
+        "algo.encoder.mlp_features_dim=8",
+        "buffer.memmap=False",
+        "env.num_envs=2",
+    ]
+    with failpoints.active("handoff.shard_put:fire"):
+        _run(args)
+        fires = failpoints.counts()["handoff.shard_put"]["fires"]
+    assert fires >= 1, "the trainer never passed through the per-shard handoff seam"
+
+
 def test_ppo_decoupled_rejects_single_device(standard_args, tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     args = standard_args + [
@@ -603,6 +637,28 @@ def test_sac_decoupled(standard_args, tmp_path, monkeypatch):
         "env=dummy",
         "env.id=continuous_dummy",
         "fabric.devices=2",
+        "algo.per_rank_batch_size=2",
+        "algo.learning_starts=0",
+        "algo.hidden_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.memmap=False",
+        "buffer.size=64",
+        "env.num_envs=2",
+    ]
+    _run(args)
+
+
+@pytest.mark.mesh
+def test_sac_decoupled_fsdp(standard_args, tmp_path, monkeypatch):
+    """Decoupled SAC under ``fabric.strategy=fsdp``: replay batches reach the
+    sharded trainer sub-mesh through the per-shard handoff."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args + [
+        "exp=sac_decoupled",
+        "env=dummy",
+        "env.id=continuous_dummy",
+        "fabric.devices=3",
+        "fabric.strategy=fsdp",
         "algo.per_rank_batch_size=2",
         "algo.learning_starts=0",
         "algo.hidden_size=8",

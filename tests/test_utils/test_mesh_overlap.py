@@ -1,0 +1,287 @@
+"""Overlap-scheduled mesh training pins (``-m mesh``).
+
+Three contracts from ROADMAP item 2's handoff/overlap work:
+
+1. **One put per device shard.** ``handoff.shard_put`` assembles a mesh-sharded
+   batch with exactly one explicit ``device_put`` per device shard — byte
+   accounting matches arithmetic, the whole assembly survives
+   ``jax.transfer_guard("disallow")`` (no hidden implicit transfer anywhere),
+   indivisible axes degrade per leaf, and re-putting an already-assembled tree
+   is free.
+
+2. **Microbatched gradients are bit-exact.** ``overlap.accumulate_grads``
+   reproduces the single-batch ``value_and_grad`` (+ ``pmean`` under
+   ``shard_map``) result bit-for-bit on integer-valued data with power-of-two
+   chunking — the accumulation scan and per-bucket ``psum`` reorder collectives
+   for the latency-hiding scheduler without changing a single bit of math.
+
+3. **The HLO collective auditor sees mesh programs and gates on them.**
+   AOT-compiling a ``psum`` program records op counts/bytes in the program
+   ledger row and the ``Program/*/collective_bytes`` gauges; the
+   ``programs diff`` CLI exits 1 on a de-async'd collective or grown
+   collective bytes (the overlap regression it exists to catch).
+
+Plus the chaos seams: the ``handoff.shard_put`` / ``train.grad_sync``
+failpoints are registered and drillable (raise + benign fire).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.core.runtime import Runtime
+from sheeprl_tpu.parallel import handoff, overlap
+
+pytestmark = pytest.mark.mesh
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return Runtime(accelerator="cpu", devices=8, strategy="auto", precision="32-true").mesh
+
+
+# --------------------------------------------------------------------------- #
+# 1. the handoff: one put per shard, exact byte accounting
+# --------------------------------------------------------------------------- #
+
+
+def test_shard_put_one_put_per_shard(mesh):
+    payload = {
+        "obs": np.ones((16, 64, 8), np.float32),  # 32768 B, sharded on axis 0
+        "rew": np.ones((16, 64), np.float32),  # 4096 B, sharded on axis 0
+        "coef": np.float32(0.5),  # scalar: the one leaf that still replicates
+    }
+    handoff.reset_stats()
+    with jax.transfer_guard("disallow"):  # every put must be explicit
+        placed = handoff.shard_put(payload, mesh, batch_axis=0)
+
+    s = handoff.stats()
+    assert s["calls"] == 1 and s["leaves"] == 3
+    # 8 single-shard puts per sharded leaf + 8 replicated puts for the scalar
+    assert s["puts"] == 24
+    assert s["replicated_leaves"] == 1
+    # sharded leaves cross the wire exactly once; the scalar crosses 8x
+    assert s["put_bytes"] == 32768 + 4096 + 4 * 8
+    # strictly fewer bytes than the old replicate-everything handoff
+    assert s["put_bytes"] < handoff.replicated_put_bytes(payload, mesh)
+
+    assert tuple(placed["obs"].sharding.spec)[0] == "data"
+    shards = placed["obs"].addressable_shards
+    assert len(shards) == 8 and shards[0].data.shape == (2, 64, 8)
+    np.testing.assert_array_equal(np.asarray(placed["obs"]), payload["obs"])
+
+
+def test_shard_put_indivisible_axis_fallback(mesh):
+    handoff.reset_stats()
+    placed = handoff.shard_put(
+        {
+            "other_axis": np.zeros((7, 16), np.float32),  # 7 % 8 != 0 -> axis 1
+            "no_axis": np.zeros((7, 3), np.float32),  # nothing divides -> replicate
+        },
+        mesh,
+        batch_axis=0,
+    )
+    assert tuple(placed["other_axis"].sharding.spec) == (None, "data")
+    assert all(a is None for a in placed["no_axis"].sharding.spec)
+    assert handoff.stats()["replicated_leaves"] == 1
+
+
+def test_shard_put_passthrough_is_free(mesh):
+    placed = handoff.shard_put({"x": np.zeros((16, 4), np.float32)}, mesh)
+    handoff.reset_stats()
+    again = handoff.shard_put(placed, mesh)
+    s = handoff.stats()
+    assert s["puts"] == 0 and s["put_bytes"] == 0
+    assert again["x"] is placed["x"]
+
+
+def test_shard_specs_mirror_shard_put_layout(mesh):
+    tree = {"a": np.zeros((16, 64), np.float32), "b": np.zeros((7, 3), np.int32)}
+    specs = handoff.shard_specs(tree, mesh, batch_axis=0)
+    placed = handoff.shard_put(tree, mesh, batch_axis=0)
+
+    def _check(spec, arr):
+        assert spec.shape == arr.shape and spec.dtype == arr.dtype
+        assert spec.sharding == arr.sharding  # or AOT warmup rejects the batch
+
+    jax.tree_util.tree_map(_check, specs, placed)
+
+
+# --------------------------------------------------------------------------- #
+# 2. microbatched gradient bit-parity
+# --------------------------------------------------------------------------- #
+
+
+def _integer_problem(batch_size: int, seed: int = 0):
+    """Integer-valued f32 data + power-of-two chunking => every sum/division in
+    both the reference and the microbatched path is exact, so the parity
+    assertion can be bitwise instead of allclose."""
+    rng = np.random.default_rng(seed)
+    params = {"w": rng.integers(-2, 3, size=(8,)).astype(np.float32)}
+    batch = {
+        "x": rng.integers(-3, 4, size=(batch_size, 8)).astype(np.float32),
+        "y": rng.integers(-8, 9, size=(batch_size,)).astype(np.float32),
+    }
+
+    def loss_fn(p, b):
+        pred = b["x"] @ p["w"]
+        return jnp.mean((pred - b["y"]) ** 2), jnp.mean(pred)
+
+    return params, batch, jax.value_and_grad(loss_fn, has_aux=True)
+
+
+@pytest.mark.parametrize("m", [2, 4, 8])
+def test_accumulate_grads_bitwise_parity_single_device(m):
+    params, batch, grad_fn = _integer_problem(32)
+    (ref_loss, ref_aux), ref_grads = jax.jit(grad_fn)(params, batch)
+
+    def micro(p, b):
+        return overlap.accumulate_grads(grad_fn, p, b, microbatches=m)
+
+    (loss, aux), grads = jax.jit(micro)(params, batch)
+    np.testing.assert_array_equal(np.asarray(loss), np.asarray(ref_loss))
+    np.testing.assert_array_equal(np.asarray(aux), np.asarray(ref_aux))
+    np.testing.assert_array_equal(np.asarray(grads["w"]), np.asarray(ref_grads["w"]))
+
+
+@pytest.mark.parametrize("m", [2, 4])
+def test_accumulate_grads_bitwise_parity_on_mesh(mesh, m):
+    from sheeprl_tpu.data.device_buffer import _shard_map
+
+    params, batch, grad_fn = _integer_problem(64, seed=1)
+
+    def ref_step(p, b):
+        (loss, _aux), grads = grad_fn(p, b)
+        return jax.lax.pmean(loss, "data"), jax.lax.pmean(grads, "data")
+
+    def micro_step(p, b):
+        # per-bucket psum inside the scan; grads come back already axis-averaged
+        (loss, _aux), grads = overlap.accumulate_grads(
+            grad_fn, p, b, microbatches=m, axis_name="data", axis_size=8
+        )
+        return jax.lax.pmean(loss, "data"), grads
+
+    in_specs, out_specs = (P(), P("data")), (P(), P())
+    ref = jax.jit(_shard_map(ref_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+    mic = jax.jit(_shard_map(micro_step, mesh=mesh, in_specs=in_specs, out_specs=out_specs))
+
+    b = handoff.shard_put(batch, mesh, batch_axis=0)
+    ref_loss, ref_grads = ref(params, b)
+    loss, grads = mic(params, b)
+    np.testing.assert_array_equal(np.asarray(loss), np.asarray(ref_loss))
+    np.testing.assert_array_equal(np.asarray(grads["w"]), np.asarray(ref_grads["w"]))
+
+
+def test_accumulate_grads_rejects_indivisible_chunking():
+    params, batch, grad_fn = _integer_problem(32)
+    with pytest.raises(ValueError, match="grad_microbatches"):
+        jax.eval_shape(
+            lambda p, b: overlap.accumulate_grads(grad_fn, p, b, microbatches=5), params, batch
+        )
+
+
+# --------------------------------------------------------------------------- #
+# 3. the HLO collective auditor + diff gate
+# --------------------------------------------------------------------------- #
+
+
+def test_mesh_program_collective_capture(mesh):
+    from sheeprl_tpu.core import compile as jax_compile
+    from sheeprl_tpu.data.device_buffer import _shard_map
+    from sheeprl_tpu.telemetry import programs as tel_programs
+
+    fn = _shard_map(
+        lambda x: jax.lax.pmean(x, "data"), mesh=mesh, in_specs=(P("data"),), out_specs=P()
+    )
+    gfn = jax_compile.guarded_jit(fn, name="test.mesh_collective")
+    x = handoff.shard_put(np.arange(256, dtype=np.float32).reshape(64, 4), mesh)
+    gfn.aot_compile(jax_compile.specs_of(x))
+
+    row = next(r for r in tel_programs.snapshot() if r["name"] == "test.mesh_collective")
+    coll = row.get("collective")
+    assert coll, "mesh program row is missing the HLO collective audit"
+    assert coll["op_count"] >= 1 and coll["bytes"] > 0
+    assert coll["async_pairs"] + coll["sync_ops"] == coll["op_count"]
+
+    gauges = tel_programs.gauges()
+    assert gauges["Program/test.mesh_collective/collective_bytes"] == float(coll["bytes"])
+    assert gauges["Program/test.mesh_collective/collective_ops"] == float(coll["op_count"])
+
+
+def _collective_row(name, async_pairs, sync_ops, nbytes):
+    return {
+        "name": name,
+        "fingerprint": "fp0",
+        "collective": {
+            "op_count": async_pairs + sync_ops,
+            "async_pairs": async_pairs,
+            "sync_ops": sync_ops,
+            "bytes": float(nbytes),
+            "exposed_bytes": 0.0,
+        },
+    }
+
+
+def test_programs_diff_cli_gates_overlap_regressions(tmp_path):
+    """Doctored candidate ledger: the same program's all-reduce compiled as a
+    plain sync op (de-async'd) and moved +20% bytes — both must be flagged and
+    the CLI must exit 1 (the CI gate); a self-diff stays rc 0."""
+    ledger_a = tmp_path / "a.jsonl"
+    ledger_b = tmp_path / "b.jsonl"
+    ledger_a.write_text(json.dumps(_collective_row("ppo.train", 2, 0, 1_000_000)) + "\n")
+    ledger_b.write_text(json.dumps(_collective_row("ppo.train", 0, 2, 1_200_000)) + "\n")
+
+    def _diff(a, b):
+        return subprocess.run(
+            [sys.executable, "-m", "sheeprl_tpu.telemetry.programs", "diff", "--json", a, b],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+
+    out = _diff(str(ledger_a), str(ledger_b))
+    assert out.returncode == 1, out.stdout + out.stderr
+    report = json.loads(out.stdout)
+    assert any("de-async'd" in r for r in report["regressions"])
+    assert any("collective bytes" in r for r in report["regressions"])
+    (delta,) = report["collective_deltas"]
+    assert delta["deasync"] and delta["regression"]
+
+    clean = _diff(str(ledger_a), str(ledger_a))
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    assert json.loads(clean.stdout)["regressions"] == []
+
+
+# --------------------------------------------------------------------------- #
+# chaos seams
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.faults
+def test_handoff_and_grad_sync_failpoints_drill(mesh):
+    for name in ("handoff.shard_put", "train.grad_sync"):
+        assert failpoints.known()[name]["plane"] == "train"
+
+    payload = {"x": np.zeros((16, 4), np.float32)}
+    with failpoints.active("handoff.shard_put:raise"):
+        with pytest.raises(failpoints.FailpointError):
+            handoff.shard_put(payload, mesh)
+
+    with failpoints.active("handoff.shard_put:fire,train.grad_sync:fire"):
+        handoff.shard_put(payload, mesh)
+        failpoints.failpoint("train.grad_sync", iter=0, microbatches=2)
+        counts = failpoints.counts()
+        assert counts["handoff.shard_put"]["fires"] == 1
+        assert counts["train.grad_sync"]["fires"] == 1
