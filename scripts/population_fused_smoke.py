@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Fused-population smoke: the chaos drill for ``population.backend=fused``.
+
+Drives the device-resident vmapped PBT stack (envs/ingraph/population.py +
+orchestrate/fused.py) through the REAL controller entry point and proves the
+fleet contract end-to-end, in two phases:
+
+1. **healthy run + member_sync drill** — a 4-member CartPole population with
+   domain-randomized physics trains for 3 exploit epochs under one compiled
+   program while the ``population.member_sync`` fire-failpoint poisons member
+   m01's params (NaN) at its first checkpoint slice. The run must finish
+   ``done`` with ZERO retraces, the sentinel must flag the poisoned member,
+   the next in-graph exploit must resow it from a healthy peer (a ``resow``
+   row in ``lineage.jsonl`` with a parent and perturb factors != 1 — the
+   perturbed member's hypers diverge from the seed config), and every member
+   must end with finite fitness and a certified checkpoint slice;
+2. **exploit seam drill** — ``population.exploit:raise`` fires at the first
+   epoch boundary; the trainee crashes, and the controller must classify the
+   crash (not preemption, not completion) and report ``failed`` once
+   ``population.max_failures=0`` is exhausted — the seam is live through the
+   whole supervision stack.
+
+Run directly (``python scripts/population_fused_smoke.py``) or through the
+registered tier-1 test (tests/test_utils/test_population_fused_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.core import failpoints  # noqa: E402
+
+_BASE_OVERRIDES = [
+    "exp=ppo",
+    "env=jax_cartpole",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=16",
+    "algo.update_epochs=1",
+    "seed=7",
+]
+
+_SPEC = {
+    "orchestrate": {
+        "population": {
+            "backend": "fused",
+            "members": 4,
+            "envs_per_member": 8,
+            "epochs": 3,
+            "iters_per_epoch": 2,
+            "checkpoint_every": 1,
+            "domain_rand": True,
+            "overrides": _BASE_OVERRIDES,
+        }
+    }
+}
+
+
+def _run_controller(spec_path: str, state_dir: str, fp_spec: str | None, timeout: float):
+    env = dict(os.environ, JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"))
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)
+    if fp_spec:
+        env["SHEEPRL_TPU_FAILPOINTS"] = fp_spec
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu.orchestrate.controller",
+            "--spec",
+            spec_path,
+            "--state-dir",
+            state_dir,
+        ],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, _ = proc.communicate()
+        raise SystemExit(f"controller did not finish within the timeout; tail:\n{out[-3000:]}")
+    result_line = next(
+        (l for l in reversed(out.splitlines()) if l.startswith("ORCHESTRATE_RESULT ")), None
+    )
+    if result_line is None:
+        raise SystemExit(f"no ORCHESTRATE_RESULT line (rc={proc.returncode}); tail:\n{out[-3000:]}")
+    return proc.returncode, json.loads(result_line.split("ORCHESTRATE_RESULT ", 1)[1]), out
+
+
+def main(workdir: str | None = None, timeout: float = 600.0) -> dict:
+    workdir = workdir or tempfile.mkdtemp(prefix="population_fused_smoke_")
+    os.makedirs(workdir, exist_ok=True)
+    spec_path = os.path.join(workdir, "population_fused.json")
+    with open(spec_path, "w") as f:
+        json.dump(_SPEC, f, indent=2)
+    deadline = time.time() + timeout
+
+    # ----- phase 1: healthy run with the member_sync poison drill.
+    # hit=2 lands on the SECOND member_sync evaluation = member m01 at its
+    # first checkpoint slice (epoch 0), AFTER epoch 0's exploit — so epoch 1
+    # trains m01 on NaN params and epoch 1's exploit must heal it.
+    state1 = os.path.join(workdir, "fused_healthy")
+    rc, summary, out = _run_controller(
+        spec_path,
+        state1,
+        failpoints.spec_entry("population.member_sync", "fire", trigger="hit=2"),
+        max(deadline - time.time(), 60.0),
+    )
+    if rc != 0 or summary["status"] != "done":
+        raise SystemExit(f"phase 1 rc={rc} summary={summary}; tail:\n{out[-3000:]}")
+    trainee = summary["trainee"]
+    if trainee["retraces"] != 0:
+        raise SystemExit(f"fused population retraced: {trainee}")
+    if trainee["exploits"] < 3 or trainee["swaps"] < 1:
+        raise SystemExit(f"exploit never fired / never swapped: {trainee}")
+    if trainee["sentinel_events"] < 1:
+        raise SystemExit(f"sentinel missed the poisoned member: {trainee}")
+    if "member_sync drill poisoned m01" not in out:
+        raise SystemExit(f"member_sync drill did not fire; tail:\n{out[-3000:]}")
+    if not all(x == x and abs(x) < 1e9 for x in trainee["fitness"]):
+        raise SystemExit(f"population ended with nonfinite fitness: {trainee['fitness']}")
+
+    with open(os.path.join(state1, "lineage.jsonl")) as f:
+        edges = [json.loads(line) for line in f if line.strip()]
+    seeds = [e for e in edges if e["kind"] == "seed"]
+    resows = [e for e in edges if e["kind"] == "resow"]
+    if len(seeds) != 4:
+        raise SystemExit(f"expected 4 seed rows, got {len(seeds)}")
+    if not resows:
+        raise SystemExit(f"no resow row in lineage; kinds={[e['kind'] for e in edges]}")
+    healed = [e for e in resows if e["trial"] == "m01" and e.get("parent")]
+    if not healed:
+        raise SystemExit(f"poisoned m01 was never resown from a peer: {resows}")
+    # explore half: the perturbed member's hypers diverged from the seed config
+    seed_hp = seeds[0]["hyperparams"]
+    diverged = [
+        e for e in resows
+        if any(abs(v - seed_hp[k]) > 1e-9 for k, v in e["hyperparams"].items())
+    ]
+    if not diverged:
+        raise SystemExit(f"no resown member's hyperparameters diverged: {resows}")
+
+    # every member ends with a certified checkpoint slice
+    for i in range(4):
+        mdir = os.path.join(state1, "members", f"m{i:02d}")
+        certs = [p for p in os.listdir(mdir) if p.endswith(".certified.json")]
+        if not certs:
+            raise SystemExit(f"member m{i:02d} has no certified checkpoint slice")
+
+    # ----- phase 2: the exploit seam crashes the trainee; the controller
+    # must classify it as a crash and give up at max_failures=0.
+    spec2 = json.loads(json.dumps(_SPEC))
+    spec2["orchestrate"]["population"]["max_failures"] = 0
+    spec2_path = os.path.join(workdir, "population_fused_crash.json")
+    with open(spec2_path, "w") as f:
+        json.dump(spec2, f, indent=2)
+    state2 = os.path.join(workdir, "fused_exploit_crash")
+    rc2, summary2, out2 = _run_controller(
+        spec2_path,
+        state2,
+        failpoints.spec_entry("population.exploit", "raise", "chaos-exploit", "hit=1"),
+        max(deadline - time.time(), 60.0),
+    )
+    if rc2 == 0 or summary2["status"] != "failed":
+        raise SystemExit(f"phase 2 should fail at max_failures=0: rc={rc2} {summary2}")
+    if summary2["failures"] != 1 or summary2["incarnations"] != 1:
+        raise SystemExit(f"unexpected crash accounting: {summary2}")
+
+    return {
+        "workdir": workdir,
+        "healthy": trainee,
+        "resow_edges": len(resows),
+        "healed_member": healed[0]["trial"],
+        "exploit_crash_status": summary2["status"],
+        "lineage": os.path.join(state1, "lineage.jsonl"),
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--workdir", default=None, help="drill directory (default: fresh tempdir)")
+    parser.add_argument("--timeout", type=float, default=600.0, help="whole-drill timeout in seconds")
+    cli = parser.parse_args()
+    result = main(cli.workdir, cli.timeout)
+    h = result["healthy"]
+    print(
+        "population fused smoke OK: "
+        f"{h['members']} members x {h['envs_per_member']} envs, "
+        f"{h['epochs_done']} epochs, {h['exploits']} exploits ({h['swaps']} swaps), "
+        f"0 retraces, poisoned {result['healed_member']} healed in-graph, "
+        f"exploit-seam crash classified '{result['exploit_crash_status']}', "
+        f"lineage at {result['lineage']}"
+    )

@@ -46,7 +46,7 @@ from sheeprl_tpu.utils.utils import PlayerParamsSync, gae, normalize_tensor, sav
 
 
 def make_update_impl(
-    agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None, *, axis_name=None, shards=1
+    agent, tx, cfg, runtime, n_data: int, obs_keys, params_sync=None, *, axis_name=None, shards=1, constrain_data=True, batch_size=None
 ):
     """Build the raw (unjitted) per-iteration optimization function.
 
@@ -59,12 +59,21 @@ def make_update_impl(
     apply-or-skip branch) all-reduce via ``jax.lax.pmean`` before the single
     optimizer step.
     """
-    global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    # batch_size overrides the data-parallel global batch for the population
+    # trainer's member-sharded mesh (see the PPO twin)
+    global_bs = (
+        int(batch_size) if batch_size is not None
+        else int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    )
     shards = int(shards)
     local_n = n_data // shards
     local_bs = max(global_bs // shards, 1)
     n_minibatches = max(local_n // local_bs, 1)
-    data_sharding = NamedSharding(runtime.mesh, P("data")) if axis_name is None else None
+    # constrain_data=False: see the PPO twin — the population trainer vmaps
+    # this body over a member axis where the env-batch constraint is invalid.
+    data_sharding = (
+        NamedSharding(runtime.mesh, P("data")) if (axis_name is None and constrain_data) else None
+    )
     nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch):

@@ -59,7 +59,19 @@ from sheeprl_tpu.utils.utils import (
 
 
 def make_update_impl(
-    agent, tx, cfg, runtime, n_data: int, obs_keys, cnn_keys, params_sync=None, *, axis_name=None, shards=1
+    agent,
+    tx,
+    cfg,
+    runtime,
+    n_data: int,
+    obs_keys,
+    cnn_keys,
+    params_sync=None,
+    *,
+    axis_name=None,
+    shards=1,
+    constrain_data=True,
+    batch_size=None,
 ):
     """Build the raw (unjitted) per-iteration optimization function.
 
@@ -79,12 +91,25 @@ def make_update_impl(
       identical to the split path.
     """
     update_epochs = int(cfg.algo.update_epochs)
-    global_bs = int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    # the default global batch assumes the mesh is DATA-parallel (every device
+    # holds a slice of one rollout); the population trainer's mesh shards
+    # MEMBERS instead — each member updates locally over its own n_data rows —
+    # so it pins batch_size=per_rank_batch_size explicitly
+    global_bs = (
+        int(batch_size) if batch_size is not None
+        else int(cfg.algo.per_rank_batch_size) * runtime.world_size
+    )
     shards = int(shards)
     local_n = n_data // shards
     local_bs = max(global_bs // shards, 1)
     n_minibatches = max(local_n // local_bs, 1)
-    data_sharding = NamedSharding(runtime.mesh, P("data")) if axis_name is None else None
+    # constrain_data=False drops the explicit data-axis sharding constraint:
+    # the population trainer (envs/ingraph/population.py) vmaps this body over
+    # a member axis (and may run it inside shard_map), where the constraint's
+    # env-batch placement no longer applies.
+    data_sharding = (
+        NamedSharding(runtime.mesh, P("data")) if (axis_name is None and constrain_data) else None
+    )
     nonfinite_guard = resilience.guard_enabled(resilience.resolve(cfg))
 
     def loss_fn(params, batch, clip_coef, ent_coef):

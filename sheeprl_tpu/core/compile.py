@@ -297,6 +297,29 @@ def specs_of(tree: Any) -> Any:
     return jax.tree_util.tree_map(spec_like, tree)
 
 
+def stacked_specs(tree: Any, n: int, mesh: Any = None, axis: str = "data") -> Any:
+    """AOT warmup specs for ``tree`` stacked along a NEW leading axis of size ``n``.
+
+    The population trainer (envs/ingraph/population.py) trains N PBT members as
+    one vmapped program over member-stacked params/opt-state/carry pytrees. The
+    stacked arrays are expensive to materialize (N copies of the model), so the
+    background AOT warmup wants their specs *before* the stack exists — this
+    derives them from a single member's live values (or specs). With ``mesh``
+    given (>1 device), every leaf is annotated with the population-axis
+    sharding ``P(axis)`` so the compile targets the mesh-sharded placements.
+    """
+
+    def one(x: Any) -> jax.ShapeDtypeStruct:
+        sharding = None
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            sharding = NamedSharding(mesh, PartitionSpec(axis))
+        return jax.ShapeDtypeStruct((int(n),) + tuple(x.shape), x.dtype, sharding=sharding)
+
+    return jax.tree_util.tree_map(one, tree)
+
+
 # --------------------------------------------------------------------------- #
 # The retrace guard
 # --------------------------------------------------------------------------- #

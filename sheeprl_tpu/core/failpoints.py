@@ -113,6 +113,8 @@ KNOWN_FAILPOINTS: Dict[str, Dict[str, str]] = {
     "orchestrate.journal": {"plane": "orchestrate", "doc": "journal append fails (torn orchestrator state)"},
     "orchestrate.spawn": {"plane": "orchestrate", "doc": "member spawn fails at process start"},
     "orchestrate.inject": {"plane": "orchestrate", "doc": "periodic orchestrator-driven member fault"},
+    "population.exploit": {"plane": "orchestrate", "doc": "in-graph PBT exploit step fails at an epoch boundary"},
+    "population.member_sync": {"plane": "orchestrate", "doc": "per-member checkpoint-slice sync fails (fire: poison the member's params)"},
     "env.step": {"plane": "env", "doc": "environment step raises/hangs"},
     "env.reset": {"plane": "env", "doc": "environment reset raises/hangs"},
     "env.autoreset": {"plane": "env", "doc": "autoreset path misbehaves after episode end"},

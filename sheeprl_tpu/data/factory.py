@@ -70,7 +70,9 @@ def make_rollout_buffer(cfg, runtime, n_envs: int, obs_keys: Sequence[str], log_
     if env_cfg is not None and str(env_cfg.get("backend", "gym")).lower() == "ingraph":
         # the fused in-graph collector (envs/ingraph/rollout.py) materializes
         # the [T, B] rollout directly in the buffer layout as its scan output —
-        # there is no incremental store to manage
+        # there is no incremental store to manage. The vmapped population loop
+        # (envs/ingraph/population.py) stacks the same layout to [N, T, B] per
+        # member inside one compiled epoch, so it too runs bufferless.
         return None
     if buffer_backend(cfg) == "device":
         if cfg.buffer.get("memmap", False):

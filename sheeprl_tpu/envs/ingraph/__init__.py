@@ -22,7 +22,22 @@ from sheeprl_tpu.envs.ingraph.base import EnvParams, FuncEnv, autoreset_step
 from sheeprl_tpu.envs.ingraph.cartpole import CartPole, CartPoleParams, CartPoleState
 from sheeprl_tpu.envs.ingraph.gridworld import GridWorld, GridWorldParams, GridWorldState
 from sheeprl_tpu.envs.ingraph.pendulum import Pendulum, PendulumParams, PendulumState
+from sheeprl_tpu.envs.ingraph.domainrand import (
+    DEFAULT_RANGES,
+    randomizable_fields,
+    resolve_ranges,
+    sample_overrides,
+)
 from sheeprl_tpu.envs.ingraph.fused import FusedInGraphTrainer, carry_partition_spec, shard_carry
+from sheeprl_tpu.envs.ingraph.population import (
+    PopulationSentinel,
+    PopulationState,
+    PopulationTrainer,
+    exploit_plan,
+    population_partition_spec,
+    shard_population,
+    stack_member,
+)
 from sheeprl_tpu.envs.ingraph.replay_ring import ReplayRing, RingState
 from sheeprl_tpu.envs.ingraph.rollout import InGraphRolloutCollector, iter_finished_episodes
 from sheeprl_tpu.envs.ingraph.vector import Carry, InGraphVectorEnv
@@ -44,6 +59,17 @@ __all__ = [
     "InGraphVectorEnv",
     "InGraphRolloutCollector",
     "FusedInGraphTrainer",
+    "PopulationTrainer",
+    "PopulationState",
+    "PopulationSentinel",
+    "exploit_plan",
+    "population_partition_spec",
+    "shard_population",
+    "stack_member",
+    "DEFAULT_RANGES",
+    "randomizable_fields",
+    "resolve_ranges",
+    "sample_overrides",
     "ReplayRing",
     "RingState",
     "carry_partition_spec",

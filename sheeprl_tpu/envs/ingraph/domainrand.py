@@ -1,0 +1,106 @@
+"""Per-member domain randomization over in-graph ``EnvParams`` physics.
+
+The first rung of the scenario-distribution axis (ROADMAP item 5): every PBT
+population member trains on its own draw of the env's physics constants —
+CartPole gravity/masses/length, Pendulum g/m/l — sampled uniformly from
+configurable ranges. The draws come back as a dict of ``[N]`` f32 arrays that
+the :class:`~sheeprl_tpu.envs.ingraph.population.PopulationTrainer` threads
+through the collector's ``env_overrides`` seam as *traced vmapped operands*:
+each member's ``lax.scan`` rollout steps (and auto-resets) its B envs under
+its own dynamics with no retrace and no per-member compile.
+
+Only continuously-valued dynamics fields may be randomized. Structural fields
+(``max_episode_steps`` gates a *static* Python branch in ``FuncEnv.step``,
+``dtype`` picks the trace dtype) would change the traced program per member,
+which a vmapped operand cannot express — they are rejected up front.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.ingraph.base import EnvParams
+
+__all__ = ["DEFAULT_RANGES", "randomizable_fields", "resolve_ranges", "sample_overrides"]
+
+# fields that parameterize the traced program itself, never a traced operand
+_STRUCTURAL_FIELDS = ("max_episode_steps", "dtype")
+
+# sensible default ±20%-ish ranges around the Gymnasium constants, keyed by
+# the registry env id — the config may override any subset (orchestrate
+# population.domain_rand)
+DEFAULT_RANGES: Dict[str, Dict[str, Tuple[float, float]]] = {
+    "CartPole-v1": {
+        "gravity": (8.0, 11.5),
+        "masscart": (0.8, 1.2),
+        "masspole": (0.08, 0.12),
+        "length": (0.4, 0.6),
+    },
+    "Pendulum-v1": {
+        "g": (8.0, 11.5),
+        "m": (0.8, 1.2),
+        "l": (0.8, 1.2),
+    },
+}
+
+
+def randomizable_fields(params: EnvParams) -> Tuple[str, ...]:
+    """Float-valued dynamics fields of ``params`` eligible for randomization."""
+    out = []
+    for f in dataclasses.fields(params):
+        if f.name in _STRUCTURAL_FIELDS:
+            continue
+        if isinstance(getattr(params, f.name), (float, int)) and not isinstance(
+            getattr(params, f.name), bool
+        ):
+            out.append(f.name)
+    return tuple(out)
+
+
+def resolve_ranges(
+    params: EnvParams,
+    env_id: Optional[str] = None,
+    ranges: Optional[Mapping[str, Sequence[float]]] = None,
+) -> Dict[str, Tuple[float, float]]:
+    """Merge configured ``{field: [lo, hi]}`` ranges over the env's defaults.
+
+    ``ranges=None`` falls back to :data:`DEFAULT_RANGES` for the env id (empty
+    when the env has no defaults). Every named field must be a randomizable
+    dynamics field of ``params`` and every range a ``lo <= hi`` pair.
+    """
+    allowed = set(randomizable_fields(params))
+    merged: Dict[str, Tuple[float, float]] = {}
+    source = ranges if ranges is not None else DEFAULT_RANGES.get(str(env_id), {})
+    for name, pair in dict(source).items():
+        if name not in allowed:
+            raise ValueError(
+                f"cannot randomize {name!r}: not a dynamics field of "
+                f"{type(params).__name__} (randomizable: {sorted(allowed)})"
+            )
+        lo, hi = (float(pair[0]), float(pair[1]))
+        if not lo <= hi:
+            raise ValueError(f"bad range for {name!r}: [{lo}, {hi}]")
+        merged[name] = (lo, hi)
+    return merged
+
+
+def sample_overrides(
+    key: jax.Array,
+    n_members: int,
+    ranges: Mapping[str, Tuple[float, float]],
+    dtype: Any = jnp.float32,
+) -> Optional[Dict[str, jax.Array]]:
+    """Draw per-member physics: ``{field: [N] uniform(lo, hi)}``, or ``None``
+    when no ranges are configured (the collector's no-override fast path)."""
+    if not ranges:
+        return None
+    out: Dict[str, jax.Array] = {}
+    for i, (name, (lo, hi)) in enumerate(sorted(ranges.items())):
+        out[name] = jax.random.uniform(
+            jax.random.fold_in(key, i), (int(n_members),), dtype, minval=lo, maxval=hi
+        )
+    return out

@@ -1,0 +1,572 @@
+"""Device-resident vmapped population training: one program trains the fleet.
+
+The orchestrate plane's PBT loop (PR 6) trains each population member as a
+separate ``sheeprl.py`` subprocess — N processes, N compiles, N Python host
+loops. This module folds the population axis *into* the compiled program the
+PureJaxRL/Brax way: the :class:`PopulationTrainer` vmaps the exact iteration
+body the :class:`~sheeprl_tpu.envs.ingraph.fused.FusedInGraphTrainer` compiles
+(the collector's unjitted ``collect_impl`` + the algo's unjitted
+``update_impl``) over a leading member axis, so N members × B envs train in
+ONE jitted, donated-carry program with zero host round-trips between exploit
+intervals.
+
+Per-member state is the same pytree the single-member path uses, stacked on a
+new leading ``[N]`` axis: params, optimizer state, rollout carry. Per-member
+*hyperparameters* (the update impl's trailing scalar extras — PPO's
+clip/entropy coefs + lr_scale, A2C's lr_scale) ride as ``[N]`` traced
+operands, and per-member *env physics* (domain randomization — see
+:mod:`sheeprl_tpu.envs.ingraph.domainrand`) as a dict of ``[N]`` traced
+``EnvParams`` overrides threaded through the collector's ``env_overrides``
+seam. Because hypers and physics are traced operands rather than closed-over
+constants, exploit/explore never retraces anything.
+
+An *epoch* is ``iters_per_epoch`` fused iterations under one ``lax.scan``,
+with the per-member fitness EWMA (mean finished-episode return) and a
+per-member nonfinite counter updated in-graph. At epoch boundaries the
+in-graph PBT **exploit** runs truncation selection + hyperparam perturb as a
+pure function of the fitness carry — the same math as
+:func:`sheeprl_tpu.orchestrate.resow.perturb` / ``bottom_quantile``
+(stable sort, ``max(int(n·q), 1)`` cut, multiplicative factor choice), jax-
+traced — so only the ``[N]`` fitness/lineage vectors ever return to the host.
+
+The ``mesh`` variant lays the member axis onto the device mesh's ``data``
+axis via the portable ``shard_map`` shim: every member-stacked leaf shards on
+its leading axis, each device runs ``N/n_dev`` members' full train loops
+locally with zero steady-state collective traffic, and the (rare) exploit
+step is a second shard_map program in which every shard all-gathers the
+population and pulls its own members' new rows locally (explicit collectives
+rather than a GSPMD global-array gather — see the note in ``exploit_shard``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.data.device_buffer import _shard_map
+from sheeprl_tpu.envs.ingraph.vector import Carry
+
+__all__ = [
+    "PopulationState",
+    "PopulationTrainer",
+    "PopulationSentinel",
+    "exploit_plan",
+    "population_partition_spec",
+    "shard_population",
+    "stack_member",
+]
+
+
+class PopulationState(NamedTuple):
+    """Everything the population program owns between host visits.
+
+    ``params``/``opt_state`` are the single-member pytrees stacked on a new
+    leading ``[N]`` axis; ``carry`` is the rollout :class:`Carry` with every
+    leaf ``[N, B, ...]`` (the key leaf is per-member ``[N, 2]``). ``hypers``
+    is the tuple of ``[N]`` f32 per-member update-impl extras, in the same
+    order the fused trainer passes them positionally. ``fitness`` is the
+    ``[N]`` f32 EWMA of mean finished-episode return; ``nonfinite`` counts
+    nonfinite train-metric leaves per member since the last exploit (the
+    health poison marker the exploit step reads).
+    """
+
+    params: Any
+    opt_state: Any
+    carry: Carry
+    hypers: Tuple[jax.Array, ...]
+    fitness: jax.Array
+    nonfinite: jax.Array
+
+
+def stack_member(tree: Any, n: int) -> Any:
+    """Broadcast-stack a single member's pytree to ``[N, ...]`` (N copies)."""
+    return jax.tree_util.tree_map(lambda x: jnp.repeat(x[None], int(n), axis=0), tree)
+
+
+def population_partition_spec() -> PopulationState:
+    """``shard_map`` prefix spec: every member-stacked subtree on ``data``."""
+    d = P("data")
+    return PopulationState(params=d, opt_state=d, carry=d, hypers=d, fitness=d, nonfinite=d)
+
+
+def shard_population(state: PopulationState, mesh: Mesh) -> PopulationState:
+    """Place a freshly-initialized population on the mesh (member axis on
+    ``data``). The epoch step donates the state and returns it identically
+    placed, so this is paid once per run (and after sentinel re-inits)."""
+    spec = population_partition_spec()
+    shardings = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.device_put(state, shardings)
+
+
+def exploit_plan(
+    fitness: jax.Array,
+    key: jax.Array,
+    *,
+    quantile: float,
+    n_hypers: int,
+    factors: Sequence[float],
+    perturb_mask: Optional[Sequence[bool]] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Pure truncation-selection + perturb plan over a fitness vector.
+
+    The jax-traced twin of :func:`sheeprl_tpu.orchestrate.resow.bottom_quantile`
+    + :func:`~sheeprl_tpu.orchestrate.resow.perturb`: the bottom
+    ``max(int(n·quantile), 1)`` members (stable sort — ties broken by member
+    index, exactly the host helper's ``(fitness, key)`` ordering) each clone a
+    uniformly-chosen member of the top quantile, and every cloned member's
+    perturbable hypers are scaled by a factor drawn from ``factors`` (the host
+    helper's ``val * rng.choice(factors)``). A bottom member only swaps when
+    its chosen source is strictly fitter, so a population of one (or an
+    all-equal population) is a bitwise no-op.
+
+    Returns ``(member_src, factor, swapped)``: the ``[N]`` int32 gather map
+    (``member_src[i] == i`` when member i keeps its own state), the
+    ``[N, n_hypers]`` f32 multiplicative factors (1.0 wherever not perturbed),
+    and the ``[N]`` bool swap mask.
+    """
+    n = fitness.shape[0]
+    n_cut = max(int(n * float(quantile)), 1)
+    order = jnp.argsort(fitness)  # stable: ties resolve by member index
+    bottom = order[:n_cut]
+    top = order[n - n_cut :]
+    k_src, k_fac = jax.random.split(key)
+    src = top[jax.random.randint(k_src, (n_cut,), 0, n_cut)]
+    better = fitness[src] > fitness[bottom]
+    src = jnp.where(better, src, bottom)
+    member_src = jnp.arange(n, dtype=jnp.int32).at[bottom].set(src.astype(jnp.int32))
+    swapped = member_src != jnp.arange(n, dtype=jnp.int32)
+    factors_arr = jnp.asarray(list(factors), jnp.float32)
+    idx = jax.random.randint(k_fac, (n, int(n_hypers)), 0, factors_arr.shape[0])
+    factor = factors_arr[idx]
+    mask = swapped[:, None]
+    if perturb_mask is not None:
+        mask = jnp.logical_and(mask, jnp.asarray(list(perturb_mask), bool)[None, :])
+    factor = jnp.where(mask, factor, 1.0)
+    return member_src, factor, swapped
+
+
+class PopulationTrainer:
+    """Vmapped-population twin of the fused trainer.
+
+    ``collector`` and ``update_impl`` are the SAME objects the single-member
+    :class:`~sheeprl_tpu.envs.ingraph.fused.FusedInGraphTrainer` composes
+    (build ``update_impl`` with ``constrain_data=False`` — the env-batch
+    sharding constraint does not apply under the member vmap), so a
+    population of one is bitwise-identical to the fused path by construction
+    (pinned in tests/test_envs/test_ingraph_population.py).
+
+    ``n_hypers`` is the number of trailing per-member extras the update impl
+    takes (PPO: 3, A2C: 1); ``perturb_mask`` selects which of them exploit may
+    perturb (default: all).
+    """
+
+    def __init__(
+        self,
+        collector: Any,
+        update_impl: Callable,
+        *,
+        n_hypers: int,
+        iters_per_epoch: int,
+        fitness_alpha: float = 0.3,
+        quantile: float = 0.25,
+        factors: Sequence[float] = (0.8, 1.25),
+        perturb_mask: Optional[Sequence[bool]] = None,
+        mesh: Optional[Mesh] = None,
+        name: str = "population",
+    ):
+        self.collector = collector
+        self.venv = collector.venv
+        self.mesh = mesh
+        self.n_hypers = int(n_hypers)
+        self.iters_per_epoch = int(iters_per_epoch)
+        self.quantile = float(quantile)
+        self.factors = tuple(float(f) for f in factors)
+        self.perturb_mask = None if perturb_mask is None else tuple(bool(b) for b in perturb_mask)
+        alpha = float(fitness_alpha)
+        rollout_steps = int(collector.rollout_steps)
+        collect_impl = collector.collect_impl
+
+        def member_iteration(params, opt_state, carry, key, env_overrides, *hypers):
+            new_carry, data, roll_metrics, next_values = collect_impl(params, carry, env_overrides)
+            params, opt_state, _flat, train_metrics = update_impl(
+                params, opt_state, data, next_values, key, *hypers
+            )
+            return params, opt_state, new_carry, roll_metrics, train_metrics
+
+        vmapped_iteration = jax.vmap(member_iteration)
+
+        def squeezed_iteration(params, opt_state, carry, keys_n, env_overrides, *hypers):
+            # population-of-1 (or one member per shard): drop the member axis
+            # and run the UNBATCHED member trace — vmap over a size-1 axis
+            # still batches the matmuls, which reorders the f32 reductions and
+            # costs ~1e-8 vs the fused single-member path; this static branch
+            # keeps pop-of-1 bitwise-identical by construction
+            sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+            ov = None if env_overrides is None else {k: v[0] for k, v in env_overrides.items()}
+            outs = member_iteration(
+                sq(params), sq(opt_state), sq(carry), keys_n[0], ov, *(h[0] for h in hypers)
+            )
+            return tuple(jax.tree_util.tree_map(lambda x: x[None], o) for o in outs)
+
+        def epoch(state: PopulationState, env_overrides, iter_keys):
+            # shapes come from the traced carry, NOT closed-over globals: under
+            # shard_map the same trace runs on the [N/n_dev] local member block
+            n_local, b = state.carry.ep_ret.shape
+            run_members = squeezed_iteration if n_local == 1 else vmapped_iteration
+            roll0 = {
+                "episode_returns": jnp.zeros(
+                    (n_local, rollout_steps, b), state.carry.ep_ret.dtype
+                ),
+                "episode_lengths": jnp.zeros(
+                    (n_local, rollout_steps, b), state.carry.ep_len.dtype
+                ),
+                "dones": jnp.zeros((n_local, rollout_steps, b), jnp.float32),
+            }
+
+            def body(carry_in, keys_n):
+                st, _last = carry_in
+                params, opt_state, new_carry, roll_m, train_m = run_members(
+                    st.params, st.opt_state, st.carry, keys_n, env_overrides, *st.hypers
+                )
+                # in-graph fitness EWMA over finished episodes this iteration
+                ep_cnt = jnp.sum(roll_m["dones"], axis=(1, 2))
+                ep_sum = jnp.sum(roll_m["episode_returns"], axis=(1, 2))
+                iter_fit = ep_sum / jnp.maximum(ep_cnt, 1.0)
+                fitness = jnp.where(
+                    ep_cnt > 0.0, (1.0 - alpha) * st.fitness + alpha * iter_fit, st.fitness
+                )
+                # nonfinite train metrics poison the member until exploit heals it
+                bad = st.nonfinite
+                for leaf in jax.tree_util.tree_leaves(train_m):
+                    bad = bad + jnp.sum(
+                        jnp.logical_not(jnp.isfinite(leaf)).astype(jnp.int32).reshape(n_local, -1),
+                        axis=1,
+                    )
+                new_st = PopulationState(
+                    params=params,
+                    opt_state=opt_state,
+                    carry=new_carry,
+                    hypers=st.hypers,
+                    fitness=fitness,
+                    nonfinite=bad,
+                )
+                return (new_st, roll_m), train_m
+
+            (state, last_roll), train_ms = jax.lax.scan(body, (state, roll0), iter_keys)
+            return state, last_roll, train_ms
+
+        if mesh is None:
+            epoch_body = epoch
+        else:
+            state_spec = population_partition_spec()
+            epoch_body = _shard_map(
+                epoch,
+                mesh=mesh,
+                # iter_keys [K, N, 2]: member axis is axis 1
+                in_specs=(state_spec, P("data"), P(None, "data")),
+                out_specs=(state_spec, P("data"), P(None, "data")),
+            )
+
+        # Donation is unconditional off-mesh and on real accelerator meshes,
+        # but NOT on a CPU mesh: the CPU PjRt client's buffer aliasing for
+        # donated multi-device programs is unsound under host oversubscription
+        # (--xla_force_host_platform_device_count on fewer physical cores ->
+        # flaky heap corruption / silently garbage output rows, observed on
+        # both the shard_map epoch and the exploit gather). The extra state
+        # copy per program call is once per epoch / exploit, off the per-
+        # iteration hot path.
+        mesh_donate = (0,) if (mesh is None or jax.default_backend() != "cpu") else ()
+
+        self.epoch_fn = jax_compile.guarded_jit(
+            epoch_body, name=f"{name}.ingraph_epoch", donate_argnums=mesh_donate
+        )
+
+        def _effective_fitness(fitness, nonfinite):
+            # a member is only as fit as it is finite: poisoned members sort
+            # to the bottom unconditionally (-inf is the marker, never stored
+            # back into the EWMA — (1-a)·(-inf) could not recover)
+            return jnp.where(
+                jnp.logical_or(nonfinite > 0, jnp.logical_not(jnp.isfinite(fitness))),
+                -jnp.inf,
+                fitness,
+            )
+
+        def _plan(eff, key):
+            k_plan, _k_seed = jax.random.split(key)
+            return exploit_plan(
+                eff,
+                k_plan,
+                quantile=self.quantile,
+                n_hypers=self.n_hypers,
+                factors=self.factors,
+                perturb_mask=self.perturb_mask,
+            )
+
+        def exploit(state: PopulationState, key):
+            eff = _effective_fitness(state.fitness, state.nonfinite)
+            member_src, factor, swapped = _plan(eff, key)
+            take = lambda x: jnp.take(x, member_src, axis=0)
+            params = jax.tree_util.tree_map(take, state.params)
+            opt_state = jax.tree_util.tree_map(take, state.opt_state)
+            carry = jax.tree_util.tree_map(take, state.carry)
+            # clones must diverge from their parent: re-key the swapped
+            # members' env/act stream (fold_in their own index)
+            n = state.fitness.shape[0]
+            reseeded = jax.vmap(jax.random.fold_in)(carry.key, jnp.arange(n))
+            carry = carry._replace(key=jnp.where(swapped[:, None], reseeded, carry.key))
+            hypers = tuple(
+                take(h) * factor[:, j].astype(h.dtype) for j, h in enumerate(state.hypers)
+            )
+            new_state = PopulationState(
+                params=params,
+                opt_state=opt_state,
+                carry=carry,
+                hypers=hypers,
+                fitness=take(state.fitness),
+                nonfinite=jnp.where(swapped, 0, state.nonfinite),
+            )
+            return new_state, member_src, factor
+
+        def exploit_shard(state: PopulationState, key):
+            # per-shard body: leaves carry this device's [N/K] members. Every
+            # shard all-gathers the (tiny) fitness vectors, computes the SAME
+            # plan from the same replicated key, and pulls its own members'
+            # new state by explicit all_gather + local row gather. The naive
+            # global-array `jnp.take` is NOT used on mesh: GSPMD lowers that
+            # cross-shard gather to a collective/aliasing combo the CPU PjRt
+            # client miscompiles on oversubscribed hosts (flaky heap
+            # corruption and silently garbage rows with
+            # --xla_force_host_platform_device_count); the explicit-collective
+            # form is the same path the rest of the repo's shard_map bodies
+            # already exercise.
+            fit = jax.lax.all_gather(state.fitness, "data", tiled=True)
+            nf = jax.lax.all_gather(state.nonfinite, "data", tiled=True)
+            member_src, factor, swapped = _plan(_effective_fitness(fit, nf), key)
+            n_local = state.fitness.shape[0]
+            local_ids = jax.lax.axis_index("data") * n_local + jnp.arange(n_local)
+            local_src = jnp.take(member_src, local_ids)
+            pull = lambda x: jnp.take(
+                jax.lax.all_gather(x, "data", tiled=True), local_src, axis=0
+            )
+            params = jax.tree_util.tree_map(pull, state.params)
+            opt_state = jax.tree_util.tree_map(pull, state.opt_state)
+            carry = jax.tree_util.tree_map(pull, state.carry)
+            local_swapped = jnp.take(swapped, local_ids)
+            reseeded = jax.vmap(jax.random.fold_in)(carry.key, local_ids)
+            carry = carry._replace(key=jnp.where(local_swapped[:, None], reseeded, carry.key))
+            hypers = tuple(
+                pull(h) * jnp.take(factor[:, j], local_ids).astype(h.dtype)
+                for j, h in enumerate(state.hypers)
+            )
+            new_state = PopulationState(
+                params=params,
+                opt_state=opt_state,
+                carry=carry,
+                hypers=hypers,
+                fitness=jnp.take(fit, local_src),
+                nonfinite=jnp.where(local_swapped, 0, state.nonfinite),
+            )
+            return new_state, member_src, factor
+
+        if mesh is None:
+            exploit_body = exploit
+        else:
+            state_spec = population_partition_spec()
+            exploit_body = _shard_map(
+                exploit_shard,
+                mesh=mesh,
+                in_specs=(state_spec, P()),
+                # member_src/factor are computed identically on every shard
+                out_specs=(state_spec, P(), P()),
+            )
+
+        self.exploit_fn = jax_compile.guarded_jit(
+            exploit_body, name=f"{name}.ingraph_exploit", donate_argnums=mesh_donate
+        )
+
+    # ---------------------------------------------------------------- building
+    def init_population(
+        self,
+        params: Any,
+        opt_state: Any,
+        key: jax.Array,
+        n_members: int,
+        base_hypers: Sequence[float],
+        env_overrides: Optional[Dict[str, jax.Array]] = None,
+    ) -> PopulationState:
+        """Stack a single member's init into the population state.
+
+        Params/opt-state start as N identical copies (per-member env keys and
+        hyper perturbs drive divergence); every member's B env streams reset
+        from its own key (and its own domain-randomized physics when
+        ``env_overrides`` is given).
+        """
+        n = int(n_members)
+        if len(tuple(base_hypers)) != self.n_hypers:
+            raise ValueError(f"expected {self.n_hypers} base hypers, got {len(tuple(base_hypers))}")
+        venv = self.venv
+        env, env_params, b = venv.env, venv.env_params, int(venv.num_envs)
+
+        def member_reset(mkey, overrides):
+            p = env_params if overrides is None else env_params.replace(**dict(overrides))
+            keys = jax.random.split(mkey, b + 1)
+            state, obs = jax.vmap(lambda k: env.reset(k, p))(keys[1:])
+            return Carry(
+                state=state,
+                # some envs return obs as the state leaf itself; the epoch step
+                # donates the carry, so aliased leaves would donate one buffer
+                # twice — copy breaks the alias bit-exactly
+                obs=jnp.array(obs, copy=True),
+                key=keys[0],
+                ep_ret=jnp.zeros((b,), jnp.float32),
+                ep_len=jnp.zeros((b,), jnp.int32),
+            )
+
+        member_keys = jax.random.split(key, n)
+        carry = jax.vmap(member_reset)(member_keys, env_overrides)
+        state = PopulationState(
+            params=stack_member(params, n),
+            opt_state=stack_member(opt_state, n),
+            carry=carry,
+            hypers=tuple(jnp.full((n,), float(h), jnp.float32) for h in base_hypers),
+            fitness=jnp.zeros((n,), jnp.float32),
+            nonfinite=jnp.zeros((n,), jnp.int32),
+        )
+        if self.mesh is not None:
+            state = shard_population(state, self.mesh)
+        return state
+
+    # ----------------------------------------------------------------- driving
+    def epoch_keys(self, key: jax.Array, n_members: int) -> jax.Array:
+        """``[iters_per_epoch, N, 2]`` per-iteration per-member update keys,
+        committed to the mesh layout the epoch executable expects."""
+        k = self.iters_per_epoch
+        keys = jax.random.split(key, k * int(n_members)).reshape(k, int(n_members), 2)
+        if self.mesh is not None:
+            keys = jax.device_put(keys, NamedSharding(self.mesh, P(None, "data")))
+        return keys
+
+    def run_epoch(self, state: PopulationState, env_overrides, key: jax.Array):
+        """One compiled epoch: ``iters_per_epoch`` fused iterations for every
+        member. Returns ``(state, last_roll_metrics, train_metrics_stack)``,
+        all still on device."""
+        return self.epoch_fn(state, env_overrides, self.epoch_keys(key, state.fitness.shape[0]))
+
+    def exploit(self, state: PopulationState, key: jax.Array):
+        """In-graph PBT exploit/explore. Returns ``(state, member_src, factor)``
+        — the gather map and perturb factors are the only host-bound lineage
+        payload (``[N]`` / ``[N, n_hypers]``)."""
+        return self.exploit_fn(state, self.to_mesh(key))
+
+    def to_mesh(self, x):
+        """Commit a small replicated operand onto the mesh (no-op off-mesh)."""
+        if self.mesh is None:
+            return x
+        return jax.device_put(x, NamedSharding(self.mesh, P()))
+
+    def commit_env_overrides(self, env_overrides):
+        """Place the ``[N]`` override leaves in the member-sharded layout."""
+        if env_overrides is None or self.mesh is None:
+            return env_overrides
+        return jax.device_put(env_overrides, NamedSharding(self.mesh, P("data")))
+
+    def stacked_state_specs(self, params, opt_state, base_hypers, n_members: int):
+        """Population-state specs derived from SINGLE-member live values.
+
+        This is the load-bearing use of :func:`core.compile.stacked_specs`:
+        the trainee queues the background AOT compile from one member's
+        params/opt-state (and ``venv.carry``) *before* the N-way stack is
+        materialized, so compilation overlaps :meth:`init_population` instead
+        of waiting behind it.
+        """
+        n = int(n_members)
+        if self.venv.carry is None:
+            raise RuntimeError("stacked_state_specs() before venv.reset()")
+        s = lambda t: jax_compile.stacked_specs(t, n, self.mesh)
+        return PopulationState(
+            params=s(params),
+            opt_state=s(opt_state),
+            carry=s(self.venv.carry),
+            hypers=tuple(s(jnp.float32(h)) for h in base_hypers),
+            fitness=s(jnp.float32(0)),
+            nonfinite=s(jnp.int32(0)),
+        )
+
+    def stacked_warmup_specs(
+        self, params, opt_state, base_hypers, n_members: int, env_overrides=None
+    ):
+        """Epoch-fn warmup specs without materializing the stacked population."""
+        state_spec = self.stacked_state_specs(params, opt_state, base_hypers, n_members)
+        key_spec = jax.ShapeDtypeStruct(
+            (self.iters_per_epoch, int(n_members), 2),
+            jnp.uint32,
+            sharding=(
+                NamedSharding(self.mesh, P(None, "data")) if self.mesh is not None else None
+            ),
+        )
+        return (state_spec, jax_compile.specs_of(env_overrides), key_spec)
+
+    def stacked_exploit_specs(self, params, opt_state, base_hypers, n_members: int):
+        """Exploit-fn warmup specs from single-member live values."""
+        return (
+            self.stacked_state_specs(params, opt_state, base_hypers, n_members),
+            jax_compile.spec_like(self.to_mesh(jax.random.PRNGKey(0))),
+        )
+
+    def warmup_specs(self, state: PopulationState, env_overrides, n_members: int):
+        """Specs for ``AOTWarmup.add(epoch_fn, ...)`` from live values."""
+        key_spec = jax.ShapeDtypeStruct(
+            (self.iters_per_epoch, int(n_members), 2),
+            jnp.uint32,
+            sharding=(
+                NamedSharding(self.mesh, P(None, "data")) if self.mesh is not None else None
+            ),
+        )
+        return (
+            jax_compile.specs_of(state),
+            jax_compile.specs_of(env_overrides),
+            key_spec,
+        )
+
+    def exploit_warmup_specs(self, state: PopulationState):
+        """Specs for ``AOTWarmup.add(exploit_fn, ...)``."""
+        key = jax.random.PRNGKey(0)
+        return (
+            jax_compile.specs_of(state),
+            jax_compile.spec_like(self.to_mesh(key)),
+        )
+
+
+class PopulationSentinel:
+    """Health sentinel over the per-member fitness/nonfinite vectors.
+
+    The trainee calls :meth:`check` after every epoch pull (the ``[N]``
+    vectors are already host-bound for journaling, so the sentinel adds zero
+    device traffic). A member is unhealthy when its fitness is nonfinite or
+    its nonfinite-metric counter is nonzero; the *population* is unhealthy
+    only when every member is (exploit heals individual members for free).
+    """
+
+    def __init__(self, name: str = "population"):
+        self.name = name
+        self.events = []
+
+    def check(self, fitness, nonfinite, epoch: int = 0) -> Dict[str, Any]:
+        fit = np.asarray(fitness)
+        bad = np.logical_or(~np.isfinite(fit), np.asarray(nonfinite) > 0)
+        report = {
+            "epoch": int(epoch),
+            "bad_members": [int(i) for i in np.nonzero(bad)[0]],
+            "healthy": not bool(bad.all()),
+            "all_healthy": not bool(bad.any()),
+        }
+        if report["bad_members"]:
+            self.events.append(report)
+        return report

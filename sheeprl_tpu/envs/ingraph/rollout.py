@@ -73,7 +73,7 @@ class InGraphRolloutCollector:
         self.rollout_steps = int(rollout_steps)
         env, params = venv.env, venv.env_params
         obs_key = venv.obs_key
-        step_fn = autoreset_step(env, params)
+        base_step = autoreset_step(env, params)
         act_impl = player._act_impl  # unjitted: fused into this trace
         values_impl = player._values_impl
         is_continuous = player.agent.is_continuous
@@ -95,7 +95,7 @@ class InGraphRolloutCollector:
             # batch size from the traced obs, NOT the closed-over venv.num_envs:
             # under shard_map the same trace runs on the [B/n_shards] local block
             step_keys = jax.random.split(sub, obs.shape[0])
-            state, next_obs, reward, done, info = jax.vmap(step_fn)(
+            state, next_obs, reward, done, info = jax.vmap(step_ref[0])(
                 step_keys, carry.state, to_env_action(env_actions)
             )
             reward = reward.astype(jnp.float32)
@@ -126,11 +126,20 @@ class InGraphRolloutCollector:
             return new_carry, (out, step_metrics, aux)
 
         # _act_impl closes over params positionally; a one-slot list lets the
-        # scan body read the traced params without re-deriving the closure
+        # scan body read the traced params without re-deriving the closure.
+        # step_ref works the same way for the env step: the population trainer
+        # passes traced per-member EnvParams overrides (domain randomization)
+        # and the scan body must see the override-closed step at trace time.
         policy_params_ref = [None]
+        step_ref = [base_step]
 
-        def collect(policy_params, carry: Carry):
+        def collect(policy_params, carry: Carry, env_overrides=None):
             policy_params_ref[0] = policy_params
+            step_ref[0] = (
+                base_step
+                if env_overrides is None
+                else autoreset_step(env, params.replace(**dict(env_overrides)))
+            )
             carry, (data, metrics, aux) = jax.lax.scan(
                 one_step, carry, None, length=self.rollout_steps
             )

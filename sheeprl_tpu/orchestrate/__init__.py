@@ -49,6 +49,28 @@ _DEFAULTS: Dict[str, Any] = {
     },
     "exploit": {"interval_s": 0.0, "quantile": 0.25, "min_peers": 3, "min_lead": 1},
     "shutdown": {"drain_timeout_s": 60.0},
+    # device-resident vmapped population training (envs/ingraph/population.py):
+    # backend=fused runs the WHOLE population as one supervised trainee process
+    # hosting one compiled program; backend=subprocess is the classic
+    # process-per-trial fleet above. Open-ended sub-dicts (overrides,
+    # domain_rand ranges, perturb hyper list) default to None — _merge only
+    # keeps keys present in a dict default, so a {} default would drop them.
+    "population": {
+        "backend": "subprocess",
+        "members": 4,
+        "envs_per_member": 16,
+        "epochs": 4,
+        "iters_per_epoch": 8,
+        "fitness_alpha": 0.3,
+        "quantile": 0.25,
+        "factors": [0.8, 1.25],
+        "perturb_mask": None,
+        "checkpoint_every": 1,
+        "devices": 1,
+        "max_failures": 2,
+        "domain_rand": None,
+        "overrides": None,
+    },
 }
 
 

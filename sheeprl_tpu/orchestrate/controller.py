@@ -595,6 +595,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--inject-spacing-s", type=float, default=2.0)
     parser.add_argument("--max-runtime-s", type=float, default=None)
     cli = parser.parse_args(argv)
+    # the fused backend dispatches BEFORE load_spec: a fused-population spec
+    # hosts the whole fleet in one trainee and declares no per-trial specs
+    # (load_spec treats an empty trial list as a config error)
+    with open(cli.spec) as f:
+        raw_spec = json.load(f)
+    if str(resolve(raw_spec).population.backend).lower() == "fused":
+        from sheeprl_tpu.orchestrate.fused import FusedPopulationController
+
+        fused = FusedPopulationController(cli.spec, cli.state_dir, cfg=raw_spec)
+        status = fused.run(max_runtime_s=cli.max_runtime_s)
+        print("ORCHESTRATE_RESULT " + json.dumps(fused.summary(status)), flush=True)
+        return 0 if status in ("done", "preempted") else 3
     specs, spec = load_spec(cli.spec)
     controller = PopulationController(
         specs,

@@ -1,0 +1,382 @@
+"""One supervised process hosting the WHOLE in-graph PBT population.
+
+The ``population.backend=fused`` counterpart of the subprocess-per-trial
+fleet: instead of N ``sheeprl.py`` children (N jax imports, N compiles, N host
+loops), the :class:`~sheeprl_tpu.envs.ingraph.population.PopulationTrainer`
+trains all N members as ONE compiled vmapped program, and this process is the
+single supervised trainee the :class:`FusedPopulationController` drives. The
+orchestrate contract is preserved at the fleet level:
+
+- **journal/lineage rows per member**: every epoch appends the ``[N]``
+  fitness/nonfinite vectors (the only steady-state host pull) to
+  ``population/fitness.jsonl``; every exploit swap lands in
+  ``lineage.jsonl`` as a ``resow`` row (member ``m03`` cloned from ``m01``
+  with these perturb factors) — the same file ``orchestrate/lineage.py``
+  reads, so ancestry reconstruction works unchanged;
+- **certified per-member checkpoint slices**: every ``checkpoint_every``
+  epochs each member's params/opt-state slice is saved + certified through
+  ``utils/checkpoint.py`` (the rolling-deploy / resow medium elsewhere);
+- **health sentinel on the fitness vector**: the
+  :class:`~sheeprl_tpu.envs.ingraph.population.PopulationSentinel` classifies
+  members from the already-pulled vectors, adding zero device traffic;
+- **chaos seams**: ``population.exploit`` fires before every in-graph exploit
+  and ``population.member_sync`` before every member checkpoint slice — a
+  ``fire`` action on the latter poisons the member's params (NaN), which the
+  nonfinite counter flags and the next exploit heals (drilled by
+  ``scripts/population_fused_smoke.py``);
+- **preemption**: the process runs under ``PreemptionGuard`` with the
+  controller's READY/FLAG files, so SIGTERM drains exactly like any trial.
+
+Per-member episode-metric pulls are gated to ``metric.log_every`` drains
+(the PR 11 pattern): between drains an epoch's host traffic is the ``[N]``
+vectors, nothing else.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.core.resilience import PreemptionGuard
+from sheeprl_tpu.core.runtime import build_runtime
+from sheeprl_tpu.config import instantiate, load_config
+from sheeprl_tpu.envs import ingraph as ig
+from sheeprl_tpu.orchestrate import resolve
+from sheeprl_tpu.orchestrate.lineage import LineageLog
+from sheeprl_tpu.utils.checkpoint import certify, save_state
+from sheeprl_tpu.utils.optim import with_clipping
+
+RESULT_TAG = "POPULATION_FUSED "
+
+
+def _append_jsonl(path: str, row: Dict[str, Any]) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+        f.flush()
+
+
+def _poison_member(state: ig.PopulationState, member: int) -> ig.PopulationState:
+    """Chaos drill payload for ``population.member_sync:fire``: NaN the
+    member's param slice. The in-graph nonfinite counter flags it on the next
+    epoch and exploit replaces it from a healthy peer — the fused analogue of
+    the subprocess fleet's divergence -> resow path."""
+    poisoned = jax.tree_util.tree_map(
+        lambda x: x.at[member].set(jnp.nan) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        state.params,
+    )
+    return state._replace(params=poisoned)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--spec", required=True, help="population spec JSON")
+    parser.add_argument("--state-dir", required=True, help="journal/lineage/checkpoint root")
+    parser.add_argument("--max-runtime-s", type=float, default=None)
+    cli = parser.parse_args(argv)
+
+    with open(cli.spec) as f:
+        raw = json.load(f)
+    pcfg = resolve(raw).population
+    state_dir = os.path.abspath(cli.state_dir)
+    os.makedirs(state_dir, exist_ok=True)
+
+    members = int(pcfg.members)
+    envs_per_member = int(pcfg.envs_per_member)
+    epochs = int(pcfg.epochs)
+    devices = int(pcfg.devices)
+
+    overrides = list(pcfg.overrides or []) + [
+        f"env.num_envs={envs_per_member}",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.cnn_keys.encoder=[]",
+    ]
+    if devices > 1:
+        overrides.append(f"fabric.devices={devices}")
+    cfg = load_config(overrides=overrides)
+    if ig.env_backend(cfg) != "ingraph":
+        raise SystemExit("population.backend=fused requires an env.backend=ingraph config")
+
+    runtime = build_runtime(cfg.fabric)
+    world_size = int(runtime.world_size)
+    mesh = runtime.mesh if world_size > 1 else None
+    if members % max(world_size, 1) != 0:
+        raise SystemExit(f"population.members={members} must divide by devices={world_size}")
+
+    # ----- single-member stack: same builders as the fused single-member loop
+    import gymnasium as gym
+
+    from sheeprl_tpu.algos.ppo.agent import build_agent
+
+    venv = ig.make_vector_env(cfg, envs_per_member, int(cfg.seed), device=runtime.device)
+    space = venv.single_action_space
+    is_continuous = isinstance(space, gym.spaces.Box)
+    actions_dim = tuple(space.shape) if is_continuous else (int(space.n),)
+    agent, params, player = build_agent(
+        runtime, actions_dim, is_continuous, cfg, venv.single_observation_space, None
+    )
+    player.params = jax.device_put(player.params, runtime.device)
+    venv.reset(seed=int(cfg.seed))
+    collector = ig.InGraphRolloutCollector(
+        venv,
+        player,
+        rollout_steps=int(cfg.algo.rollout_steps),
+        gamma=float(cfg.algo.gamma),
+        clip_rewards=bool(cfg.env.clip_rewards),
+        store_logprobs=True,
+        name="population",
+    )
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    n_data = envs_per_member * int(cfg.algo.rollout_steps)
+
+    algo_name = str(cfg.algo.name).lower()
+    if algo_name.startswith("a2c"):
+        from sheeprl_tpu.algos.a2c.a2c import make_update_impl
+
+        update_impl = make_update_impl(
+            agent, tx, cfg, runtime, n_data, ["state"], None,
+            constrain_data=False, batch_size=int(cfg.algo.per_rank_batch_size),
+        )
+        base_hypers = (1.0,)
+    else:
+        from sheeprl_tpu.algos.ppo.ppo import make_update_impl
+
+        # batch_size pins the PER-MEMBER batch: the mesh (when any) shards
+        # members, so the data-parallel world_size scaling must not apply
+        update_impl = make_update_impl(
+            agent, tx, cfg, runtime, n_data, ["state"], [], None,
+            constrain_data=False, batch_size=int(cfg.algo.per_rank_batch_size),
+        )
+        base_hypers = (float(cfg.algo.clip_coef), float(cfg.algo.ent_coef), 1.0)
+
+    trainer = ig.PopulationTrainer(
+        collector,
+        update_impl,
+        n_hypers=len(base_hypers),
+        iters_per_epoch=int(pcfg.iters_per_epoch),
+        fitness_alpha=float(pcfg.fitness_alpha),
+        quantile=float(pcfg.quantile),
+        factors=tuple(pcfg.factors or (0.8, 1.25)),
+        perturb_mask=pcfg.perturb_mask,
+        mesh=mesh,
+        name="population",
+    )
+
+    # ----- domain randomization (envs/ingraph/domainrand.py): None disables;
+    # True/"default" uses the env's default ranges; a dict overrides them
+    key = jax.random.PRNGKey(int(cfg.seed))
+    dr = pcfg.domain_rand
+    env_overrides = None
+    ranges: Dict[str, Any] = {}
+    if dr:
+        ranges = ig.resolve_ranges(
+            venv.env_params, cfg.env.id, None if dr in (True, "default") else dict(dr)
+        )
+        env_overrides = ig.sample_overrides(jax.random.fold_in(key, 17), members, ranges)
+        env_overrides = trainer.commit_env_overrides(env_overrides)
+
+    # ----- background AOT warmup from SINGLE-member specs (stacked_specs):
+    # the epoch/exploit executables compile while init_population stacks N
+    # copies of the model on the main thread
+    warmup = jax_compile.AOTWarmup(enabled=jax_compile.aot_enabled(cfg))
+    if warmup.enabled:
+        warmup.add(
+            trainer.epoch_fn,
+            *trainer.stacked_warmup_specs(params, opt_state, base_hypers, members, env_overrides),
+        )
+        warmup.add(
+            trainer.exploit_fn,
+            *trainer.stacked_exploit_specs(params, opt_state, base_hypers, members),
+        )
+        warmup.start()
+
+    state = trainer.init_population(
+        params, opt_state, jax.random.fold_in(key, 23), members, base_hypers, env_overrides
+    )
+
+    lineage = LineageLog(os.path.join(state_dir, "lineage.jsonl"))
+    fitness_log = os.path.join(state_dir, "population", "fitness.jsonl")
+    sentinel = ig.PopulationSentinel()
+    generations = [0] * members
+    hyper_names = (
+        ("algo.clip_coef", "algo.ent_coef", "lr_scale")
+        if len(base_hypers) == 3
+        else ("lr_scale",)
+    )
+    for i in range(members):
+        lineage.record(
+            "seed",
+            f"m{i:02d}",
+            0,
+            hyperparams=dict(zip(hyper_names, [float(h) for h in base_hypers])),
+            backend="fused",
+        )
+
+    env_steps_per_epoch = members * envs_per_member * int(cfg.algo.rollout_steps) * int(
+        pcfg.iters_per_epoch
+    )
+    policy_step, last_log = 0, 0
+    log_every = int(cfg.metric.log_every)
+    log_level = int(cfg.metric.log_level)
+    exploits = swaps = 0
+    epochs_done = 0
+    status = "done"
+    warmup.wait()
+    jax_compile.mark_steady()
+    t_train0 = time.perf_counter()
+
+    with PreemptionGuard(enabled=True) as guard:
+        for ep in range(epochs):
+            state, last_roll, train_ms = trainer.run_epoch(
+                state, env_overrides, jax.random.fold_in(key, 1000 + ep)
+            )
+            policy_step += env_steps_per_epoch
+            fitness = np.asarray(state.fitness)
+            nonfinite = np.asarray(state.nonfinite)
+            report = sentinel.check(fitness, nonfinite, ep)
+
+            # episode/loss pulls gated to log_every drains (PR 11 pattern): a
+            # steady-state epoch's host traffic is the two [N] vectors above
+            if log_level > 0 and (
+                policy_step - last_log >= log_every or ep == epochs - 1
+            ):
+                last_log = policy_step
+                losses = {
+                    k: np.nanmean(np.asarray(v), axis=0).tolist()
+                    for k, v in train_ms.items()
+                    if k.startswith("Loss/")
+                }
+                ep_counts = [
+                    sum(1 for _ in ig.iter_finished_episodes(
+                        {mk: np.asarray(mv)[i] for mk, mv in last_roll.items()}
+                    ))
+                    for i in range(members)
+                ]
+                print(
+                    f"[population] epoch {ep}: policy_step={policy_step} "
+                    f"fitness={np.round(fitness, 3).tolist()} episodes={ep_counts}",
+                    flush=True,
+                )
+                _append_jsonl(
+                    fitness_log,
+                    {
+                        "epoch": ep,
+                        "policy_step": policy_step,
+                        "losses": losses,
+                        "episodes": ep_counts,
+                        "kind": "drain",
+                    },
+                )
+
+            # ----- in-graph exploit/explore at the epoch boundary
+            failpoints.failpoint("population.exploit", epoch=ep)
+            state, member_src, factor = trainer.exploit(
+                state, jax.random.fold_in(key, 2000 + ep)
+            )
+            exploits += 1
+            src = np.asarray(member_src)
+            fac = np.asarray(factor)
+            hypers_now = [np.asarray(h) for h in state.hypers]
+            for i in range(members):
+                if int(src[i]) == i:
+                    continue
+                swaps += 1
+                generations[i] += 1
+                lineage.record(
+                    "resow",
+                    f"m{i:02d}",
+                    generations[i],
+                    parent=f"m{int(src[i]):02d}",
+                    hyperparams={
+                        name: float(hypers_now[j][i]) for j, name in enumerate(hyper_names)
+                    },
+                    factors=[float(x) for x in fac[i]],
+                    backend="fused",
+                )
+            _append_jsonl(
+                fitness_log,
+                {
+                    "epoch": ep,
+                    "fitness": [float(x) for x in fitness],
+                    "nonfinite": [int(x) for x in nonfinite],
+                    "member_src": [int(x) for x in src],
+                    "bad_members": report["bad_members"],
+                    "kind": "epoch",
+                },
+            )
+
+            # ----- certified per-member checkpoint slices
+            if (ep + 1) % max(int(pcfg.checkpoint_every), 1) == 0:
+                host_params = jax.device_get(state.params)
+                host_opt = jax.device_get(state.opt_state)
+                for i in range(members):
+                    fired = failpoints.failpoint(
+                        "population.member_sync", member=i, epoch=ep
+                    )
+                    if fired is True:
+                        # drill: the sync "corrupting" this member stands in
+                        # for any per-member fault — poison it and let the
+                        # nonfinite counter + exploit heal it in-graph
+                        state = _poison_member(state, i)
+                        print(f"[population] member_sync drill poisoned m{i:02d}", flush=True)
+                        continue
+                    mdir = os.path.join(state_dir, "members", f"m{i:02d}")
+                    path = os.path.join(mdir, f"ckpt_ep{ep:04d}.ckpt")
+                    meta = save_state(
+                        path,
+                        {
+                            "agent": jax.tree_util.tree_map(lambda x: x[i], host_params),
+                            "optimizer": jax.tree_util.tree_map(lambda x: x[i], host_opt),
+                            "hypers": [float(h[i]) for h in hypers_now],
+                            "fitness": float(fitness[i]),
+                            "epoch": ep,
+                            "member": i,
+                        },
+                    )
+                    certify(path, **meta, member=i, epoch=ep, policy_step=policy_step)
+
+            epochs_done = ep + 1
+            if guard.should_stop:
+                status = "preempted"
+                break
+            if cli.max_runtime_s is not None and time.perf_counter() - t_train0 > cli.max_runtime_s:
+                status = "timeout"
+                break
+
+    train_wall_s = time.perf_counter() - t_train0
+    total_env_steps = epochs_done * env_steps_per_epoch
+    summary = {
+        "status": status,
+        "backend": "fused",
+        "members": members,
+        "envs_per_member": envs_per_member,
+        "world_size": world_size,
+        "epochs_done": epochs_done,
+        "env_steps": total_env_steps,
+        "train_wall_s": round(train_wall_s, 3),
+        "agg_env_steps_per_s": round(total_env_steps / max(train_wall_s, 1e-9), 1),
+        "exploits": exploits,
+        "swaps": swaps,
+        "retraces": int(trainer.epoch_fn.retraces + trainer.exploit_fn.retraces),
+        "fitness": [float(x) for x in np.asarray(state.fitness)],
+        "domain_rand": sorted(ranges),
+        "sentinel_events": len(sentinel.events),
+    }
+    print(RESULT_TAG + json.dumps(summary), flush=True)
+    venv.close()
+    return 0 if status in ("done", "preempted") else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())

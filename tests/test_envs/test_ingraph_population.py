@@ -1,0 +1,330 @@
+"""Device-resident vmapped population training (envs/ingraph/population.py).
+
+Pins the tentpole guarantees:
+- a population of ONE is bitwise-identical to the single-member
+  ``FusedInGraphTrainer`` — params AND optimizer state after K iterations
+  (the static pop-of-1 branch runs the unbatched member trace, so the f32
+  reduction order matches exactly);
+- the in-graph ``exploit_plan`` reproduces the host PBT helpers' math
+  (``resow.bottom_quantile`` selection with stable tie-breaking,
+  ``resow.perturb`` multiplicative factor choice);
+- AOT warmup from ``stacked_specs`` (single-member live values, BEFORE the
+  population is materialized) leaves zero retraces across epochs + exploits;
+- the ``shard_map`` variant trains an 8-member population on a forced
+  8-device CPU mesh (member axis on ``data``) without retracing;
+- domain randomization samples valid per-member physics and actually changes
+  the dynamics each member trains under;
+- the ``population.exploit`` / ``population.member_sync`` chaos seams are
+  registered and fire.
+
+Same caveat as the fused tests: every traced path needs its OWN collector
+instance (``lax.scan`` caches the body jaxpr on the body function object).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.ppo import make_update_impl
+from sheeprl_tpu.config import instantiate, load_config
+from sheeprl_tpu.core import compile as jax_compile
+from sheeprl_tpu.core import failpoints
+from sheeprl_tpu.core.runtime import build_runtime
+from sheeprl_tpu.envs import ingraph as ig
+from sheeprl_tpu.orchestrate import resow
+from sheeprl_tpu.utils.optim import with_clipping
+from sheeprl_tpu.utils.utils import PlayerParamsSync
+
+pytestmark = pytest.mark.ingraph
+
+N_ENVS = 16
+T = 8
+N_DATA = N_ENVS * T
+
+
+def _load_cfg(env_name: str, extra=()):
+    return load_config(
+        overrides=[
+            "exp=ppo",
+            f"env={env_name}",
+            f"env.num_envs={N_ENVS}",
+            f"algo.rollout_steps={T}",
+            f"algo.per_rank_batch_size={N_DATA // 2}",
+            "algo.update_epochs=2",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_keys.encoder=[]",
+            "seed=7",
+            *extra,
+        ]
+    )
+
+
+def _build_stack(cfg, runtime, name: str):
+    import gymnasium as gym
+
+    venv = ig.make_vector_env(cfg, N_ENVS, cfg.seed, device=runtime.device)
+    space = venv.single_action_space
+    is_continuous = isinstance(space, gym.spaces.Box)
+    actions_dim = tuple(space.shape) if is_continuous else (int(space.n),)
+    agent, params, player = build_agent(
+        runtime, actions_dim, is_continuous, cfg, venv.single_observation_space, None
+    )
+    player.params = jax.device_put(player.params, runtime.device)
+    venv.reset(seed=cfg.seed)
+    collector = ig.InGraphRolloutCollector(
+        venv, player, rollout_steps=T, gamma=float(cfg.algo.gamma), name=name
+    )
+    tx = with_clipping(instantiate(dict(cfg.algo.optimizer))(), cfg.algo.max_grad_norm)
+    opt_state = tx.init(params)
+    return venv, agent, params, player, collector, tx, opt_state
+
+
+def _extras(cfg):
+    return (jnp.float32(cfg.algo.clip_coef), jnp.float32(cfg.algo.ent_coef), jnp.float32(1.0))
+
+
+def _base_hypers(cfg):
+    return (float(cfg.algo.clip_coef), float(cfg.algo.ent_coef), 1.0)
+
+
+def _pop_update_impl(cfg, runtime, agent, tx):
+    # env-batch data sharding does not apply under the member vmap/shard_map,
+    # and each member batches over its OWN rollout (the mesh shards members,
+    # not data — batch_size must not scale with world_size)
+    return make_update_impl(
+        agent, tx, cfg, runtime, N_DATA, ["state"], [], None,
+        constrain_data=False, batch_size=int(cfg.algo.per_rank_batch_size),
+    )
+
+
+@pytest.mark.timeout(300)
+def test_population_of_one_matches_fused_bitwise():
+    """K iterations of a 1-member population == K FusedInGraphTrainer steps,
+    bit for bit: params, optimizer state, and the carried env chain."""
+    cfg = _load_cfg("jax_cartpole")
+    runtime = build_runtime(cfg.fabric)
+    extras = _extras(cfg)
+    K = 3
+
+    # single-member fused reference
+    venv_f, agent_f, params_f, player_f, coll_f, tx_f, opt_f = _build_stack(
+        cfg, runtime, "pop1_fusedref"
+    )
+    upd_f = make_update_impl(
+        agent_f, tx_f, cfg, runtime, N_DATA, ["state"], [], PlayerParamsSync(player_f.params)
+    )
+    trainer_f = ig.FusedInGraphTrainer(coll_f, upd_f, n_extras=3, name="pop1_fusedref")
+    for i in range(K):
+        key = jax.random.fold_in(jax.random.PRNGKey(99), i)
+        params_f, opt_f, _flat, _roll, _train = trainer_f.step(params_f, opt_f, key, *extras)
+
+    # population of one on a fresh identical world (same seed => same bits)
+    venv_p, agent_p, params_p, _player_p, coll_p, tx_p, opt_p = _build_stack(
+        cfg, runtime, "pop1_member"
+    )
+    pop = ig.PopulationTrainer(
+        coll_p, _pop_update_impl(cfg, runtime, agent_p, tx_p),
+        n_hypers=3, iters_per_epoch=K, name="pop1_member",
+    )
+    state = pop.init_population(params_p, opt_p, jax.random.PRNGKey(0), 1, _base_hypers(cfg))
+    # pin member 0's env chain to the fused venv's reset carry (init_population
+    # re-keys per member; bit-parity needs the identical starting streams)
+    state = state._replace(carry=ig.stack_member(venv_p.carry, 1))
+    iter_keys = jnp.stack(
+        [jax.random.fold_in(jax.random.PRNGKey(99), i)[None] for i in range(K)]
+    )
+    state, last_roll, _train_ms = pop.epoch_fn(state, None, iter_keys)
+
+    for pa, pb in zip(
+        jax.tree_util.tree_leaves(params_f), jax.tree_util.tree_leaves(state.params)
+    ):
+        np.testing.assert_array_equal(np.asarray(pa), np.asarray(pb)[0])
+    for oa, ob in zip(
+        jax.tree_util.tree_leaves(opt_f), jax.tree_util.tree_leaves(state.opt_state)
+    ):
+        if np.shape(ob)[:1] == (1,):
+            np.testing.assert_array_equal(np.asarray(oa), np.asarray(ob)[0])
+    np.testing.assert_array_equal(
+        np.asarray(venv_f.carry.obs), np.asarray(state.carry.obs)[0]
+    )
+    assert np.asarray(last_roll["dones"]).shape == (1, T, N_ENVS)
+
+    # exploit on a population of one is the identity plan: the sole member is
+    # its own top AND bottom quantile, never strictly fitter than itself
+    _state2, member_src, factor = pop.exploit(state, jax.random.PRNGKey(42))
+    assert np.asarray(member_src).tolist() == [0]
+    np.testing.assert_array_equal(np.asarray(factor), 1.0)
+    venv_f.close()
+    venv_p.close()
+
+
+def test_exploit_plan_matches_host_pbt_helpers():
+    """The jax-traced plan reproduces ``resow.bottom_quantile`` selection
+    (stable (fitness, index) ordering, ``max(int(n·q), 1)`` cut) and
+    ``resow.perturb`` factor semantics (multiplicative draw from ``factors``,
+    untouched keys stay at factor 1.0)."""
+    factors = (0.8, 1.25)
+    for n, q in ((8, 0.25), (5, 0.25), (4, 0.5), (3, 0.1)):
+        fit = np.linspace(10.0, 10.0 + n - 1, n)[::-1].copy()  # distinct, reversed
+        host = resow.bottom_quantile({f"m{i:02d}": float(fit[i]) for i in range(n)}, q)
+        host_idx = sorted(int(k[1:]) for k in host)
+        member_src, factor, swapped = ig.exploit_plan(
+            jnp.asarray(fit, jnp.float32), jax.random.PRNGKey(0),
+            quantile=q, n_hypers=3, factors=factors,
+        )
+        member_src, factor, swapped = map(np.asarray, (member_src, factor, swapped))
+        # with distinct fitness every bottom member finds a strictly-fitter
+        # parent, so the swapped set IS the host helper's bottom quantile
+        assert sorted(np.nonzero(swapped)[0].tolist()) == host_idx, (n, q)
+        # clone sources live in the top quantile and are strictly fitter
+        n_cut = max(int(n * q), 1)
+        top_idx = set(np.argsort(fit, kind="stable")[n - n_cut:].tolist())
+        for i in host_idx:
+            assert int(member_src[i]) in top_idx
+            assert fit[int(member_src[i])] > fit[i]
+        # perturb: swapped rows draw factors from the PBT set, others are 1.0
+        assert np.all(np.isin(factor[swapped], np.asarray(factors, np.float32)))
+        np.testing.assert_array_equal(factor[~swapped], 1.0)
+
+    # ties at the cut break by member index — bottom_quantile's (fitness, key)
+    fit = np.asarray([5.0, 1.0, 1.0, 9.0], np.float32)
+    host = resow.bottom_quantile({f"m{i:02d}": float(fit[i]) for i in range(4)}, 0.25)
+    assert host == ["m01"]
+    _src, _fac, swapped = ig.exploit_plan(
+        jnp.asarray(fit), jax.random.PRNGKey(1), quantile=0.25, n_hypers=1, factors=factors
+    )
+    assert np.nonzero(np.asarray(swapped))[0].tolist() == [1]
+
+    # perturb_mask pins masked hyper columns at factor 1.0 even when swapped
+    _src, factor, swapped = ig.exploit_plan(
+        jnp.asarray([0.0, 1.0, 2.0, 3.0], jnp.float32), jax.random.PRNGKey(2),
+        quantile=0.25, n_hypers=3, factors=factors, perturb_mask=(True, False, True),
+    )
+    factor = np.asarray(factor)
+    np.testing.assert_array_equal(factor[:, 1], 1.0)
+    assert np.all(np.isin(factor[np.asarray(swapped), 0], np.asarray(factors, np.float32)))
+
+
+@pytest.mark.timeout(300)
+def test_population_aot_warmup_zero_retrace():
+    """Epoch + exploit AOT-compile from ``stacked_specs`` built off ONE
+    member's live values — before the population exists — then run two
+    epoch/exploit rounds live with zero retraces."""
+    cfg = _load_cfg("jax_cartpole")
+    runtime = build_runtime(cfg.fabric)
+    venv, agent, params, _player, collector, tx, opt_state = _build_stack(
+        cfg, runtime, "pop_warm"
+    )
+    pop = ig.PopulationTrainer(
+        collector, _pop_update_impl(cfg, runtime, agent, tx),
+        n_hypers=3, iters_per_epoch=2, name="pop_warm",
+    )
+    n = 4
+    base = _base_hypers(cfg)
+    ranges = ig.resolve_ranges(venv.env_params, cfg.env.id)
+    overrides = ig.sample_overrides(jax.random.PRNGKey(3), n, ranges)
+
+    warmup = jax_compile.AOTWarmup(enabled=True)
+    warmup.add(pop.epoch_fn, *pop.stacked_warmup_specs(params, opt_state, base, n, overrides))
+    warmup.add(pop.exploit_fn, *pop.stacked_exploit_specs(params, opt_state, base, n))
+    warmup.start()
+    state = pop.init_population(params, opt_state, jax.random.PRNGKey(1), n, base, overrides)
+    assert warmup.wait(240), "population AOT warmup did not finish"
+
+    for e in range(2):
+        state, roll, _tms = pop.run_epoch(state, overrides, jax.random.fold_in(jax.random.PRNGKey(7), e))
+        state, member_src, _factor = pop.exploit(state, jax.random.fold_in(jax.random.PRNGKey(8), e))
+    assert pop.epoch_fn.retraces == 0, "population epoch retraced after AOT warmup"
+    assert pop.exploit_fn.retraces == 0, "population exploit retraced after AOT warmup"
+    assert np.asarray(roll["dones"]).shape == (n, T, N_ENVS)
+    assert np.asarray(member_src).shape == (n,)
+    assert np.all(np.isfinite(np.asarray(state.fitness)))
+    venv.close()
+
+
+@pytest.mark.timeout(300)
+def test_population_sharded_eight_device_mesh():
+    """The shard_map variant: 8 members across an 8-device mesh (member axis
+    on ``data``, one member's full train loop per device), domain-randomized
+    physics, in-graph exploit on the global sharded arrays — zero retraces."""
+    if len(jax.local_devices()) < 8:
+        pytest.skip("needs >= 8 local devices (conftest forces 8 on CPU)")
+    cfg = _load_cfg("jax_cartpole", extra=["fabric.devices=8"])
+    runtime = build_runtime(cfg.fabric)
+    assert runtime.world_size == 8
+    venv, agent, params, _player, collector, tx, opt_state = _build_stack(
+        cfg, runtime, "pop_mesh"
+    )
+    pop = ig.PopulationTrainer(
+        collector, _pop_update_impl(cfg, runtime, agent, tx),
+        n_hypers=3, iters_per_epoch=2, mesh=runtime.mesh, name="pop_mesh",
+    )
+    n = 8
+    base = _base_hypers(cfg)
+    overrides = pop.commit_env_overrides(
+        ig.sample_overrides(
+            jax.random.PRNGKey(5), n, ig.resolve_ranges(venv.env_params, cfg.env.id)
+        )
+    )
+    state = pop.init_population(params, opt_state, jax.random.PRNGKey(1), n, base, overrides)
+    for e in range(2):
+        state, roll, _tms = pop.run_epoch(state, overrides, jax.random.fold_in(jax.random.PRNGKey(7), e))
+        state, member_src, _factor = pop.exploit(state, jax.random.fold_in(jax.random.PRNGKey(8), e))
+    assert pop.epoch_fn.retraces == 0, "sharded population epoch retraced"
+    assert pop.exploit_fn.retraces == 0, "sharded population exploit retraced"
+    assert np.asarray(roll["dones"]).shape == (n, T, N_ENVS)
+    assert np.asarray(state.fitness).shape == (n,)
+    assert np.all(np.isfinite(np.asarray(state.fitness)))
+    assert all(
+        np.all(np.isfinite(np.asarray(x))) for x in jax.tree_util.tree_leaves(state.params)
+    )
+    venv.close()
+
+
+def test_domain_rand_ranges_and_dynamics_divergence():
+    """Default ranges resolve against the real EnvParams fields, bad configs
+    are rejected up front, and a physics override genuinely changes the traced
+    dynamics (same state + action, different gravity => different next state)."""
+    env, params = ig.make("CartPole-v1")
+    ranges = ig.resolve_ranges(params, "CartPole-v1")
+    assert set(ranges) == {"gravity", "masscart", "masspole", "length"}
+    overrides = ig.sample_overrides(jax.random.PRNGKey(0), 6, ranges)
+    for name, (lo, hi) in ranges.items():
+        vals = np.asarray(overrides[name])
+        assert vals.shape == (6,)
+        assert np.all((vals >= lo) & (vals <= hi))
+    # per-member draws actually differ
+    assert len(np.unique(np.asarray(overrides["gravity"]))) > 1
+
+    with pytest.raises(ValueError, match="not a dynamics field"):
+        ig.resolve_ranges(params, None, {"warp_factor": (1.0, 2.0)})
+    with pytest.raises(ValueError, match="not a dynamics field"):
+        ig.resolve_ranges(params, None, {"max_episode_steps": (100, 200)})
+    with pytest.raises(ValueError, match="bad range"):
+        ig.resolve_ranges(params, None, {"gravity": (11.0, 8.0)})
+    assert ig.sample_overrides(jax.random.PRNGKey(0), 4, {}) is None
+
+    state, _obs = env.reset(jax.random.PRNGKey(9), params)
+    action = jnp.int32(1)
+    step = lambda p: env.step(jax.random.PRNGKey(10), state, action, p)
+    s_lo, *_ = step(params.replace(gravity=8.0))
+    s_hi, *_ = step(params.replace(gravity=11.5))
+    assert not np.array_equal(np.asarray(s_lo.y), np.asarray(s_hi.y))
+
+
+@pytest.mark.faults
+def test_population_failpoints_registered_and_fire():
+    """Both population chaos seams are in the static registry and fire."""
+    for name in ("population.exploit", "population.member_sync"):
+        assert name in failpoints.KNOWN_FAILPOINTS
+        assert failpoints.KNOWN_FAILPOINTS[name]["plane"] == "orchestrate"
+    with failpoints.active("population.exploit:raise:chaos-pop"):
+        with pytest.raises(failpoints.FailpointError, match="chaos-pop"):
+            failpoints.failpoint("population.exploit", epoch=0)
+    with failpoints.active("population.member_sync:fire"):
+        assert failpoints.failpoint("population.member_sync", member=1) is True
+    assert failpoints.failpoint("population.member_sync", member=1) is not True
