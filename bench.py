@@ -1527,6 +1527,167 @@ def bench_fsdp(iters: int = 5, timeout_s: float = 600.0) -> dict:
         return {"fsdp_error": f"child rc={proc.returncode}: " + " | ".join(tail)}
 
 
+def _ckpt_child_main(reps: int = 3) -> dict:
+    """Subprocess body of bench_checkpoint, pinned to the 8-device CPU mesh.
+
+    Four timings on the SAME ~48 MiB mesh-sharded state:
+
+    1. ``checkpoint_legacy_blocked_ms`` — the synchronous single-file
+       ``save_state`` (the caller eats serialize + fsync);
+    2. ``checkpoint_blocked_save_ms`` — the async sharded path's train-thread
+       block (D2H snapshot only; serialize/fsync/commit ride the writer
+       thread). The acceptance gate: strictly below legacy;
+    3. ``checkpoint_commit_visible_ms`` — save() call to committed-and-
+       discoverable (the window a preemption loses);
+    4. ``checkpoint_elastic_restore_s`` / ``checkpoint_peer_restore_s`` —
+       8-device save restored onto a 2-device mesh, and the peer-RAM fetch
+       (control-plane chunk stream, zero storage reads) of the same payload.
+    """
+    import pickle
+    import tempfile
+    import threading
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    import sheeprl_tpu.utils.ckpt_sharded as cs
+    from sheeprl_tpu.utils.checkpoint import save_state
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("d",))
+    rng = np.random.default_rng(0)
+    state = {
+        "params": {
+            f"layer{i}": jax.device_put(
+                rng.standard_normal((1024, 1536)).astype(np.float32),
+                NamedSharding(mesh, PartitionSpec("d")),
+            )
+            for i in range(8)
+        },
+        "step": 1,
+    }
+    jax.block_until_ready(state["params"])
+    state_bytes = sum(leaf.nbytes for leaf in state["params"].values())
+
+    def median(xs):
+        xs = sorted(xs)
+        return xs[len(xs) // 2]
+
+    out: dict = {"checkpoint_state_mb": round(state_bytes / 1e6, 1), "checkpoint_reps": reps}
+    with tempfile.TemporaryDirectory() as td:
+        legacy_ms = []
+        for r in range(reps):
+            t0 = time.perf_counter()
+            save_state(os.path.join(td, f"legacy_{r}.ckpt"), state)
+            legacy_ms.append((time.perf_counter() - t0) * 1e3)
+
+        blocked_ms, visible_ms = [], []
+        ck = cs.ShardedCheckpointer(process_index=0, world=1)
+        try:
+            last_path = None
+            for r in range(reps):
+                last_path = os.path.join(td, f"sharded_{r}.ckpt")
+                t0 = time.perf_counter()
+                pending = ck.save(last_path, state)
+                blocked_ms.append(pending.blocked_s * 1e3)
+                pending.wait(120.0)
+                visible_ms.append((time.perf_counter() - t0) * 1e3)
+                assert cs.is_committed(last_path)
+        finally:
+            ck.close()
+
+        mesh_b = Mesh(np.array(devices[:2]), ("d",))
+        t0 = time.perf_counter()
+        restored = cs.elastic_restore(
+            last_path,
+            lambda key, shape, dtype: NamedSharding(mesh_b, PartitionSpec("d"))
+            if key.startswith("/params/")
+            else None,
+        )
+        jax.block_until_ready(restored["params"])
+        out["checkpoint_elastic_restore_s"] = round(time.perf_counter() - t0, 3)
+
+        # peer-RAM emergency path: two in-process control planes, real sockets
+        from sheeprl_tpu.parallel.control import ControlPlane, KVServer, SocketKV
+
+        payload = pickle.dumps(jax.device_get(state), protocol=pickle.HIGHEST_PROTOCOL)
+        server = KVServer()
+        server.start()
+        try:
+            p0 = ControlPlane(SocketKV(server.address), rank=0, world=2, scope="ckptbench", timeout_ms=60_000)
+            p1 = ControlPlane(SocketKV(server.address), rank=1, world=2, scope="ckptbench", timeout_ms=60_000)
+            p0.begin_session("ckpt_replicator")
+            store = cs.PeerReplicaStore(p1, src_rank=0, poll_ms=20, fence_role="ckpt_replicator")
+            store.start()
+            push = threading.Thread(
+                target=cs.replicate_to_peer, args=(p0, payload, 1), kwargs={"timeout_ms": 60_000}
+            )
+            push.start()
+            push.join()
+            # the restarted incarnation of rank 0 fetches its own snapshot back
+            p0b = ControlPlane(SocketKV(server.address), rank=0, world=2, scope="ckptbench", timeout_ms=60_000)
+            t0 = time.perf_counter()
+            fetched = cs.fetch_from_peer(p0b, timeout_ms=60_000)
+            assert fetched is not None and fetched[0] == 1
+            pickle.loads(fetched[1])
+            out["checkpoint_peer_restore_s"] = round(time.perf_counter() - t0, 3)
+            store.stop()
+            store.join(timeout=5.0)
+        finally:
+            server.stop()
+
+    out["checkpoint_legacy_blocked_ms"] = round(median(legacy_ms), 3)
+    out["checkpoint_blocked_save_ms"] = round(median(blocked_ms), 3)
+    out["checkpoint_commit_visible_ms"] = round(median(visible_ms), 3)
+    out["checkpoint_blocked_reduction_x"] = round(
+        median(legacy_ms) / max(median(blocked_ms), 1e-6), 2
+    )
+    # acceptance gate: the async sharded path must block the train thread
+    # STRICTLY less than the legacy synchronous save it replaces
+    out["checkpoint_gate_pass"] = bool(median(blocked_ms) < median(legacy_ms))
+    return out
+
+
+def bench_checkpoint(reps: int = 3, timeout_s: float = 600.0) -> dict:
+    """Sharded-checkpoint subsystem drill (elastic-checkpointing issue).
+
+    Runs in a SUBPROCESS pinned to an 8-device virtual CPU mesh (the
+    device-count flag only takes effect before jax initializes). Headline:
+    ``checkpoint_blocked_save_ms`` (sentinel class ``blocked_save``, direction
+    *lower*) — the milliseconds the training thread stalls per checkpoint,
+    which the async writer reduces to the D2H snapshot alone."""
+    import os
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as td:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        xla = env.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in xla:
+            env["XLA_FLAGS"] = (xla + " --xla_force_host_platform_device_count=8").strip()
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env["TMPDIR"] = td
+        env["_SHEEPRL_BENCH_CKPT_CHILD"] = str(int(reps))
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join(repo, "bench.py")],
+                env=env, capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            return {"checkpoint_error": f"child exceeded {timeout_s}s"}
+        for line in proc.stdout.splitlines():
+            if line.startswith("CKPT_BENCH "):
+                try:
+                    return json.loads(line[len("CKPT_BENCH "):])
+                except ValueError:
+                    break
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        return {"checkpoint_error": f"child rc={proc.returncode}: " + " | ".join(tail)}
+
+
 def bench_population(
     members: int = 8,
     envs_per_member: int = 8,
@@ -1735,6 +1896,7 @@ def _target_metric(target: str) -> str:
         "telemetry": "telemetry_tracer_overhead_pct",
         "rssm": "rssm_fused_bytes_per_step",
         "fsdp": "fsdp_handoff_bytes_per_iter",
+        "checkpoint": "checkpoint_blocked_save_ms",
         "population": "population_agg_env_steps_per_sec",
         "smoke": "ppo_smoke_env_steps_per_sec",
         "all": "ppo_cartpole_env_steps_per_sec",  # PPO stays the headline value
@@ -1758,6 +1920,7 @@ _METRIC_UNITS = {
     "telemetry_tracer_overhead_pct": "%",
     "rssm_fused_bytes_per_step": "bytes/step",
     "fsdp_handoff_bytes_per_iter": "bytes/iter",
+    "checkpoint_blocked_save_ms": "ms",
     "population_agg_env_steps_per_sec": "env-steps/s",
     "ppo_smoke_env_steps_per_sec": "env-steps/s",
 }
@@ -1790,6 +1953,9 @@ _SENTINEL_CLASSES = (
     # per-shard handoff bytes are pure payload-shape arithmetic — growth means
     # a leaf fell off the sharded path back onto the replicated one
     ("handoff_bytes", "lower", 0.02),
+    # train-thread checkpoint stall: a D2H memcpy on a shared CPU host is
+    # noisy, but growth past the floor means work leaked back onto the caller
+    ("blocked_save", "lower", 0.50),
     # fused-population wall-clock advantage over the subprocess fleet: both
     # sides run on a shared CPU host, so the floor is loose — but the >=2x
     # acceptance gate means even a 25% slip is worth flagging
@@ -1988,6 +2154,12 @@ if __name__ == "__main__":
         print("FSDP_BENCH " + json.dumps(_fsdp_child_main(int(os.environ["_SHEEPRL_BENCH_FSDP_CHILD"]))))
         sys.exit(0)
 
+    if os.environ.get("_SHEEPRL_BENCH_CKPT_CHILD"):
+        # subprocess body of bench_checkpoint: the parent pinned the CPU
+        # backend and the 8-device virtual mesh before spawning us
+        print("CKPT_BENCH " + json.dumps(_ckpt_child_main(int(os.environ["_SHEEPRL_BENCH_CKPT_CHILD"]))))
+        sys.exit(0)
+
     parser = argparse.ArgumentParser(description="sheeprl-tpu bench harness (one JSON line on stdout)")
     parser.add_argument(
         "--target",
@@ -2005,6 +2177,7 @@ if __name__ == "__main__":
             "telemetry",
             "rssm",
             "fsdp",
+            "checkpoint",
             "population",
             "all",
         ),
@@ -2232,6 +2405,17 @@ if __name__ == "__main__":
                 result.setdefault("value", fs.get("fsdp_handoff_bytes_per_iter"))
                 result.setdefault("unit", "bytes/iter")
                 result.setdefault("vs_baseline", fs.get("fsdp_handoff_reduction_x"))
+            if cli_args.target == "checkpoint":
+                # opt-in only: sharded-checkpoint drill on the 8-device
+                # virtual mesh (subprocess child) — train-thread blocked ms
+                # (async vs legacy), commit-to-visible latency, elastic
+                # 8->2-device restore wall, and the peer-RAM fetch wall
+                ckb = bench_checkpoint()
+                result.update(ckb)
+                result.setdefault("metric", headline_metric)
+                result.setdefault("value", ckb.get("checkpoint_blocked_save_ms"))
+                result.setdefault("unit", "ms")
+                result.setdefault("vs_baseline", ckb.get("checkpoint_blocked_reduction_x"))
             if cli_args.target == "population":
                 # opt-in only: the device-resident vmapped PBT population
                 # (one compiled program, one trainee process) vs the classic

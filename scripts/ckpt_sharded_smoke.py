@@ -1,0 +1,381 @@
+#!/usr/bin/env python
+"""Sharded-checkpoint smoke: two-host elastic checkpointing + recovery drill.
+
+Proves the sharded checkpoint subsystem (sheeprl_tpu/utils/ckpt_sharded.py)
+keeps its durability contract while the failpoint registry
+(core/failpoints.py) kills hosts at the nastiest instants:
+
+1. a parent process runs a :class:`KVServer` and spawns two jax-free "host"
+   children (ranks 0/1 of a world-2 fleet) that each write ONLY their own
+   shard windows into a shared ``*.ckpt`` directory, rendezvous through the
+   control plane, and two-phase-commit the generation;
+2. **healthy generation**: both hosts save; the parent audits the committed
+   layout, loads the FULL state through the ordinary ``load_state`` dispatch
+   (a world-1 reader — the topology-elastic restore), and certifies it;
+3. **host killed between shard write and commit** (``ckpt.commit:kill``): the
+   commit marker never appears, ``latest_certified`` still points at the
+   previous generation, and ``load_state`` on the torn generation falls back
+   to it — the fleet resumes from the previous certified checkpoint;
+4. **zombie commit fence**: a commit attempt stamped with the dead
+   incarnation's epoch raises ``StaleEpochError`` before the marker rename;
+5. **host killed mid shard write** (``ckpt.shard_write:kill``): the surviving
+   rank's commit barrier times out, so no partial-shard generation can ever
+   become visible;
+6. **recovery + GC**: the restarted fleet commits a new generation and the
+   orphan sweep removes the two abandoned uncommitted directories;
+7. **peer-RAM emergency recovery**: host 0 replicates its state into host 1's
+   RAM over the epoch-fenced chunk transport (``ckpt.replicate`` failpoint
+   kills it mid-epoch on the third push); a restarted host 0 restores from
+   the peer copy with ZERO persistent-storage reads (``READ_OPENS == 0``)
+   and bit-identical state.
+
+Run directly (``python scripts/ckpt_sharded_smoke.py``) or through the
+registered tier-1 test (tests/test_utils/test_ckpt_sharded_smoke.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from sheeprl_tpu.core import failpoints  # noqa: E402
+from sheeprl_tpu.parallel.control import (  # noqa: E402
+    ControlPlane,
+    ControlPlaneTimeoutError,
+    SocketKV,
+    StaleEpochError,
+)
+from sheeprl_tpu.utils import ckpt_sharded as cs  # noqa: E402
+
+SCOPE = "ckpt_smoke"
+WORLD = 2
+FENCE_ROLE = "ckpt_writer"
+REP_ROLE = "host0_replicator"
+
+
+def _drill_state(gen: int) -> dict:
+    """Deterministic world-2 state: axis-0-splittable array leaves (rows 0-3
+    belong to rank 0, rows 4-7 to rank 1) plus inline scalar leaves."""
+    return {
+        "params": {
+            "w": (np.arange(64, dtype=np.float64).reshape(8, 8) + gen),
+            "b": (np.arange(8, dtype=np.float32) * gen),
+        },
+        "odd": np.arange(7, dtype=np.int64) + gen,  # indivisible: rank 0 owns it whole
+        "step": int(gen),
+    }
+
+
+def _state_equal(a: dict, b: dict) -> bool:
+    return (
+        np.array_equal(a["params"]["w"], b["params"]["w"])
+        and np.array_equal(a["params"]["b"], b["params"]["b"])
+        and np.array_equal(a["odd"], b["odd"])
+        and a["step"] == b["step"]
+    )
+
+
+def _gen_path(ckpt_dir: str, gen: int) -> str:
+    return os.path.join(ckpt_dir, f"ckpt_{gen}_0.ckpt")
+
+
+# --------------------------------------------------------------------------- children
+def run_host(addr: str, rank: int, ckpt_dir: str, gens: list, barrier_ms: int) -> None:
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=rank, world=WORLD, scope=SCOPE, timeout_ms=30_000)
+    epoch = plane.begin_session(FENCE_ROLE) if rank == 0 else plane.adopt_epoch(FENCE_ROLE)
+    saved, failures = [], []
+    for gen in gens:
+        path = _gen_path(ckpt_dir, gen)
+        try:
+            cs.save_sharded(
+                path,
+                _drill_state(gen),
+                process_index=rank,
+                world=WORLD,
+                plane=plane,
+                epoch=epoch,
+                fence_role=FENCE_ROLE,
+                barrier_timeout_ms=barrier_ms,
+            )
+            saved.append(gen)
+        except (ControlPlaneTimeoutError, StaleEpochError) as e:
+            # a dead/fenced peer: the generation must stay uncommitted, the
+            # host reports the failure and carries on (the fleet supervisor's
+            # reaction, not the drill's concern here)
+            failures.append({"gen": gen, "err": type(e).__name__})
+    print(json.dumps({"role": "host", "rank": rank, "epoch": epoch, "saved": saved, "failures": failures}))
+
+
+def run_peer(addr: str) -> None:
+    """Host 1's replica store: keeps host 0's latest pushed state in RAM and
+    answers its restarted incarnation's fetch — no storage anywhere."""
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=1, world=WORLD, scope=SCOPE, timeout_ms=30_000)
+    store = cs.PeerReplicaStore(plane, src_rank=0, poll_ms=100, fence_role=REP_ROLE)
+    store.start()
+    stop_key = plane._key("drill", "peer_stop")
+    while kv.try_get(stop_key, timeout_ms=100) is None:
+        time.sleep(0.05)
+    store.stop()
+    store.join(timeout=5.0)
+    held = store.snapshots_held
+    latest_gen = store.latest[0] if store.latest is not None else None
+    print(json.dumps({"role": "peer", "snapshots_held": held, "latest_gen": latest_gen}))
+
+
+def run_worker_push(addr: str, pushes: int) -> None:
+    """Host 0 pushing state snapshots to its peer; the ``ckpt.replicate``
+    failpoint SIGKILLs it mid-epoch on the final attempt."""
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=0, world=WORLD, scope=SCOPE, timeout_ms=30_000)
+    plane.begin_session(REP_ROLE)
+    for gen in range(1, pushes + 1):
+        payload = pickle.dumps(_drill_state(gen), protocol=pickle.HIGHEST_PROTOCOL)
+        cs.replicate_to_peer(plane, payload, generation=gen, timeout_ms=30_000)
+    print(json.dumps({"role": "worker_push", "pushes": pushes}))
+
+
+def run_worker_restore(addr: str) -> None:
+    """Host 0's restarted incarnation: restore from peer RAM, prove zero
+    persistent-storage reads happened on the way."""
+    kv = SocketKV(addr)
+    plane = ControlPlane(kv, rank=0, world=WORLD, scope=SCOPE, timeout_ms=30_000)
+    got = cs.fetch_from_peer(plane, timeout_ms=30_000)
+    if got is None:
+        print(json.dumps({"role": "worker_restore", "ok": False, "err": "no peer answer"}))
+        return
+    gen, payload = got
+    state = pickle.loads(payload)
+    print(
+        json.dumps(
+            {
+                "role": "worker_restore",
+                "ok": bool(_state_equal(state, _drill_state(gen))),
+                "gen": gen,
+                "read_opens": cs.READ_OPENS,  # sharded-load file opens in THIS process
+                "payload_bytes": len(payload),
+            }
+        )
+    )
+
+
+# --------------------------------------------------------------------------- parent
+def _spawn(args: list, failpoints_spec: str = "") -> subprocess.Popen:
+    env = dict(os.environ)
+    env.pop("SHEEPRL_TPU_FAILPOINTS", None)
+    if failpoints_spec:
+        env["SHEEPRL_TPU_FAILPOINTS"] = failpoints_spec
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__)] + args,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+
+
+def _result(proc: subprocess.Popen, label: str, timeout: float) -> dict:
+    try:
+        out, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        raise SystemExit(f"{label} hung; stdout:\n{out[-2000:]}\nstderr:\n{err[-2000:]}")
+    if proc.returncode != 0:
+        raise SystemExit(f"{label} exited rc={proc.returncode}; stderr tail:\n{err[-2000:]}")
+    last = out.strip().splitlines()[-1] if out.strip() else ""
+    try:
+        return json.loads(last)
+    except ValueError:
+        raise SystemExit(f"{label} printed no JSON result; stdout tail:\n{out[-2000:]}")
+
+
+def _expect_kill(proc: subprocess.Popen, label: str, timeout: float) -> None:
+    out, err = proc.communicate(timeout=timeout)
+    if proc.returncode != 9:
+        raise SystemExit(
+            f"{label} should die by its kill failpoint (rc 9), got rc={proc.returncode}; "
+            f"stderr tail:\n{err[-2000:]}\nstdout:\n{out[-500:]}"
+        )
+
+
+def _run_fleet_save(addr: str, ckpt_dir: str, gen: int, timeout: float, *, fp0: str = "", fp1: str = "",
+                    barrier_ms: int = 30_000, expect_kill_rank=None) -> dict:
+    hosts = [
+        _spawn(
+            ["--role", "host", "--addr", addr, "--rank", str(r), "--dir", ckpt_dir,
+             "--gens", str(gen), "--barrier-ms", str(barrier_ms)],
+            fp0 if r == 0 else fp1,
+        )
+        for r in range(WORLD)
+    ]
+    results = {}
+    for r, proc in enumerate(hosts):
+        if r == expect_kill_rank:
+            _expect_kill(proc, f"gen-{gen} host {r}", timeout)
+        else:
+            results[r] = _result(proc, f"gen-{gen} host {r}", timeout)
+    return results
+
+
+def main(timeout: float = 300.0) -> dict:
+    from sheeprl_tpu.parallel.control import KVServer
+    from sheeprl_tpu.utils import checkpoint as ck  # jax import stays in the parent
+
+    started = time.monotonic()
+    server = KVServer()
+    server.start()
+    kv = SocketKV(server.address)
+    plane = ControlPlane(kv, rank=99, world=WORLD, scope=SCOPE)  # parent's key helper only
+    ckpt_dir = tempfile.mkdtemp(prefix="ckpt_sharded_smoke_")
+    try:
+        # ---- phase 1: healthy generation ------------------------------------
+        _run_fleet_save(server.address, ckpt_dir, 100, timeout)
+        g100 = _gen_path(ckpt_dir, 100)
+        if not cs.is_committed(g100):
+            raise SystemExit("phase 1: committed generation has no COMMIT marker")
+        shard_files = sorted(n for n in os.listdir(g100) if n.startswith("shard_"))
+        if shard_files != ["shard_00000.bin", "shard_00001.bin"]:
+            raise SystemExit(f"phase 1: expected one shard per host, got {shard_files}")
+        # topology-elastic read: this world-1 parent assembles the full state
+        stats: dict = {}
+        state = cs.load_sharded(g100, stats)
+        if not _state_equal(state, _drill_state(100)):
+            raise SystemExit("phase 1: world-1 restore of the world-2 checkpoint is not bit-identical")
+        ck.certify(g100, policy_step=100)
+        if ck.latest_certified(ckpt_dir) != g100:
+            raise SystemExit("phase 1: certified generation not visible to latest_certified")
+
+        # ---- phase 2: host 0 killed between shard write and commit ----------
+        _run_fleet_save(
+            server.address, ckpt_dir, 200, timeout,
+            fp0=failpoints.spec_entry("ckpt.commit", "kill", "9", "hit=1"),
+            expect_kill_rank=0,
+        )
+        g200 = _gen_path(ckpt_dir, 200)
+        if cs.is_committed(g200):
+            raise SystemExit("phase 2: generation committed despite the pre-commit kill")
+        if ck.latest_certified(ckpt_dir) != g100:
+            raise SystemExit("phase 2: latest_certified moved off the previous generation")
+        resumed = ck.load_state(g200)  # must fall back to the previous certified sibling
+        if resumed["step"] != 100:
+            raise SystemExit(f"phase 2: resume landed on step {resumed['step']}, want 100")
+
+        # ---- phase 3: zombie commit fence -----------------------------------
+        dead_epoch = 1  # phase 1's incarnation; phase 2's restart bumped past it
+        fenced = False
+        try:
+            cs.commit(g200, {0: {"file": "shard_00000.bin"}}, plane=plane, epoch=dead_epoch,
+                      fence_role=FENCE_ROLE)
+        except StaleEpochError:
+            fenced = True
+        if not fenced or cs.is_committed(g200):
+            raise SystemExit("phase 3: a dead incarnation's commit was not fenced")
+
+        # ---- phase 4: host 1 killed mid shard write -------------------------
+        results = _run_fleet_save(
+            server.address, ckpt_dir, 250, timeout,
+            fp1=failpoints.spec_entry("ckpt.shard_write", "kill", "9", "hit=1"),
+            barrier_ms=4_000,
+            expect_kill_rank=1,
+        )
+        if results[0]["failures"] != [{"gen": 250, "err": "ControlPlaneTimeoutError"}]:
+            raise SystemExit(f"phase 4: surviving host should time out its commit barrier, got {results[0]}")
+        if cs.is_committed(_gen_path(ckpt_dir, 250)):
+            raise SystemExit("phase 4: partial-shard generation became visible")
+
+        # ---- phase 5: recovery + orphan GC ----------------------------------
+        _run_fleet_save(server.address, ckpt_dir, 300, timeout)
+        g300 = _gen_path(ckpt_dir, 300)
+        ck.certify(g300, policy_step=300)
+        if ck.latest_certified(ckpt_dir) != g300:
+            raise SystemExit("phase 5: recovered fleet's generation not the newest certified")
+        swept = sorted(os.path.basename(p) for p in cs.sweep_orphaned(ckpt_dir))
+        if swept != ["ckpt_200_0.ckpt", "ckpt_250_0.ckpt"]:
+            raise SystemExit(f"phase 5: orphan sweep removed {swept}, want the two abandoned generations")
+        left = sorted(n for n in os.listdir(ckpt_dir) if n.endswith(".ckpt"))
+        if left != ["ckpt_100_0.ckpt", "ckpt_300_0.ckpt"]:
+            raise SystemExit(f"phase 5: surviving generations wrong: {left}")
+
+        # ---- phase 6: peer-RAM emergency recovery ---------------------------
+        peer = _spawn(["--role", "peer", "--addr", server.address])
+        pusher = _spawn(
+            ["--role", "worker-push", "--addr", server.address, "--pushes", "3"],
+            # dies mid-epoch on its third replication push — after the peer
+            # already holds generation 2 in RAM
+            failpoints.spec_entry("ckpt.replicate", "kill", "9", "hit=3"),
+        )
+        _expect_kill(pusher, "phase 6 pusher", timeout)
+        restorer = _spawn(["--role", "worker-restore", "--addr", server.address])
+        restored = _result(restorer, "phase 6 restorer", timeout)
+        kv.set(plane._key("drill", "peer_stop"), "1")
+        peer_res = _result(peer, "phase 6 peer", timeout)
+        if not restored.get("ok") or restored.get("gen") != 2:
+            raise SystemExit(f"phase 6: peer-RAM restore wrong: {restored}")
+        if restored.get("read_opens") != 0:
+            raise SystemExit(
+                f"phase 6: peer-RAM restore touched persistent storage "
+                f"({restored['read_opens']} read opens, want 0)"
+            )
+        if peer_res.get("snapshots_held", 0) < 2 or peer_res.get("latest_gen") != 2:
+            raise SystemExit(f"phase 6: peer store state wrong: {peer_res}")
+    finally:
+        server.stop()
+
+    return {
+        "generations_committed": [100, 300],
+        "generations_discarded": [200, 250],
+        "zombie_commit_fenced": True,
+        "partial_reads_bytes": stats.get("bytes_read", 0),
+        "peer_restore_gen": restored["gen"],
+        "peer_restore_read_opens": restored["read_opens"],
+        "wall_s": round(time.monotonic() - started, 2),
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--role",
+        choices=["parent", "host", "peer", "worker-push", "worker-restore"],
+        default="parent",
+    )
+    parser.add_argument("--addr", default=None, help="KV server address (child roles)")
+    parser.add_argument("--rank", type=int, default=0, help="host: fleet rank")
+    parser.add_argument("--dir", default=None, help="host: shared checkpoint dir")
+    parser.add_argument("--gens", default="", help="host: comma-separated generation steps to save")
+    parser.add_argument("--barrier-ms", type=int, default=30_000, help="host: commit barrier budget")
+    parser.add_argument("--pushes", type=int, default=3, help="worker-push: replication attempts")
+    parser.add_argument("--timeout", type=float, default=300.0, help="parent: per-child budget in seconds")
+    cli = parser.parse_args()
+    if cli.role == "host":
+        run_host(cli.addr, cli.rank, cli.dir, [int(g) for g in cli.gens.split(",") if g], cli.barrier_ms)
+    elif cli.role == "peer":
+        run_peer(cli.addr)
+    elif cli.role == "worker-push":
+        run_worker_push(cli.addr, cli.pushes)
+    elif cli.role == "worker-restore":
+        run_worker_restore(cli.addr)
+    else:
+        result = main(cli.timeout)
+        print(
+            "ckpt sharded smoke OK: "
+            f"generations {result['generations_committed']} committed, "
+            f"{result['generations_discarded']} discarded (pre-commit kills + zombie fence), "
+            f"peer-RAM restore of gen {result['peer_restore_gen']} with "
+            f"{result['peer_restore_read_opens']} storage reads "
+            f"({result['wall_s']}s)"
+        )

@@ -70,7 +70,8 @@ def resume_from_checkpoint(cfg: dotdict) -> dotdict:
     if cfg.checkpoint.resume_from is None:
         return cfg
     ckpt_path = os.path.abspath(cfg.checkpoint.resume_from)
-    if not os.path.isfile(ckpt_path):
+    # sharded checkpoints are *.ckpt DIRECTORIES (utils/ckpt_sharded.py)
+    if not (os.path.isfile(ckpt_path) or os.path.isdir(ckpt_path)):
         raise ValueError(f"The checkpoint to resume from does not exist: {ckpt_path}")
     old_cfg_path = os.path.join(os.path.dirname(ckpt_path), os.pardir, "config.yaml")
     if not os.path.isfile(old_cfg_path):
@@ -253,14 +254,32 @@ def run_algorithm(cfg: dotdict) -> None:
             if k not in models_keys:
                 cfg.model_manager.models.pop(k, None)
 
-    callbacks = [CheckpointCallback(keep_last=cfg.checkpoint.keep_last)]
+    checkpointer = None
+    if cfg.checkpoint.get("sharded"):
+        # Async elastic sharded checkpointing: the training thread pays only
+        # the D2H snapshot; shard write/commit/certify/GC run on the writer
+        # thread (howto/fault_tolerance.md "Sharded checkpoints & emergency
+        # recovery"). Multihost drivers construct their own checkpointer with
+        # a control plane; the CLI path covers the single-process world.
+        from sheeprl_tpu.utils.ckpt_sharded import ShardedCheckpointer
+
+        checkpointer = ShardedCheckpointer(process_index=0, world=1)
+    callbacks = [CheckpointCallback(keep_last=cfg.checkpoint.keep_last, checkpointer=checkpointer)]
     runtime = build_runtime(cfg.fabric, extra_callbacks=[])
     runtime.callbacks = callbacks
     seed_everything(cfg.seed)
     _apply_global_flags(cfg)
     if runtime.is_global_zero:
         print_config(cfg)
-    command(runtime, cfg, **kwargs)
+    try:
+        command(runtime, cfg, **kwargs)
+    finally:
+        for cb in callbacks:
+            flush = getattr(cb, "flush", None)
+            if flush is not None:
+                flush()  # drain in-flight async shard writes before exit
+        if checkpointer is not None:
+            checkpointer.close()
 
 
 def eval_algorithm(cfg: dotdict) -> None:

@@ -97,6 +97,9 @@ KNOWN_FAILPOINTS: Dict[str, Dict[str, str]] = {
     "ckpt.pre_fsync": {"plane": "checkpoint", "doc": "crash before the manifest fsync (torn write)"},
     "ckpt.finalize": {"plane": "checkpoint", "doc": "crash between payload write and manifest rename"},
     "ckpt.load": {"plane": "checkpoint", "doc": "corrupt/failed restore on the resume path"},
+    "ckpt.shard_write": {"plane": "checkpoint", "doc": "host dies/tears its shard before the shard fsync"},
+    "ckpt.commit": {"plane": "checkpoint", "doc": "host dies between the commit barrier and the marker rename"},
+    "ckpt.replicate": {"plane": "checkpoint", "doc": "peer-RAM replication push dropped/failed"},
     "transport.kv_set": {"plane": "transport", "doc": "weight-push KV write fails"},
     "transport.kv_get": {"plane": "transport", "doc": "weight-pull KV read fails"},
     "transport.player_crash": {"plane": "transport", "doc": "player process dies mid-stream"},

@@ -53,7 +53,8 @@ from sheeprl_tpu.config import instantiate, load_config
 from sheeprl_tpu.envs import ingraph as ig
 from sheeprl_tpu.orchestrate import resolve
 from sheeprl_tpu.orchestrate.lineage import LineageLog
-from sheeprl_tpu.utils.checkpoint import certify, save_state
+from sheeprl_tpu.utils.checkpoint import certify
+from sheeprl_tpu.utils.ckpt_sharded import ShardedCheckpointer
 from sheeprl_tpu.utils.optim import with_clipping
 
 RESULT_TAG = "POPULATION_FUSED "
@@ -234,6 +235,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     status = "done"
     warmup.wait()
     jax_compile.mark_steady()
+    # async writer for the per-member certified slices (single-process world:
+    # commit needs no barrier; the win is moving pickle+fsync off the loop)
+    checkpointer = ShardedCheckpointer(process_index=0, world=1)
     t_train0 = time.perf_counter()
 
     with PreemptionGuard(enabled=True) as guard:
@@ -317,9 +321,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             )
 
             # ----- certified per-member checkpoint slices
+            # The async sharded writer keeps the epoch loop paying only the
+            # per-member D2H snapshot; serialization, fsync, commit, and
+            # certification all land on its background thread. Saves are
+            # strictly ordered, so the per-member drill semantics are intact.
             if (ep + 1) % max(int(pcfg.checkpoint_every), 1) == 0:
-                host_params = jax.device_get(state.params)
-                host_opt = jax.device_get(state.opt_state)
                 for i in range(members):
                     fired = failpoints.failpoint(
                         "population.member_sync", member=i, epoch=ep
@@ -333,18 +339,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                         continue
                     mdir = os.path.join(state_dir, "members", f"m{i:02d}")
                     path = os.path.join(mdir, f"ckpt_ep{ep:04d}.ckpt")
-                    meta = save_state(
-                        path,
-                        {
-                            "agent": jax.tree_util.tree_map(lambda x: x[i], host_params),
-                            "optimizer": jax.tree_util.tree_map(lambda x: x[i], host_opt),
-                            "hypers": [float(h[i]) for h in hypers_now],
-                            "fitness": float(fitness[i]),
-                            "epoch": ep,
-                            "member": i,
-                        },
-                    )
-                    certify(path, **meta, member=i, epoch=ep, policy_step=policy_step)
+                    member_state = {
+                        # device-side row slices: the checkpointer's snapshot
+                        # copies exactly one member's rows to host, not the
+                        # whole fleet's stacked params twice over
+                        "agent": jax.tree_util.tree_map(lambda x: x[i], state.params),
+                        "optimizer": jax.tree_util.tree_map(lambda x: x[i], state.opt_state),
+                        "hypers": [float(h[i]) for h in hypers_now],
+                        "fitness": float(fitness[i]),
+                        "epoch": ep,
+                        "member": i,
+                    }
+
+                    def _certify_member(
+                        p: str, _result: Dict[str, Any], _i: int = i, _ep: int = ep, _ps: int = policy_step
+                    ) -> None:
+                        certify(p, member=_i, epoch=_ep, policy_step=_ps)
+
+                    checkpointer.save(path, member_state, finalize=_certify_member)
 
             epochs_done = ep + 1
             if guard.should_stop:
@@ -354,6 +366,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 status = "timeout"
                 break
 
+    # drain in-flight member-slice writes: the controller reads the certified
+    # slices the moment this process reports, so they must be durable first
+    checkpointer.close()
     train_wall_s = time.perf_counter() - t_train0
     total_env_steps = epochs_done * env_steps_per_epoch
     summary = {

@@ -64,7 +64,7 @@ from sheeprl_tpu.serve.router import FailoverRouter
 from sheeprl_tpu.serve.stats import FleetStats
 from sheeprl_tpu.telemetry import registry as tel_registry
 from sheeprl_tpu.telemetry import trace
-from sheeprl_tpu.utils.checkpoint import certified_info, latest_certified
+from sheeprl_tpu.utils.checkpoint import artifact_bootable, certified_info, latest_certified
 
 _logger = logging.getLogger(__name__)
 
@@ -537,6 +537,23 @@ class FleetSupervisor:
         if info is None:
             return
         if (path, info.get("crc32")) == self._current_ident:
+            return
+        # Artifact-compat gate (sidecar format/topology stamp + shard-file
+        # presence): never start a rolling deploy onto an artifact the
+        # replicas can't boot — e.g. a sharded dir with a missing shard file
+        # or a format version from a newer build. Recorded, retried later.
+        ok, why = artifact_bootable(path, info)
+        if not ok:
+            self.stats.inc("deploy_rejected")
+            append_event(
+                self.events_dir,
+                "fleet_deploy_rejected",
+                int(info.get("policy_step") or 0),
+                path=path,
+                reason=why,
+            )
+            _logger.warning("[fleet] deploy of %s rejected: %s", path, why)
+            self._deploy_retry_at = now + self.deploy_retry_s
             return
         self._rolling_deploy(path, info)
 

@@ -31,7 +31,7 @@ from sheeprl_tpu.core.health import append_event
 from sheeprl_tpu.serve.engine import PolicyEngine, GenerationStore
 from sheeprl_tpu.serve.stats import ServeStats
 from sheeprl_tpu.telemetry import trace
-from sheeprl_tpu.utils.checkpoint import certified_info, latest_certified, load_state
+from sheeprl_tpu.utils.checkpoint import artifact_bootable, certified_info, latest_certified, load_state
 
 _logger = logging.getLogger(__name__)
 
@@ -91,6 +91,14 @@ class HotReloader(threading.Thread):
         if info is None:
             return None
         if (path, info.get("crc32")) == self._loaded:
+            return None
+        # Artifact-compat gate (sidecar format/topology stamp + shard-file
+        # presence): an artifact this replica can't boot — unsupported shard
+        # format version, sharded dir missing shard files — is rejected as a
+        # recorded reload failure BEFORE any load work, never a replica crash.
+        ok, why = artifact_bootable(path, info)
+        if not ok:
+            self._record_failure(path, RuntimeError(f"artifact not bootable: {why}"))
             return None
         cur = self.store.get()
         with trace.span("serve/reload", plane="serve", path=path) as sp:

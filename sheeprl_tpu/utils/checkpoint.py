@@ -38,12 +38,31 @@ def _to_host(tree):
     return jax.tree_util.tree_map(conv, tree, is_leaf=lambda x: isinstance(x, jax.Array))
 
 
+class _LazyHostPickler(pickle.Pickler):
+    """Pickler converting ``jax.Array`` leaves to numpy ONE AT A TIME, as the
+    stream reaches them. The old save path materialized a full host copy of
+    every leaf up front (``_to_host``) and then pickled that copy — doubling
+    peak host RAM for multi-GB buffer-in-checkpoint states even though
+    ``_CrcWriter`` exists precisely to stream. The produced byte stream is
+    identical to pickling the eager copy (numpy's own ``__reduce_ex__``), so
+    the on-disk format, CRCs, and legacy loaders are unchanged."""
+
+    def reducer_override(self, obj):
+        if isinstance(obj, jax.Array):
+            return np.asarray(obj).__reduce_ex__(pickle.HIGHEST_PROTOCOL)
+        return NotImplemented
+
+
 def _manifest(tree) -> Dict[str, Tuple[Tuple[int, ...], str]]:
-    """``{leaf path: (shape, dtype)}`` for every array leaf of the state."""
+    """``{leaf path: (shape, dtype)}`` for every array leaf of the state.
+
+    ``jax.Array`` leaves are recorded with the same shape/dtype strings their
+    numpy conversion will have, so the manifest written by the lazy save path
+    matches the manifest recomputed from the loaded (all-numpy) state."""
     out: Dict[str, Tuple[Tuple[int, ...], str]] = {}
     leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
     for path, leaf in leaves:
-        if isinstance(leaf, np.ndarray):
+        if isinstance(leaf, (np.ndarray, jax.Array)):
             out[jax.tree_util.keystr(path)] = (tuple(int(d) for d in leaf.shape), str(leaf.dtype))
     return out
 
@@ -111,17 +130,18 @@ def save_state(path: str, state: Dict[str, Any]) -> Dict[str, Any]:
     re-reading a potentially multi-GB checkpoint."""
     parent = os.path.dirname(os.path.abspath(path))
     os.makedirs(parent, exist_ok=True)
-    host_state = _to_host(state)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
         header = {
             "__format__": _CKPT_MAGIC,
             "format_version": CKPT_FORMAT_VERSION,
-            "manifest": _manifest(host_state),
+            "manifest": _manifest(state),
         }
         pickle.dump(header, f, protocol=pickle.HIGHEST_PROTOCOL)
         writer = _CrcWriter(f)
-        pickle.dump(host_state, writer, protocol=pickle.HIGHEST_PROTOCOL)
+        # device leaves stream to host one at a time inside the pickle — no
+        # up-front full-tree host copy (peak RAM ~ largest leaf, not the sum)
+        _LazyHostPickler(writer, protocol=pickle.HIGHEST_PROTOCOL).dump(state)
         pickle.dump({"crc32": writer.crc}, f, protocol=pickle.HIGHEST_PROTOCOL)
         f.flush()
         # Drill site: a truncate/kill here is a write torn BEFORE durability —
@@ -207,6 +227,14 @@ def read_manifest(path: str) -> Optional[Dict[str, Tuple[Tuple[int, ...], str]]]
     unpickled, even when the magic appears somewhere in its own leading bytes
     (advisor r4 + r5 findings).
     """
+    if os.path.isdir(path):  # sharded directory: project its JSON manifest
+        from sheeprl_tpu.utils import ckpt_sharded
+
+        manifest = ckpt_sharded.read_sharded_manifest(path)
+        return {
+            key: (tuple(int(d) for d in leaf["shape"]), str(np.dtype(leaf["dtype"])))
+            for key, leaf in manifest.get("leaves", {}).items()
+        }
     with open(path, "rb") as f:
         head = f.read(256)
         if not _v1_header_at_head(head):
@@ -249,6 +277,30 @@ def certify(path: str, crc32: Optional[int] = None, size: Optional[int] = None, 
 
     sidecar = certified_sidecar(path)
     payload = {"certified": True, "ckpt": os.path.basename(path), "crc32": crc32, "size": size}
+    # Artifact-format + mesh-topology stamp: rolling deploys and serve
+    # hot-reload check THIS before swapping a replica onto the artifact, so a
+    # shard-formatted checkpoint a replica can't boot is rejected up front.
+    if os.path.isdir(path):
+        from sheeprl_tpu.utils import ckpt_sharded
+
+        payload["format"] = "sharded"
+        payload["shard_format_version"] = ckpt_sharded.SHARD_FORMAT_VERSION
+        commit = ckpt_sharded.read_commit(path)
+        if commit is not None:
+            payload["world"] = commit.get("world")
+        try:
+            payload["topology"] = ckpt_sharded.read_sharded_manifest(path).get("topology", {})
+        except Exception:
+            pass
+    else:
+        payload["format"] = "file-v1"
+        try:
+            payload["topology"] = {
+                "process_count": int(jax.process_count()),
+                "device_count": int(jax.device_count()),
+            }
+        except Exception:
+            pass
     try:
         from sheeprl_tpu.telemetry import trace as _trace
 
@@ -281,6 +333,8 @@ def read_footer_crc(path: str) -> Optional[int]:
     STOP opcode, and the true footer ends the file, so the match is exact).
     Returns None for legacy bare-pickle checkpoints or unreadable files.
     """
+    if os.path.isdir(path):
+        return None  # sharded dirs carry per-entry CRCs in the commit marker
     try:
         size = os.path.getsize(path)
         with open(path, "rb") as f:
@@ -316,6 +370,14 @@ def is_certified(path: str) -> bool:
         return False
     if not (isinstance(payload, dict) and payload.get("certified") is True):
         return False
+    if os.path.isdir(path):
+        # Sharded directory: the sidecar vouches only for a COMMITTED
+        # generation whose shard files are all still present. File-level
+        # size/footer checks don't apply; per-entry CRCs run at load.
+        from sheeprl_tpu.utils import ckpt_sharded
+
+        ok, _ = ckpt_sharded.bootable(path)
+        return ok
     size = payload.get("size")
     if size is not None:
         try:
@@ -347,6 +409,31 @@ def certified_info(path: str) -> Optional[Dict[str, Any]]:
     except (OSError, ValueError):
         return None
     return payload if isinstance(payload, dict) else None
+
+
+#: Artifact formats this build can boot. A sidecar stamped by a NEWER build
+#: with a format outside this set is rejected by rolling deploys up front.
+SUPPORTED_ARTIFACT_FORMATS = (None, "file-v1", "sharded")
+
+
+def artifact_bootable(path: str, info: Optional[Dict[str, Any]] = None) -> Tuple[bool, str]:
+    """Can THIS process boot the certified artifact at ``path``? (Nothing is
+    loaded.) Serve hot-reload and fleet rolling deploys call this BEFORE
+    swapping a replica onto a new generation: an artifact in a format this
+    build can't read, or a sharded directory missing shard files, is rejected
+    with a reason instead of crashing the replica mid-deploy."""
+    fmt = (info or {}).get("format")
+    if fmt not in SUPPORTED_ARTIFACT_FORMATS:
+        return False, f"artifact format '{fmt}' is not supported by this build"
+    from sheeprl_tpu.utils import ckpt_sharded
+
+    version = (info or {}).get("shard_format_version")
+    if version is not None and version > ckpt_sharded.SHARD_FORMAT_VERSION:
+        return False, (
+            f"sharded format version {version} is newer than this build reads "
+            f"(<= {ckpt_sharded.SHARD_FORMAT_VERSION})"
+        )
+    return ckpt_sharded.bootable(path)
 
 
 def ckpt_sort_key(path: str) -> Tuple[float, int, str]:
@@ -393,8 +480,12 @@ def certified_under(root: str) -> Optional[str]:
     medium is the newest certified checkpoint across ALL of them."""
     best: Optional[str] = None
     best_key: Optional[Tuple[float, int, str]] = None
-    for base, _, files in os.walk(root):
-        for name in files:
+    for base, dirs, files in os.walk(root):
+        # sharded generations are *.ckpt DIRECTORIES — consider them as
+        # artifacts and don't descend into their shard files
+        sharded = [d for d in dirs if d.endswith(".ckpt")]
+        dirs[:] = [d for d in dirs if not d.endswith(".ckpt")]
+        for name in list(files) + sharded:
             if not name.endswith(".ckpt"):
                 continue
             cand = os.path.join(base, name)
@@ -414,6 +505,14 @@ class CheckpointCorruptionError(RuntimeError):
 
 
 def _load_state_file(path: str) -> Dict[str, Any]:
+    if os.path.isdir(path):
+        # Sharded generation: full elastic assembly (any restore topology,
+        # incl. single-device). Uncommitted/torn dirs raise
+        # CheckpointCorruptionError, landing on the same older-sibling
+        # fallback as a torn file. (Its own ckpt.load drill site fires there.)
+        from sheeprl_tpu.utils import ckpt_sharded
+
+        return ckpt_sharded.load_sharded(path)
     # Drill site: corrupt (in place) or raise here to force the certified-first
     # older-sibling fallback in load_state without hand-rolled byte flippers.
     failpoints.failpoint("ckpt.load", path=path)
@@ -531,10 +630,23 @@ class CheckpointCallback:
     the last ``truncated`` flag of every env stream is patched to True before saving and
     restored afterwards, so resumed training treats in-flight episodes as truncated
     (reference callback.py:87-142).
+
+    ``checkpointer`` (a :class:`~sheeprl_tpu.utils.ckpt_sharded.ShardedCheckpointer`)
+    switches saves to the async sharded path: the training thread pays only
+    the D2H snapshot (taken synchronously, so the buffer unpatch stays safe);
+    shard write, commit barrier, certification, and GC all run on the writer
+    thread. Every process calls the hook (each writes its own shard) — the
+    global-zero gate applies only to the legacy single-file path.
     """
 
-    def __init__(self, keep_last: Optional[int] = None):
+    def __init__(self, keep_last: Optional[int] = None, checkpointer: Optional[Any] = None):
         self.keep_last = keep_last
+        self.checkpointer = checkpointer
+
+    def flush(self, timeout: Optional[float] = 60.0) -> None:
+        """Drain any in-flight async sharded saves (end-of-run / pre-exit)."""
+        if self.checkpointer is not None:
+            self.checkpointer.wait(timeout)
 
     @staticmethod
     def _sub_buffers(rb):
@@ -590,7 +702,18 @@ class CheckpointCallback:
                 state["rb"] = (
                     replay_buffer.state_dict() if hasattr(replay_buffer, "state_dict") else replay_buffer
                 )
-            if runtime is None or runtime.is_global_zero:
+            if self.checkpointer is not None:
+                policy_step = extra.get("policy_step")
+                want_certify = bool(healthy)
+
+                def _finalize(path: str, result: Dict[str, Any]) -> None:
+                    # writer thread, rank 0, after a successful commit
+                    if want_certify:
+                        certify(path, policy_step=policy_step)
+                    self._gc(os.path.dirname(path))
+
+                self.checkpointer.save(ckpt_path, state, finalize=_finalize)
+            elif runtime is None or runtime.is_global_zero:
                 info = save_state(ckpt_path, state)
                 # healthy=None means the loop has no sentinel (or it's disabled):
                 # no sidecar is written and GC behaves exactly as before.
@@ -631,9 +754,18 @@ class CheckpointCallback:
         would leave the health sentinel with no rollback target. Certified
         files age out under their OWN keep_last budget (newest ``keep_last``
         certified survive) so disk use stays bounded, and orphan sidecars
-        (checkpoint deleted out-of-band) are swept."""
+        (checkpoint deleted out-of-band) are swept. Sharded checkpoint
+        DIRECTORIES ride the same windows; abandoned sharded debris —
+        uncommitted generations a newer commit superseded, orphaned commit
+        markers whose shards vanished — is swept alongside."""
         if not self.keep_last:
             return
+        try:
+            from sheeprl_tpu.utils import ckpt_sharded
+
+            ckpt_sharded.sweep_orphaned(ckpt_dir)
+        except Exception:
+            pass
         try:
             names = os.listdir(ckpt_dir)
         except FileNotFoundError:
@@ -657,7 +789,13 @@ class CheckpointCallback:
             if f.endswith(CERTIFIED_SUFFIX) and f[: -len(CERTIFIED_SUFFIX)] not in set(ckpts):
                 doomed.append(f)
         for f in doomed:
+            target = os.path.join(ckpt_dir, f)
             try:
-                os.remove(os.path.join(ckpt_dir, f))
+                if os.path.isdir(target):  # sharded generation directory
+                    import shutil
+
+                    shutil.rmtree(target, ignore_errors=True)
+                else:
+                    os.remove(target)
             except OSError:
                 pass

@@ -1,0 +1,1174 @@
+"""Elastic sharded checkpointing: per-host shard writes, two-phase commit,
+topology-elastic restore, and peer-RAM emergency recovery.
+
+The legacy path (``utils/checkpoint.py:save_state``) funnels the WHOLE state
+through one host: a synchronous full ``device_get`` plus a pickle on the
+training thread. On a pod-scale FSDP run that blocks the step loop for
+seconds, serializes every byte through a single writer, and loses the whole
+generation if that one host is preempted mid-save. This module is the
+mesh-sharded counterpart the PR 18/19 training plane needs — the same design
+the JAX ecosystem converged on for preemptible fleets (Orbax-style
+async/emergency checkpointing):
+
+**Layout.** A sharded checkpoint is a *directory* named ``*.ckpt`` (so every
+existing discovery surface — ``latest_certified``, sibling fallback, GC —
+sees it as one artifact)::
+
+    ckpt_100_0.ckpt/
+        TREE.pkl          # state skeleton: array leaves replaced by refs
+        MANIFEST.json     # global shapes/dtypes + window->shard-file map
+                          # + the mesh topology the save ran on
+        shard_00000.bin   # process 0's windows (per-entry offsets + CRCs)
+        shard_00001.bin   # process 1's windows
+        COMMIT            # the commit marker — absent = generation invisible
+
+**Per-process shard writes.** Each process snapshots only the windows it owns
+(the D2H copy is the only train-thread block; see :class:`ShardedCheckpointer`)
+and streams them into its own ``shard_<p>.bin`` with a per-entry CRC.
+Ownership is computed WITHOUT communication: every process walks the same
+``devices_indices_map`` and assigns each distinct index window to the process
+of the lowest-id device holding it, so replicated leaves are written exactly
+once fleet-wide.
+
+**Two-phase commit.** shards -> fsync -> barrier (``parallel/control.py``) ->
+atomic ``COMMIT`` rename by process 0. The marker is epoch-fenced: a zombie
+writer from a fenced incarnation fails :func:`commit` with
+:class:`~sheeprl_tpu.parallel.control.StaleEpochError` before the rename. An
+uncommitted directory is invisible to ``latest_certified``/``load_state``
+(loading raises ``CheckpointCorruptionError``, which lands on the existing
+certified-first older-sibling fallback) and is swept by checkpoint GC once a
+newer generation commits.
+
+**Topology-elastic restore.** :func:`load_sharded` assembles the full global
+state as numpy (any topology, incl. single-device serve/eval — the existing
+"algorithms re-shard on restore" contract). :func:`elastic_restore` takes
+target shardings for a *different* mesh shape and reads only the shard bytes
+each process needs (per-entry offsets allow seek+read of single windows).
+
+**Peer-RAM emergency recovery.** :class:`PeerReplicaStore` +
+:func:`replicate_to_peer`/:func:`fetch_from_peer` keep the latest state bytes
+in a peer host's RAM over the epoch-fenced chunk transport, so a restarted
+host rejoins mid-epoch without touching persistent storage at all. The
+restore-precedence order is peer RAM -> latest committed certified -> older
+sibling (:func:`emergency_restore`).
+
+Failpoints: ``ckpt.shard_write`` (before the shard fsync), ``ckpt.commit``
+(between barrier and marker rename), ``ckpt.replicate`` (before a peer-RAM
+push) — all in ``KNOWN_FAILPOINTS`` and drilled by
+``scripts/ckpt_sharded_smoke.py``.
+
+Module-level imports stay jax-free (like ``parallel/control.py``): the smoke's
+host children shard plain numpy states without an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import threading
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from sheeprl_tpu.core import failpoints
+
+SHARD_FORMAT_VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+TREE_NAME = "TREE.pkl"
+COMMIT_NAME = "COMMIT"
+_SHARD_MAGIC = "sheeprl_tpu_shardfile"
+
+#: Process-wide count of file opens made by the sharded LOAD path. The
+#: peer-RAM drill asserts a host that restored from its peer's memory made
+#: ZERO persistent-storage reads — this counter is that proof.
+READ_OPENS = 0
+
+
+class ShardedCheckpointError(RuntimeError):
+    pass
+
+
+def _corruption(msg: str) -> Exception:
+    # the corruption type load_state's older-sibling fallback catches; imported
+    # lazily so this module stays importable without the checkpoint module
+    from sheeprl_tpu.utils.checkpoint import CheckpointCorruptionError
+
+    return CheckpointCorruptionError(msg)
+
+
+def _open_for_read(path: str):
+    global READ_OPENS
+    READ_OPENS += 1
+    return open(path, "rb")
+
+
+def _fsync_dir(dirname: str) -> None:
+    try:
+        dfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dfd)
+    except OSError:
+        pass
+    finally:
+        os.close(dfd)
+
+
+# --------------------------------------------------------------------------- #
+# leaf keys and the state skeleton
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class ArrayRef:
+    """Placeholder for an array leaf inside the pickled state skeleton."""
+
+    key: str
+
+
+def _is_jax_array(x: Any) -> bool:
+    try:
+        import jax
+
+        return isinstance(x, jax.Array)
+    except Exception:
+        return False
+
+
+def _is_array_leaf(x: Any) -> bool:
+    return isinstance(x, np.ndarray) or _is_jax_array(x)
+
+
+def _flatten_state(state: Any) -> Tuple[List[Tuple[str, Any]], Any]:
+    """``([(leaf_key, leaf), ...], skeleton)`` where the skeleton is ``state``
+    with every array leaf replaced by an :class:`ArrayRef`.
+
+    Walks dicts/lists/tuples directly (insertion order) so the walk needs no
+    jax pytree machinery — the smoke's host children are jax-free. Exotic
+    containers survive as opaque skeleton leaves (pickled whole, like the
+    legacy path would)."""
+    leaves: List[Tuple[str, Any]] = []
+
+    def walk(node: Any, prefix: str) -> Any:
+        if _is_array_leaf(node):
+            key = prefix or "/"
+            leaves.append((key, node))
+            return ArrayRef(key)
+        if isinstance(node, dict):
+            return {k: walk(v, f"{prefix}/{k}") for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(v, f"{prefix}/{i}") for i, v in enumerate(node)]
+            if isinstance(node, list):
+                return out
+            # NamedTuples (optax opt states) must keep their class: a bare
+            # tuple would lose .mu/.nu attribute access on restore
+            return type(node)(*out) if hasattr(node, "_fields") else tuple(out)
+        return node
+
+    skeleton = walk(state, "")
+    return leaves, skeleton
+
+
+def _fill_skeleton(skeleton: Any, arrays: Dict[str, np.ndarray]) -> Any:
+    def walk(node: Any) -> Any:
+        if isinstance(node, ArrayRef):
+            if node.key not in arrays:
+                raise _corruption(f"sharded checkpoint is missing array leaf '{node.key}'")
+            return arrays[node.key]
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            out = [walk(v) for v in node]
+            if isinstance(node, list):
+                return out
+            return type(node)(*out) if hasattr(node, "_fields") else tuple(out)
+        return node
+
+    return walk(skeleton)
+
+
+# --------------------------------------------------------------------------- #
+# window plans: who writes which index window of which leaf
+# --------------------------------------------------------------------------- #
+
+Window = Tuple[Tuple[int, int], ...]  # ((start, stop), ...) per dim
+
+
+def _window_from_index(index: Sequence[slice], shape: Sequence[int]) -> Window:
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def _window_volume(window: Window) -> int:
+    vol = 1
+    for start, stop in window:
+        vol *= max(0, stop - start)
+    return vol
+
+
+@dataclass
+class _LeafPlan:
+    key: str
+    global_shape: Tuple[int, ...]
+    dtype: str
+    # window -> owning process index (deterministic on every process)
+    owners: Dict[Window, int] = field(default_factory=dict)
+
+
+def _plan_leaf(key: str, leaf: Any, process_index: int, world: int) -> _LeafPlan:
+    """The deterministic window->owner assignment every process agrees on.
+
+    jax arrays: walk ``devices_indices_map`` in device-id order and give each
+    DISTINCT window to the process of the lowest-id device holding it (so a
+    replicated leaf is written once, by one process). Host numpy leaves: split
+    axis 0 evenly across processes when divisible (each host holds an
+    identical replica under SPMD), else process 0 writes the whole leaf."""
+    if _is_jax_array(leaf):
+        shape = tuple(int(d) for d in leaf.shape)
+        plan = _LeafPlan(key=key, global_shape=shape, dtype=np.dtype(leaf.dtype).name)
+        dmap = leaf.sharding.devices_indices_map(shape)
+        for dev in sorted(dmap, key=lambda d: d.id):
+            window = _window_from_index(dmap[dev], shape)
+            plan.owners.setdefault(window, int(dev.process_index))
+        return plan
+    arr = np.asarray(leaf)
+    shape = tuple(int(d) for d in arr.shape)
+    plan = _LeafPlan(key=key, global_shape=shape, dtype=arr.dtype.name)
+    if world > 1 and arr.ndim > 0 and shape[0] % world == 0 and shape[0] > 0:
+        rows = shape[0] // world
+        for p in range(world):
+            window = ((p * rows, (p + 1) * rows),) + tuple((0, d) for d in shape[1:])
+            plan.owners[window] = p
+    else:
+        plan.owners[tuple((0, d) for d in shape)] = 0
+    return plan
+
+
+def _local_window_data(leaf: Any, window: Window) -> np.ndarray:
+    """The bytes for ``window`` from this process's replica of ``leaf`` — the
+    D2H copy for jax leaves, a defensive copy for numpy leaves (checkpoint
+    buffer state_dicts return VIEWS of live ring storage; the snapshot must
+    outlive the caller's unpatch)."""
+    if _is_jax_array(leaf):
+        for shard in leaf.addressable_shards:
+            if _window_from_index(shard.index, leaf.shape) == window:
+                return np.asarray(shard.data)
+        # replicated-but-unlisted window (single-device array asked for its
+        # full window): slice the array itself
+        idx = tuple(slice(start, stop) for start, stop in window)
+        return np.asarray(leaf[idx])
+    arr = np.asarray(leaf)
+    idx = tuple(slice(start, stop) for start, stop in window)
+    return np.array(arr[idx], copy=True)
+
+
+# --------------------------------------------------------------------------- #
+# snapshot: the only train-thread work
+# --------------------------------------------------------------------------- #
+
+
+@dataclass
+class Snapshot:
+    """Host-side copy of this process's windows, ready for a background write."""
+
+    process_index: int
+    world: int
+    plans: List[_LeafPlan]
+    entries: List[Tuple[str, Window, np.ndarray]]  # (leaf_key, window, data)
+    skeleton: Any
+    d2h_s: float = 0.0
+
+
+def snapshot_state(state: Any, process_index: int = 0, world: int = 1) -> Snapshot:
+    """Copy this process's windows to host memory (the D2H transfer). This is
+    the ONLY step :class:`ShardedCheckpointer` runs on the calling thread;
+    serialization, fsync, barrier, and commit all happen on the writer."""
+    t0 = time.perf_counter()
+    leaves, skeleton = _flatten_state(state)
+    plans: List[_LeafPlan] = []
+    entries: List[Tuple[str, Window, np.ndarray]] = []
+    for key, leaf in leaves:
+        plan = _plan_leaf(key, leaf, process_index, world)
+        plans.append(plan)
+        for window, owner in plan.owners.items():
+            if owner == process_index:
+                entries.append((key, window, _local_window_data(leaf, window)))
+    return Snapshot(
+        process_index=process_index,
+        world=world,
+        plans=plans,
+        entries=entries,
+        skeleton=skeleton,
+        d2h_s=time.perf_counter() - t0,
+    )
+
+
+# --------------------------------------------------------------------------- #
+# shard files
+# --------------------------------------------------------------------------- #
+
+
+def shard_file_name(process_index: int) -> str:
+    return f"shard_{process_index:05d}.bin"
+
+
+def write_shard(path: str, snap: Snapshot) -> Dict[str, Any]:
+    """Write this process's shard file (atomic tmp -> fsync -> rename).
+
+    Layout: one header pickle carrying a per-entry index (leaf key, window,
+    dtype, local shape, byte offset relative to the data section, nbytes,
+    CRC32), then the entries' raw C-order bytes. Offsets in the header let a
+    restoring process seek straight to the windows it needs."""
+    os.makedirs(path, exist_ok=True)
+    index = []
+    offset = 0
+    for key, window, data in snap.entries:
+        raw = data.tobytes()
+        index.append(
+            {
+                "leaf": key,
+                "window": [list(w) for w in window],
+                "dtype": data.dtype.name,
+                "shape": list(data.shape),
+                "offset": offset,
+                "nbytes": len(raw),
+                "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+            }
+        )
+        offset += len(raw)
+    header = {
+        "__format__": _SHARD_MAGIC,
+        "format_version": SHARD_FORMAT_VERSION,
+        "process": snap.process_index,
+        "world": snap.world,
+        "index": index,
+    }
+    name = shard_file_name(snap.process_index)
+    final = os.path.join(path, name)
+    tmp = final + ".tmp"
+    crc = 0
+    with open(tmp, "wb") as f:
+        pickle.dump(header, f, protocol=pickle.HIGHEST_PROTOCOL)
+        for (_, _, data), meta in zip(snap.entries, index):
+            raw = data.tobytes()
+            assert len(raw) == meta["nbytes"]
+            f.write(raw)
+            crc = zlib.crc32(raw, crc)
+        f.flush()
+        # Drill site: a kill/truncate here is a shard torn BEFORE durability —
+        # no commit can happen (the barrier never completes) and the whole
+        # generation stays invisible.
+        failpoints.failpoint("ckpt.shard_write", path=tmp, file=f, process=snap.process_index)
+        os.fsync(f.fileno())
+        size = f.tell()
+    os.replace(tmp, final)
+    _fsync_dir(path)
+    return {"file": name, "size": size, "crc32": crc, "entries": len(index)}
+
+
+def _read_shard_header(shard_path: str) -> Dict[str, Any]:
+    with _open_for_read(shard_path) as f:
+        try:
+            header = pickle.load(f)
+        except Exception as e:
+            raise _corruption(f"shard '{shard_path}' header is unreadable: {type(e).__name__}: {e}")
+        data_start = f.tell()
+    if not (isinstance(header, dict) and header.get("__format__") == _SHARD_MAGIC):
+        raise _corruption(f"'{shard_path}' is not a sheeprl_tpu shard file")
+    version = header.get("format_version")
+    if not isinstance(version, int) or version > SHARD_FORMAT_VERSION:
+        raise ShardedCheckpointError(
+            f"shard '{shard_path}' has format_version {version}; this build reads "
+            f"<= {SHARD_FORMAT_VERSION}"
+        )
+    header["data_start"] = data_start
+    return header
+
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    import ml_dtypes  # bf16 & friends live outside numpy's builtin table
+
+    return np.dtype(getattr(ml_dtypes, name))
+
+
+def _read_shard_entry(
+    shard_path: str, header: Dict[str, Any], meta: Dict[str, Any], stats: Optional[Dict[str, int]] = None
+) -> np.ndarray:
+    with _open_for_read(shard_path) as f:
+        f.seek(header["data_start"] + int(meta["offset"]))
+        raw = f.read(int(meta["nbytes"]))
+    if len(raw) != int(meta["nbytes"]) or (zlib.crc32(raw) & 0xFFFFFFFF) != meta["crc32"]:
+        raise _corruption(
+            f"shard '{shard_path}' entry for leaf '{meta['leaf']}' failed its CRC "
+            "(torn shard, truncated copy, or bit rot)"
+        )
+    if stats is not None:
+        stats["bytes_read"] = stats.get("bytes_read", 0) + len(raw)
+        stats["entries_read"] = stats.get("entries_read", 0) + 1
+    return np.frombuffer(raw, dtype=_np_dtype(meta["dtype"])).reshape(meta["shape"])
+
+
+# --------------------------------------------------------------------------- #
+# manifest + commit
+# --------------------------------------------------------------------------- #
+
+
+def _mesh_topology(state: Any) -> Dict[str, Any]:
+    """Mesh/topology facts of the SAVING world, for the manifest and the
+    certification sidecar: process count, device count, and the named mesh
+    axes of the first NamedSharding leaf (the restore side uses this only for
+    diagnostics/compat — elastic restore never requires shape agreement)."""
+    topo: Dict[str, Any] = {}
+    try:
+        import jax
+
+        topo["process_count"] = int(jax.process_count())
+        topo["device_count"] = int(jax.device_count())
+    except Exception:
+        topo["process_count"] = 1
+        topo["device_count"] = 0
+    leaves, _ = _flatten_state(state)
+    for _, leaf in leaves:
+        if _is_jax_array(leaf):
+            sharding = leaf.sharding
+            mesh = getattr(sharding, "mesh", None)
+            if mesh is not None:
+                try:
+                    topo["mesh_axis_names"] = [str(a) for a in mesh.axis_names]
+                    topo["mesh_shape"] = [int(mesh.shape[a]) for a in mesh.axis_names]
+                except Exception:
+                    pass
+                break
+    return topo
+
+
+def write_manifest(path: str, snap: Snapshot, topology: Optional[Dict[str, Any]] = None) -> None:
+    """Process 0 writes the global manifest + the pickled state skeleton.
+
+    The manifest maps every leaf's windows to the shard FILE that carries
+    them, so a restoring process can open only the files (and, via per-entry
+    offsets, only the byte ranges) it needs."""
+    os.makedirs(path, exist_ok=True)
+    leaves = {}
+    for plan in snap.plans:
+        leaves[plan.key] = {
+            "shape": list(plan.global_shape),
+            "dtype": plan.dtype,
+            "windows": [
+                {"window": [list(w) for w in window], "file": shard_file_name(owner)}
+                for window, owner in plan.owners.items()
+            ],
+        }
+    manifest = {
+        "format_version": SHARD_FORMAT_VERSION,
+        "world": snap.world,
+        "topology": topology or {},
+        "leaves": leaves,
+    }
+    tmp = os.path.join(path, MANIFEST_NAME + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, MANIFEST_NAME))
+    tree_tmp = os.path.join(path, TREE_NAME + ".tmp")
+    with open(tree_tmp, "wb") as f:
+        pickle.dump(snap.skeleton, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tree_tmp, os.path.join(path, TREE_NAME))
+    _fsync_dir(path)
+
+
+def read_sharded_manifest(path: str) -> Dict[str, Any]:
+    mpath = os.path.join(path, MANIFEST_NAME)
+    try:
+        with _open_for_read(mpath) as f:
+            manifest = json.loads(f.read().decode())
+    except (OSError, ValueError) as e:
+        raise _corruption(f"sharded checkpoint '{path}' has no readable manifest: {e}")
+    version = manifest.get("format_version")
+    if not isinstance(version, int) or version > SHARD_FORMAT_VERSION:
+        raise ShardedCheckpointError(
+            f"sharded checkpoint '{path}' has format_version {version}; this build reads "
+            f"<= {SHARD_FORMAT_VERSION}"
+        )
+    return manifest
+
+
+def commit_marker(path: str) -> str:
+    return os.path.join(path, COMMIT_NAME)
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(commit_marker(path))
+
+
+def read_commit(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(commit_marker(path), "rb") as f:
+            payload = json.loads(f.read().decode())
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def commit(
+    path: str,
+    shard_infos: Dict[int, Dict[str, Any]],
+    *,
+    plane: Any = None,
+    epoch: int = 0,
+    fence_role: str = "ckpt_writer",
+) -> Dict[str, Any]:
+    """Phase two: make the generation visible, exactly once, never by a zombie.
+
+    ``shard_infos`` is every process's :func:`write_shard` result (rank 0
+    gathers them via ``plane.all_gather_meta`` in :func:`save_sharded`). The
+    epoch fence re-reads the AUTHORITATIVE epoch key right before the rename:
+    a writer whose incarnation has been superseded raises
+    :class:`~sheeprl_tpu.parallel.control.StaleEpochError` and the marker is
+    never created — its half-written generation stays invisible and is swept
+    by GC once a live incarnation commits a newer one."""
+    if plane is not None:
+        from sheeprl_tpu.parallel.control import StaleEpochError
+
+        authoritative = plane.adopt_epoch(fence_role)
+        if epoch < authoritative:
+            raise StaleEpochError(
+                f"checkpoint commit of '{path}': writer epoch {epoch} has been "
+                f"superseded by {authoritative} — a newer incarnation owns the "
+                "checkpoint stream; discarding this generation"
+            )
+    payload = {
+        "committed": True,
+        "epoch": int(epoch),
+        "world": len(shard_infos),
+        "shards": {str(p): info for p, info in sorted(shard_infos.items())},
+        "t": time.time(),
+    }
+    # Drill site: a kill here is the window between "all shards durable" and
+    # "generation visible" — the fleet must resume from the PREVIOUS certified
+    # generation and GC must sweep this one.
+    failpoints.failpoint("ckpt.commit", path=path, epoch=epoch)
+    tmp = commit_marker(path) + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, commit_marker(path))
+    _fsync_dir(path)
+    return payload
+
+
+def save_sharded(
+    path: str,
+    state: Any,
+    *,
+    process_index: int = 0,
+    world: int = 1,
+    plane: Any = None,
+    epoch: int = 0,
+    fence_role: str = "ckpt_writer",
+    snapshot: Optional[Snapshot] = None,
+    barrier_timeout_ms: int = 60_000,
+) -> Dict[str, Any]:
+    """The synchronous all-in-one save (snapshot + shard + barrier + commit).
+
+    Every process of the world calls this with the same ``path``; rank 0
+    additionally writes the manifest and, after the all-shards-durable
+    rendezvous, the commit marker. Returns the per-process summary (rank 0's
+    carries the commit payload). :class:`ShardedCheckpointer` runs everything
+    after the snapshot on a background thread."""
+    snap = snapshot if snapshot is not None else snapshot_state(state, process_index, world)
+    parent = os.path.dirname(os.path.abspath(path))
+    os.makedirs(parent, exist_ok=True)
+    info = write_shard(path, snap)
+    if process_index == 0:
+        write_manifest(path, snap, topology=_mesh_topology(state) if state is not None else None)
+    if plane is not None and world > 1:
+        gathered = plane.all_gather_meta(f"ckpt_shards/{os.path.basename(path)}", info, timeout_ms=barrier_timeout_ms)
+        shard_infos = {int(r): m for r, m in gathered.items()}
+        plane.barrier(f"ckpt_commit/{os.path.basename(path)}", timeout_ms=barrier_timeout_ms)
+    else:
+        shard_infos = {process_index: info}
+    out: Dict[str, Any] = {"shard": info, "path": path, "d2h_s": snap.d2h_s}
+    if process_index == 0:
+        out["commit"] = commit(path, shard_infos, plane=plane, epoch=epoch, fence_role=fence_role)
+        _fsync_dir(parent)
+    return out
+
+
+# --------------------------------------------------------------------------- #
+# restore
+# --------------------------------------------------------------------------- #
+
+
+def _load_skeleton(path: str) -> Any:
+    tpath = os.path.join(path, TREE_NAME)
+    try:
+        with _open_for_read(tpath) as f:
+            return pickle.load(f)
+    except ShardedCheckpointError:
+        raise
+    except Exception as e:
+        raise _corruption(f"sharded checkpoint '{path}' has no readable state skeleton: {e}")
+
+
+def _require_committed(path: str) -> Dict[str, Any]:
+    payload = read_commit(path)
+    if payload is None or payload.get("committed") is not True:
+        raise _corruption(
+            f"sharded checkpoint '{path}' has no commit marker: the generation was "
+            "abandoned mid-save (host preempted between shard write and commit) and "
+            "must not be resumed from"
+        )
+    return payload
+
+
+def _window_reader(path: str) -> Callable[[str, Dict[str, Any], Optional[Dict[str, int]]], np.ndarray]:
+    header_cache: Dict[str, Dict[str, Any]] = {}
+
+    def read(file_name: str, meta: Dict[str, Any], stats: Optional[Dict[str, int]]) -> np.ndarray:
+        shard_path = os.path.join(path, file_name)
+        if file_name not in header_cache:
+            if not os.path.isfile(shard_path):
+                raise _corruption(
+                    f"sharded checkpoint '{path}' is missing shard file '{file_name}' "
+                    "named by its manifest"
+                )
+            header_cache[file_name] = _read_shard_header(shard_path)
+        header = header_cache[file_name]
+        entry = next(
+            (
+                e
+                for e in header["index"]
+                if e["leaf"] == meta["leaf"] and e["window"] == meta["window"]
+            ),
+            None,
+        )
+        if entry is None:
+            raise _corruption(
+                f"shard '{file_name}' does not carry window {meta['window']} of leaf "
+                f"'{meta['leaf']}' promised by the manifest"
+            )
+        return _read_shard_entry(shard_path, header, entry, stats)
+
+    return read
+
+
+def _windows_overlap(a: Window, b: Window) -> bool:
+    return all(sa < eb and sb < ea for (sa, ea), (sb, eb) in zip(a, b))
+
+
+def load_sharded(
+    path: str,
+    stats: Optional[Dict[str, int]] = None,
+) -> Any:
+    """Assemble the FULL global state as a numpy tree — the topology-elastic
+    default (works on any restore topology incl. single-device, matching the
+    legacy ``load_state`` contract: algorithms re-shard on restore).
+
+    Raises ``CheckpointCorruptionError`` for an uncommitted generation, a
+    missing/torn shard, or a CRC mismatch — the same corruption boundary the
+    older-sibling fallback keys on."""
+    failpoints.failpoint("ckpt.load", path=path)
+    _require_committed(path)
+    manifest = read_sharded_manifest(path)
+    skeleton = _load_skeleton(path)
+    read = _window_reader(path)
+    arrays: Dict[str, np.ndarray] = {}
+    for key, leaf in manifest.get("leaves", {}).items():
+        shape = tuple(int(d) for d in leaf["shape"])
+        out = np.empty(shape, dtype=_np_dtype(leaf["dtype"]))
+        for wmeta in leaf["windows"]:
+            window = tuple(tuple(w) for w in wmeta["window"])
+            data = read(wmeta["file"], {"leaf": key, "window": wmeta["window"]}, stats)
+            idx = tuple(slice(start, stop) for start, stop in window)
+            out[idx] = data
+        arrays[key] = out
+    return _fill_skeleton(skeleton, arrays)
+
+
+def elastic_restore(
+    path: str,
+    sharding_for: Callable[[str, Tuple[int, ...], str], Any],
+    stats: Optional[Dict[str, int]] = None,
+) -> Any:
+    """Restore onto a DIFFERENT mesh shape, reading only the bytes this
+    process needs.
+
+    ``sharding_for(leaf_key, global_shape, dtype)`` returns the target
+    ``jax.sharding.Sharding`` for an array leaf (or None to assemble it as
+    host numpy). For each target shard this process addresses, only the
+    manifest windows overlapping it are read (seek+read of single entries),
+    then per-device arrays are assembled with
+    ``jax.make_array_from_single_device_arrays`` — a checkpoint saved on mesh
+    shape A restores bit-identically on mesh shape B with per-process reads
+    proportional to B's local footprint, not A's global one."""
+    import jax
+
+    failpoints.failpoint("ckpt.load", path=path)
+    _require_committed(path)
+    manifest = read_sharded_manifest(path)
+    skeleton = _load_skeleton(path)
+    read = _window_reader(path)
+    arrays: Dict[str, Any] = {}
+    for key, leaf in manifest.get("leaves", {}).items():
+        shape = tuple(int(d) for d in leaf["shape"])
+        dtype = _np_dtype(leaf["dtype"])
+        target = sharding_for(key, shape, leaf["dtype"])
+        windows = [
+            (tuple(tuple(w) for w in wmeta["window"]), wmeta["file"], wmeta["window"])
+            for wmeta in leaf["windows"]
+        ]
+
+        def gather_window(want: Window) -> np.ndarray:
+            out = np.empty(tuple(stop - start for start, stop in want), dtype=dtype)
+            covered = 0
+            for window, file_name, raw_window in windows:
+                if not _windows_overlap(want, window):
+                    continue
+                data = read(file_name, {"leaf": key, "window": raw_window}, stats)
+                # intersection of `window` and `want`, in both frames
+                src_idx, dst_idx = [], []
+                for (ws, we), (ts, te) in zip(window, want):
+                    lo, hi = max(ws, ts), min(we, te)
+                    src_idx.append(slice(lo - ws, hi - ws))
+                    dst_idx.append(slice(lo - ts, hi - ts))
+                block = data[tuple(src_idx)]
+                out[tuple(dst_idx)] = block
+                covered += block.size
+            if covered < out.size:
+                raise _corruption(
+                    f"sharded checkpoint '{path}': leaf '{key}' window {want} is not "
+                    "fully covered by the stored shards"
+                )
+            return out
+
+        if target is None:
+            arrays[key] = gather_window(tuple((0, d) for d in shape))
+            continue
+        dmap = target.devices_indices_map(shape)
+        local = [(dev, _window_from_index(idx, shape)) for dev, idx in dmap.items() if dev.process_index == jax.process_index()]
+        singles = [
+            jax.device_put(gather_window(window), dev) for dev, window in sorted(local, key=lambda t: t[0].id)
+        ]
+        arrays[key] = jax.make_array_from_single_device_arrays(shape, target, singles)
+    return _fill_skeleton(skeleton, arrays)
+
+
+def bootable(path: str) -> Tuple[bool, str]:
+    """Can THIS process boot the artifact at ``path``? (No state is loaded.)
+
+    For sharded directories: the commit marker must exist, the manifest must
+    parse at a supported format version, and every shard file it names must be
+    present — a dir that lost shards out-of-band (partial rsync, tier
+    migration) is rejected BEFORE a serve replica swaps onto it. Plain files
+    are always bootable here (their CRC/manifest checks run at load)."""
+    if not os.path.isdir(path):
+        return True, ""
+    if not is_committed(path):
+        return False, "no commit marker (generation was never committed)"
+    try:
+        manifest = read_sharded_manifest(path)
+    except ShardedCheckpointError as e:
+        return False, str(e)
+    except Exception as e:
+        return False, f"unreadable manifest: {e}"
+    missing = set()
+    for leaf in manifest.get("leaves", {}).values():
+        for wmeta in leaf["windows"]:
+            name = wmeta["file"]
+            if name not in missing and not os.path.isfile(os.path.join(path, name)):
+                missing.add(name)
+    if missing:
+        return False, f"missing shard file(s): {', '.join(sorted(missing))}"
+    if not os.path.isfile(os.path.join(path, TREE_NAME)):
+        return False, "missing state skeleton (TREE.pkl)"
+    return True, ""
+
+
+# --------------------------------------------------------------------------- #
+# GC helpers (called from CheckpointCallback._gc)
+# --------------------------------------------------------------------------- #
+
+
+def sweep_orphaned(ckpt_dir: str) -> List[str]:
+    """Remove abandoned sharded artifacts: (a) UNCOMMITTED shard directories
+    that a newer committed generation has superseded — the debris of a host
+    killed between shard write and commit; (b) orphaned commit markers —
+    directories whose marker survives but whose manifest/shards were deleted
+    out-of-band, which can never boot again. Returns the paths removed."""
+    try:
+        names = os.listdir(ckpt_dir)
+    except OSError:
+        return []
+    dirs = [
+        os.path.join(ckpt_dir, n)
+        for n in names
+        if n.endswith(".ckpt") and os.path.isdir(os.path.join(ckpt_dir, n))
+    ]
+    committed = [d for d in dirs if is_committed(d)]
+    newest_commit = max((os.path.getmtime(commit_marker(d)) for d in committed), default=None)
+    removed: List[str] = []
+    for d in dirs:
+        if not is_committed(d):
+            # sweep only once a NEWER generation committed: an uncommitted dir
+            # younger than every commit may still be mid-save
+            try:
+                mtime = os.path.getmtime(d)
+            except OSError:
+                continue
+            if newest_commit is not None and mtime < newest_commit:
+                shutil.rmtree(d, ignore_errors=True)
+                removed.append(d)
+            continue
+        ok, _reason = bootable(d)
+        if not ok and len(committed) > 1:
+            # an orphaned commit marker vouches for shards that no longer
+            # exist; keep it only while it is the sole committed artifact
+            # (an operator may be restoring the missing files)
+            shutil.rmtree(d, ignore_errors=True)
+            removed.append(d)
+    return removed
+
+
+# --------------------------------------------------------------------------- #
+# async writer: D2H on the caller, everything else in the background
+# --------------------------------------------------------------------------- #
+
+
+class _Pending:
+    def __init__(self) -> None:
+        self._done = threading.Event()
+        self.result: Optional[Dict[str, Any]] = None
+        self.error: Optional[BaseException] = None
+        self.blocked_s: float = 0.0
+
+    def wait(self, timeout: Optional[float] = None) -> "_Pending":
+        if not self._done.wait(timeout):
+            raise TimeoutError("sharded checkpoint write still in flight")
+        if self.error is not None:
+            raise self.error
+        return self
+
+
+class ShardedCheckpointer:
+    """Async per-host shard writer.
+
+    ``save()`` runs :func:`snapshot_state` on the calling thread (the D2H copy
+    — the only train-thread block) and queues everything else (serialize,
+    fsync, barrier, commit, certify, GC) onto one daemon writer thread. Writes
+    are strictly ordered; ``wait()``/``close()`` drain the queue. A commit
+    fenced by :class:`~sheeprl_tpu.parallel.control.StaleEpochError` marks the
+    pending save failed and stops the writer — the only correct reaction of a
+    superseded incarnation."""
+
+    def __init__(
+        self,
+        *,
+        process_index: int = 0,
+        world: int = 1,
+        plane: Any = None,
+        fence_role: str = "ckpt_writer",
+        on_committed: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+    ) -> None:
+        self.process_index = int(process_index)
+        self.world = int(world)
+        self.plane = plane
+        self.fence_role = fence_role
+        self.epoch = 0
+        if plane is not None:
+            self.epoch = plane.begin_session(fence_role) if process_index == 0 else plane.adopt_epoch(fence_role)
+        self.on_committed = on_committed
+        self.last_blocked_s: float = 0.0
+        self._queue: List[Tuple[str, Snapshot, Dict[str, Any], _Pending]] = []
+        self._cond = threading.Condition()
+        self._stopping = False
+        self._worker = threading.Thread(target=self._run, name="sheeprl-ckpt-writer", daemon=True)
+        self._worker.start()
+
+    def save(
+        self,
+        path: str,
+        state: Any,
+        finalize: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+        **topo_extra: Any,
+    ) -> _Pending:
+        """``finalize(path, result)`` runs on the WRITER thread after a
+        successful commit (rank 0 only) — the hook ``CheckpointCallback`` uses
+        to certify + GC off the training thread."""
+        t0 = time.perf_counter()
+        snap = snapshot_state(state, self.process_index, self.world)
+        topology = _mesh_topology(state)
+        topology.update(topo_extra)
+        pending = _Pending()
+        pending.blocked_s = time.perf_counter() - t0
+        self.last_blocked_s = pending.blocked_s
+        with self._cond:
+            if self._stopping:
+                raise ShardedCheckpointError("ShardedCheckpointer is closed")
+            self._queue.append((path, snap, topology, finalize, pending))
+            self._cond.notify_all()
+        return pending
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stopping:
+                    self._cond.wait(0.25)
+                if not self._queue:
+                    if self._stopping:
+                        return
+                    continue
+                path, snap, topology, finalize, pending = self._queue.pop(0)
+            if path is None:  # drain sentinel from wait()
+                pending._done.set()
+                continue
+            try:
+                info = write_shard(path, snap)
+                if self.process_index == 0:
+                    write_manifest(path, snap, topology=topology)
+                if self.plane is not None and self.world > 1:
+                    gathered = self.plane.all_gather_meta(
+                        f"ckpt_shards/{os.path.basename(path)}", info
+                    )
+                    shard_infos = {int(r): m for r, m in gathered.items()}
+                    self.plane.barrier(f"ckpt_commit/{os.path.basename(path)}")
+                else:
+                    shard_infos = {self.process_index: info}
+                result: Dict[str, Any] = {"shard": info, "path": path, "d2h_s": snap.d2h_s}
+                if self.process_index == 0:
+                    result["commit"] = commit(
+                        path,
+                        shard_infos,
+                        plane=self.plane,
+                        epoch=self.epoch,
+                        fence_role=self.fence_role,
+                    )
+                    if finalize is not None:
+                        finalize(path, result)
+                    if self.on_committed is not None:
+                        self.on_committed(path, result)
+                pending.result = result
+            except BaseException as e:  # surfaced via pending.wait(); never silent
+                pending.error = e
+            finally:
+                pending._done.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        """Block until every queued save has finished (success or failure)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._cond:
+                if not self._queue:
+                    break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("sharded checkpoint queue did not drain")
+            time.sleep(0.01)
+        # the worker may still be mid-write on the last popped job; join via a
+        # drain sentinel the worker completes in order
+        probe = _Pending()
+        with self._cond:
+            if self._stopping:
+                return
+            self._queue.append((None, None, None, None, probe))
+            self._cond.notify_all()
+        probe.wait(timeout)
+
+    def close(self, timeout: Optional[float] = 60.0) -> None:
+        try:
+            self.wait(timeout)
+        except TimeoutError:
+            pass
+        with self._cond:
+            self._stopping = True
+            self._cond.notify_all()
+        self._worker.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------- #
+# peer-RAM emergency recovery
+# --------------------------------------------------------------------------- #
+
+_REPLICA_CHUNK = 1 << 18  # control-plane values are strings; keep chunks modest
+
+
+def _replica_channel(rank: int) -> str:
+    return f"ckptrep/{rank}"
+
+
+def _fetch_req_key(plane: Any, rank: int) -> str:
+    return plane._key("ckptfetch", str(rank))
+
+
+def _fetch_channel(rank: int, token: str) -> str:
+    return f"ckptres/{rank}/{token}"
+
+
+def replicate_to_peer(plane: Any, payload: bytes, generation: int, timeout_ms: int = 60_000) -> int:
+    """Push ``payload`` (this host's latest state, already serialized) to the
+    peer's in-RAM store over the epoch-fenced chunk transport. Returns the
+    number of chunks sent. A fenced (superseded) writer surfaces
+    ``StaleEpochError`` from the transport — the zombie stops replicating."""
+    fp = failpoints.failpoint("ckpt.replicate", generation=generation)
+    if fp is failpoints.DROPPED:
+        return 0
+    channel = _replica_channel(plane.rank)
+    chunks = [payload[i : i + _REPLICA_CHUNK] for i in range(0, len(payload), _REPLICA_CHUNK)] or [b""]
+    header = json.dumps({"gen": int(generation), "nchunks": len(chunks), "nbytes": len(payload)}).encode()
+    # The reader advances its durable cursor AFTER acking, so a push fired
+    # right on the heels of the last one could re-read a stale cursor and
+    # wedge on an already-acked seq. Within one incarnation our own send
+    # count is authoritative; the durable cursor only seeds a restart.
+    sent: Dict[str, int] = plane.__dict__.setdefault("_ckptrep_next_seq", {})
+    seq = max(plane.chunk_cursor(channel) + 1, sent.get(channel, 0))
+    plane.send_chunk(channel, seq, header, timeout_ms=timeout_ms)
+    for i, chunk in enumerate(chunks):
+        plane.send_chunk(channel, seq + 1 + i, chunk, timeout_ms=timeout_ms)
+    sent[channel] = seq + 1 + len(chunks)
+    return len(chunks)
+
+
+class PeerReplicaStore(threading.Thread):
+    """The PEER side: receives a neighbor host's replication stream, keeps the
+    newest snapshot in RAM, and answers fetch requests from the neighbor's
+    restarted incarnation — no persistent storage anywhere on the path."""
+
+    def __init__(self, plane: Any, src_rank: int, poll_ms: int = 200, fence_role: Optional[str] = None):
+        super().__init__(name=f"sheeprl-ckpt-replica-{src_rank}", daemon=True)
+        self.plane = plane
+        self.src_rank = int(src_rank)
+        self.poll_ms = int(poll_ms)
+        self.fence_role = fence_role
+        self.latest: Optional[Tuple[int, bytes]] = None  # (generation, payload)
+        self.snapshots_held = 0
+        self._stop_evt = threading.Event()  # NB: Thread reserves the _stop name
+        self._served_tokens: set = set()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def run(self) -> None:
+        if self.fence_role is not None:
+            # fence the replication stream on the source's incarnation epoch:
+            # a zombie writer's pushes are stale-rejected by recv_chunk
+            self.plane.adopt_epoch(self.fence_role)
+        channel = _replica_channel(self.src_rank)
+        seq = self.plane.chunk_cursor(channel) + 1
+        while not self._stop_evt.is_set():
+            self._answer_fetch()
+            try:
+                header_raw = self.plane.recv_chunk(channel, seq, timeout_ms=self.poll_ms)
+            except Exception:
+                continue  # timeout/no traffic: keep polling fetch requests
+            try:
+                header = json.loads(header_raw.decode())
+                nchunks = int(header["nchunks"])
+            except (ValueError, KeyError):
+                seq += 1
+                continue
+            parts: List[bytes] = []
+            ok = True
+            for i in range(nchunks):
+                try:
+                    parts.append(self.plane.recv_chunk(channel, seq + 1 + i, timeout_ms=30_000))
+                except Exception:
+                    ok = False
+                    break
+            seq += 1 + len(parts)
+            if not ok:
+                continue
+            payload = b"".join(parts)
+            if len(payload) == int(header.get("nbytes", len(payload))):
+                self.latest = (int(header.get("gen", 0)), payload)
+                self.snapshots_held += 1
+
+    def _answer_fetch(self) -> None:
+        raw = self.plane.kv.try_get(_fetch_req_key(self.plane, self.src_rank), timeout_ms=20)
+        if raw is None or raw in self._served_tokens or self.latest is None:
+            return
+        self._served_tokens.add(raw)
+        gen, payload = self.latest
+        channel = _fetch_channel(self.src_rank, raw)
+        chunks = [payload[i : i + _REPLICA_CHUNK] for i in range(0, len(payload), _REPLICA_CHUNK)] or [b""]
+        header = json.dumps({"gen": gen, "nchunks": len(chunks), "nbytes": len(payload)}).encode()
+        try:
+            self.plane.send_chunk(channel, 0, header, timeout_ms=60_000)
+            for i, chunk in enumerate(chunks):
+                self.plane.send_chunk(channel, 1 + i, chunk, timeout_ms=60_000)
+        except Exception:
+            # the fetcher died mid-restore; it will re-request with a new token
+            self._served_tokens.discard(raw)
+
+
+def fetch_from_peer(plane: Any, timeout_ms: int = 60_000) -> Optional[Tuple[int, bytes]]:
+    """A restarted host's side: ask the peer's :class:`PeerReplicaStore` for
+    the in-RAM snapshot of OUR rank. Returns ``(generation, payload)`` or None
+    when no peer answered in time (fall through to persistent storage)."""
+    token = f"{plane.epoch}-{plane.rank}-{int(time.time() * 1000)}"
+    try:
+        plane.kv.set(_fetch_req_key(plane, plane.rank), token)
+    except Exception:
+        return None
+    channel = _fetch_channel(plane.rank, token)
+    try:
+        header_raw = plane.recv_chunk(channel, 0, timeout_ms=timeout_ms)
+        header = json.loads(header_raw.decode())
+        parts = [
+            plane.recv_chunk(channel, 1 + i, timeout_ms=timeout_ms)
+            for i in range(int(header["nchunks"]))
+        ]
+    except Exception:
+        return None
+    payload = b"".join(parts)
+    if len(payload) != int(header.get("nbytes", -1)):
+        return None
+    return int(header.get("gen", 0)), payload
+
+
+def emergency_restore(
+    ckpt_dir: str,
+    plane: Any = None,
+    *,
+    peer_timeout_ms: int = 10_000,
+    stats: Optional[Dict[str, int]] = None,
+) -> Tuple[Optional[Any], str]:
+    """The restore-precedence order for a restarted host:
+
+    1. **peer RAM** — zero persistent-storage reads, newest state (may be
+       newer than any committed checkpoint);
+    2. **latest committed certified** checkpoint in ``ckpt_dir``;
+    3. the **older-sibling** corruption fallback inside ``load_state``.
+
+    Returns ``(state, source)`` where source is ``"peer"``, ``"certified"``,
+    or ``"none"``."""
+    if plane is not None:
+        got = fetch_from_peer(plane, timeout_ms=peer_timeout_ms)
+        if got is not None:
+            gen, payload = got
+            if stats is not None:
+                stats["peer_bytes"] = len(payload)
+                stats["peer_generation"] = gen
+            return pickle.loads(payload), "peer"
+    from sheeprl_tpu.utils import checkpoint as ckpt
+
+    path = ckpt.latest_certified(ckpt_dir)
+    if path is None:
+        return None, "none"
+    return ckpt.load_state(path), "certified"

@@ -1,0 +1,37 @@
+"""Satellite registration of scripts/ckpt_sharded_smoke.py as a tier-1 test: a
+two-host sharded checkpoint fleet must commit healthy generations atomically,
+leave NO visible generation when a host is killed before the commit barrier
+(``ckpt.commit`` and ``ckpt.shard_write`` failpoints, real kill delivery),
+fence a zombie writer's late commit via the session epoch, garbage-collect the
+abandoned shard directories, and restore a restarted host from a peer's RAM
+replica with zero persistent-storage reads (full harness, fresh
+interpreters)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.faults
+@pytest.mark.timeout(240)
+def test_ckpt_sharded_smoke_kill_commit_peer_restore():
+    out = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "ckpt_sharded_smoke.py"),
+            "--timeout",
+            "180",
+        ],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        capture_output=True,
+        text=True,
+        timeout=220,
+    )
+    assert out.returncode == 0, f"stdout:\n{out.stdout[-2000:]}\nstderr:\n{out.stderr[-3000:]}"
+    assert "ckpt sharded smoke OK" in out.stdout
+    assert "0 storage reads" in out.stdout
+    assert "[200, 250] discarded" in out.stdout
